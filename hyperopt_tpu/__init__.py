@@ -1,0 +1,64 @@
+"""hyperopt_tpu — a TPU-native hyperparameter-optimization framework.
+
+A ground-up JAX/XLA re-design of the capabilities of the reference
+(``jonatasfreitasv/hyperopt``, a fork of hyperopt — see SURVEY.md): the same
+public surface (``fmin``, ``hp.*`` search-space DSL, suggest-algorithm and
+``Trials`` plugin boundaries, random / TPE / annealing / mixture / ATPE
+algorithms, distributed trial stores), with the numeric core compiled to XLA:
+
+* search spaces compile ONCE to batched, jitted samplers (dense vals + masks
+  instead of ragged idxs/vals),
+* TPE's adaptive-Parzen fitting, GMM log-pdfs and EI scoring are jitted
+  batched kernels over a device-resident trial history,
+* candidate batches and multi-start posteriors shard across a TPU slice via
+  ``jax.sharding`` / ``shard_map``.
+"""
+
+from . import anneal, atpe, hp, mix, rand, tpe  # noqa: F401
+from .base import (  # noqa: F401
+    Ctrl,
+    Domain,
+    JOB_STATE_CANCEL,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    JOB_STATES,
+    STATUS_FAIL,
+    STATUS_NEW,
+    STATUS_OK,
+    STATUS_RUNNING,
+    STATUS_STRINGS,
+    STATUS_SUSPENDED,
+    Trials,
+    trials_from_docs,
+)
+from .exceptions import (  # noqa: F401
+    AllTrialsFailed,
+    DuplicateLabel,
+    HyperoptTpuError,
+    InvalidTrial,
+)
+from .fmin import (  # noqa: F401
+    FMinIter,
+    fmin,
+    generate_trials_to_calculate,
+    partial,
+    space_eval,
+)
+from .space import CompiledSpace, compile_space  # noqa: F401
+from .utils.early_stop import no_progress_loss  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "fmin", "FMinIter", "space_eval", "generate_trials_to_calculate",
+    "partial", "hp", "tpe", "rand", "anneal", "mix", "atpe",
+    "Trials", "trials_from_docs", "Domain", "Ctrl",
+    "CompiledSpace", "compile_space", "no_progress_loss",
+    "STATUS_NEW", "STATUS_RUNNING", "STATUS_SUSPENDED", "STATUS_OK",
+    "STATUS_FAIL", "STATUS_STRINGS",
+    "JOB_STATE_NEW", "JOB_STATE_RUNNING", "JOB_STATE_DONE",
+    "JOB_STATE_ERROR", "JOB_STATE_CANCEL", "JOB_STATES",
+    "AllTrialsFailed", "DuplicateLabel", "HyperoptTpuError", "InvalidTrial",
+]

@@ -1,0 +1,33 @@
+"""Exception types.
+
+Reference parity: ``hyperopt/exceptions.py`` (AllTrialsFailed, InvalidTrial,
+DuplicateLabel; mount was empty — anchors per SURVEY.md §2).
+"""
+
+
+class HyperoptTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class AllTrialsFailed(HyperoptTpuError):
+    """Raised when ``fmin`` finishes without a single successful trial."""
+
+
+class InvalidTrial(HyperoptTpuError):
+    """A trial document failed schema validation."""
+
+
+class InvalidResultStatus(HyperoptTpuError):
+    """Objective returned a result dict with an unknown ``status``."""
+
+
+class InvalidLoss(HyperoptTpuError):
+    """Objective returned a non-finite / non-float loss with status ok."""
+
+
+class DuplicateLabel(HyperoptTpuError):
+    """The same hyperparameter label was used twice in one search space."""
+
+
+class InvalidAnnotatedParameter(HyperoptTpuError):
+    """A search-space leaf is not a recognized hyperparameter expression."""

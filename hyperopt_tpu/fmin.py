@@ -1,0 +1,359 @@
+"""`fmin` — the optimization loop and public API.
+
+Reference: ``hyperopt/fmin.py`` (SURVEY.md §2 L5 — ``FMinIter`` ~L60-300,
+``fmin()`` ~L300-550, ``space_eval`` ~L560, ``generate_trials_to_calculate``
+~L580; mount was empty, anchors from upstream hyperopt).
+
+The plugin boundaries the north star requires are preserved exactly:
+
+* ``algo=`` — any callable ``suggest(new_ids, domain, trials, seed) -> docs``;
+  bind hyperparameters with ``functools.partial(tpe.suggest, gamma=...)``.
+* ``trials=`` — any :class:`~hyperopt_tpu.base.Trials` subclass; asynchronous
+  subclasses only get docs enqueued and are polled until the queue drains.
+"""
+
+from __future__ import annotations
+
+import logging
+import numbers
+import os
+import pickle
+import time
+from functools import partial  # re-exported for reference parity
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from . import base
+from .base import (
+    Ctrl,
+    Domain,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    STATUS_OK,
+    Trials,
+    coarse_utcnow,
+    miscs_update_idxs_vals,
+)
+from .exceptions import AllTrialsFailed
+from .space import compile_space
+from .utils.progress import default_callback, no_progress_callback
+
+logger = logging.getLogger(__name__)
+
+
+def space_eval(space, hp_assignment: dict):
+    """Substitute a ``{label: value}`` assignment into a search space.
+
+    Reference: ``hyperopt/fmin.py::space_eval``.  Accepts the dicts produced
+    by ``fmin(return_argmin=True)`` / ``trials.argmin`` (choice values are
+    branch indices) and returns the concrete nested configuration.
+    """
+    return compile_space(space).eval_point(hp_assignment)
+
+
+def generate_trials_to_calculate(points, exp_key=None):
+    """Seed a ``Trials`` with predetermined points to evaluate first.
+
+    Reference: ``hyperopt/fmin.py::generate_trials_to_calculate``.
+    ``points`` is a list of ``{label: value}`` dicts.
+    """
+    trials = Trials(exp_key=exp_key)
+    docs = []
+    for tid, pt in enumerate(points):
+        doc = base.new_trial_doc(tid, exp_key=exp_key)
+        doc["misc"]["idxs"] = {k: [tid] for k in pt}
+        doc["misc"]["vals"] = {k: [v] for k, v in pt.items()}
+        docs.append(doc)
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    return trials
+
+
+class FMinIter:
+    """The scheduler loop (reference: ``hyperopt/fmin.py::FMinIter``).
+
+    Iterating yields after each batch of completed trials; ``exhaust()`` runs
+    to ``max_evals``.  Synchronous trials are evaluated in-process by
+    ``serial_evaluate``; asynchronous trials are enqueued and polled.
+    """
+
+    catch_eval_exceptions = False
+    pickle_protocol = -1
+
+    def __init__(self, algo, domain, trials, rstate=None,
+                 early_stop_fn=None, trials_save_file="",
+                 asynchronous=None, max_queue_len=1,
+                 poll_interval_secs=0.1, max_evals=None,
+                 timeout=None, loss_threshold=None,
+                 show_progressbar=True, verbose=False):
+        self.algo = algo
+        self.domain = domain
+        self.trials = trials
+        if rstate is None:
+            rstate = np.random.default_rng()
+        self.rstate = rstate
+        self.early_stop_fn = early_stop_fn
+        self.early_stop_args: list = []
+        self.trials_save_file = trials_save_file
+        if asynchronous is None:
+            self.asynchronous = bool(getattr(trials, "asynchronous", False))
+        else:
+            self.asynchronous = asynchronous
+        self.max_queue_len = max_queue_len
+        self.poll_interval_secs = poll_interval_secs
+        self.max_evals = max_evals
+        self.timeout = timeout
+        self.loss_threshold = loss_threshold
+        self.start_time = time.time()
+        self.show_progressbar = show_progressbar
+        self.verbose = verbose
+
+    # -- evaluation ---------------------------------------------------------
+
+    def serial_evaluate(self, N=-1):
+        for trial in self.trials._dynamic_trials:
+            if trial["state"] != JOB_STATE_NEW:
+                continue
+            trial["state"] = JOB_STATE_RUNNING
+            trial["book_time"] = coarse_utcnow()
+            ctrl = Ctrl(self.trials, current_trial=trial)
+            try:
+                spec = base.spec_from_misc(trial["misc"])
+                result = self.domain.evaluate(spec, ctrl)
+            except Exception as e:
+                logger.error("job exception: %s", e)
+                trial["state"] = JOB_STATE_ERROR
+                trial["misc"]["error"] = (type(e).__name__, str(e))
+                trial["refresh_time"] = coarse_utcnow()
+                if not self.catch_eval_exceptions:
+                    self.trials.refresh()
+                    raise
+            else:
+                trial["state"] = JOB_STATE_DONE
+                trial["result"] = result
+                trial["refresh_time"] = coarse_utcnow()
+            N -= 1
+            if N == 0:
+                break
+        self.trials.refresh()
+
+    def block_until_done(self):
+        if self.asynchronous:
+            unfinished = (JOB_STATE_NEW, JOB_STATE_RUNNING)
+            while self.trials.count_by_state_unsynced(unfinished) > 0:
+                time.sleep(self.poll_interval_secs)
+                self.trials.refresh()
+        else:
+            self.serial_evaluate()
+
+    # -- loop ---------------------------------------------------------------
+
+    def _stopped(self, n_done):
+        if self.max_evals is not None and n_done >= self.max_evals:
+            return True
+        if self.timeout is not None and \
+                time.time() - self.start_time >= self.timeout:
+            return True
+        if self.loss_threshold is not None:
+            try:
+                if self.trials.best_trial["result"]["loss"] <= \
+                        self.loss_threshold:
+                    return True
+            except AllTrialsFailed:
+                pass
+        return False
+
+    def run_one_batch(self):
+        """Enqueue up to ``max_queue_len`` new trials and evaluate/poll once.
+
+        Returns True if the experiment should stop (algo exhausted or early
+        stop fired).
+        """
+        trials = self.trials
+        stopped = False
+
+        qlen = trials.count_by_state_unsynced((JOB_STATE_NEW,
+                                               JOB_STATE_RUNNING))
+        remaining = (self.max_evals - self.n_enqueued()
+                     if self.max_evals is not None else self.max_queue_len)
+        n_to_enqueue = min(self.max_queue_len - qlen, remaining)
+        if n_to_enqueue > 0:
+            seed = int(self.rstate.integers(2 ** 31 - 1))
+            new_ids = trials.new_trial_ids(n_to_enqueue)
+            trials.refresh()
+            new_trials = self.algo(new_ids, self.domain, trials, seed)
+            if new_trials is None or len(new_trials) == 0:
+                stopped = True
+            else:
+                trials.insert_trial_docs(new_trials)
+                trials.refresh()
+
+        if self.asynchronous:
+            time.sleep(self.poll_interval_secs)
+            trials.refresh()
+        else:
+            self.serial_evaluate()
+
+        self._save_trials()
+
+        if self.early_stop_fn is not None:
+            stop, kwargs = self.early_stop_fn(self.trials,
+                                              *self.early_stop_args)
+            self.early_stop_args = kwargs
+            if stop:
+                logger.info("early stop triggered")
+                stopped = True
+        return stopped
+
+    def n_done(self):
+        return self.trials.count_by_state_unsynced(
+            (JOB_STATE_DONE, JOB_STATE_ERROR))
+
+    def n_enqueued(self):
+        return self.trials.count_by_state_unsynced(
+            (JOB_STATE_NEW, JOB_STATE_RUNNING, JOB_STATE_DONE,
+             JOB_STATE_ERROR))
+
+    def _save_trials(self):
+        if self.trials_save_file:
+            with open(self.trials_save_file, "wb") as f:
+                pickle.dump(self.trials, f, protocol=self.pickle_protocol)
+
+    def run(self, N, block_until_done=True):
+        """Reference-compat: enqueue+evaluate ~N more trials."""
+        target = self.n_done() + N
+        saved_max = self.max_evals
+        self.max_evals = target if saved_max is None else min(saved_max, target)
+        try:
+            self._loop()
+        finally:
+            self.max_evals = saved_max
+        if block_until_done:
+            self.block_until_done()
+
+    def _loop(self):
+        progress_ctx = default_callback if self.show_progressbar \
+            else no_progress_callback
+        with progress_ctx(initial=self.n_done(), total=self.max_evals) as prog:
+            while not self._stopped(self.n_done()):
+                before = self.n_done()
+                stopped = self.run_one_batch()
+                after = self.n_done()
+                prog.update(after - before)
+                try:
+                    prog.postfix(self.trials.best_trial["result"]["loss"])
+                except AllTrialsFailed:
+                    pass
+                if stopped:
+                    break
+                if after == before and not self.asynchronous:
+                    break  # no forward progress possible
+        return self
+
+    def exhaust(self):
+        """Run until ``max_evals`` complete (or a stop condition fires)."""
+        self._loop()
+        self.block_until_done()
+        return self
+
+
+def fmin(fn, space, algo=None, max_evals=None,
+         timeout=None, loss_threshold=None,
+         trials=None, rstate=None,
+         allow_trials_fmin=True, pass_expr_memo_ctrl=None,
+         catch_eval_exceptions=False,
+         verbose=True, return_argmin=True,
+         points_to_evaluate=None, max_queue_len=1,
+         show_progressbar=True, early_stop_fn=None,
+         trials_save_file=""):
+    """Minimize ``fn`` over ``space`` using ``algo``.
+
+    Reference-parity signature: ``hyperopt/fmin.py::fmin`` (SURVEY.md §2 L5).
+
+    Parameters mirror the reference: ``fn`` objective (returns float loss or a
+    result dict with ``loss``/``status``), ``space`` an ``hp.*`` structure,
+    ``algo`` a suggest callable (default TPE), ``max_evals``, wall-clock
+    ``timeout`` (seconds), ``loss_threshold``, ``trials`` (plugin boundary),
+    ``rstate`` (``np.random.Generator``), ``points_to_evaluate`` (list of
+    ``{label: value}`` dicts run first), ``trials_save_file`` (pickle
+    checkpoint, auto-resume), ``early_stop_fn(trials, *args)->(stop, args)``,
+    ``return_argmin`` (return best point dict vs None).
+    """
+    if algo is None:
+        from . import tpe
+        algo = tpe.suggest
+
+    if rstate is None:
+        env_seed = os.environ.get("HYPEROPT_FMIN_SEED", "")
+        if env_seed:
+            rstate = np.random.default_rng(int(env_seed))
+        else:
+            rstate = np.random.default_rng()
+    elif isinstance(rstate, (int, np.integer)):
+        rstate = np.random.default_rng(int(rstate))
+
+    validate_timeout(timeout)
+    validate_loss_threshold(loss_threshold)
+
+    if trials_save_file and os.path.exists(trials_save_file) and trials is None:
+        with open(trials_save_file, "rb") as f:
+            trials = pickle.load(f)
+
+    if trials is None:
+        if points_to_evaluate is None:
+            trials = Trials()
+        else:
+            if not isinstance(points_to_evaluate, list):
+                raise ValueError("points_to_evaluate must be a list of dicts")
+            trials = generate_trials_to_calculate(points_to_evaluate)
+
+    if allow_trials_fmin and hasattr(trials, "fmin") and \
+            type(trials).fmin is not Trials.fmin:
+        # durable/async backends may implement their own fmin; delegate.
+        return trials.fmin(
+            fn, space, algo=algo, max_evals=max_evals, timeout=timeout,
+            loss_threshold=loss_threshold, rstate=rstate,
+            pass_expr_memo_ctrl=pass_expr_memo_ctrl,
+            verbose=verbose, catch_eval_exceptions=catch_eval_exceptions,
+            return_argmin=return_argmin, show_progressbar=show_progressbar,
+            early_stop_fn=early_stop_fn, trials_save_file=trials_save_file)
+
+    domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)
+
+    rval = FMinIter(algo, domain, trials, rstate=rstate,
+                    early_stop_fn=early_stop_fn,
+                    trials_save_file=trials_save_file,
+                    max_queue_len=max_queue_len,
+                    max_evals=max_evals, timeout=timeout,
+                    loss_threshold=loss_threshold,
+                    show_progressbar=show_progressbar and verbose,
+                    verbose=verbose)
+    rval.catch_eval_exceptions = catch_eval_exceptions
+    rval.exhaust()
+    rval._save_trials()
+
+    if return_argmin:
+        if len(trials.trials) == 0:
+            raise AllTrialsFailed(
+                f"There are no evaluation tasks, cannot return argmin of task losses.")
+        return trials.argmin
+    if len(trials) > 0:
+        return trials.best_trial["result"]["loss"]
+    return None
+
+
+def validate_timeout(timeout):
+    if timeout is not None and (not isinstance(timeout, numbers.Real)
+                                or timeout <= 0):
+        raise Exception(f"The timeout argument should be None or a positive "
+                        f"value. Given value: {timeout}")
+
+
+def validate_loss_threshold(loss_threshold):
+    if loss_threshold is not None and not isinstance(loss_threshold,
+                                                     numbers.Real):
+        raise Exception(f"The loss_threshold argument should be None or a "
+                        f"numeric value. Given value: {loss_threshold}")

@@ -1,0 +1,33 @@
+"""Random-search suggest algorithm.
+
+Reference: ``hyperopt/rand.py::suggest`` (SURVEY.md §2 L3): seed an RNG, draw
+one sample of the space per new trial id, package into trial docs.
+
+TPU-native: all ``len(new_ids)`` configurations are drawn in ONE jitted,
+batched device call via :meth:`CompiledSpace.sample` — no per-node graph
+interpretation.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from . import base
+
+
+def suggest(new_ids, domain, trials, seed):
+    """Uniform-prior sampling: the reference's random search."""
+    n = len(new_ids)
+    if n == 0:
+        return []
+    key = jax.random.key(int(seed) % (2 ** 32))
+    vals, active = domain.cs.sample(key, n)
+    return base.docs_from_samples(domain.cs, new_ids,
+                                  np.asarray(vals), np.asarray(active))
+
+
+def suggest_batch(new_ids, domain, trials, seed):
+    """Return raw (vals, active) arrays for ``new_ids`` without packaging."""
+    key = jax.random.key(int(seed) % (2 ** 32))
+    return domain.cs.sample(key, len(new_ids))

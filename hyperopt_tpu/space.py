@@ -1,0 +1,532 @@
+"""Search-space specification and its XLA compiler.
+
+This replaces the reference's ``pyll`` stochastic expression graph + interpreter
+(``hyperopt/pyll/base.py::rec_eval``, ``hyperopt/pyll/stochastic.py``,
+``hyperopt/vectorize.py::VectorizeHelper`` — anchors per SURVEY.md §2; the
+reference mount was empty, symbols cited from upstream hyperopt).
+
+Design (TPU-first, NOT a translation):
+
+* The reference *interprets* a graph of ``Apply`` nodes per call, and represents
+  N vectorized samples of a conditional space as ragged ``idxs``/``vals`` lists.
+  Ragged host-side interpretation is hostile to XLA, so here a space is
+  **compiled once** into a pure, shape-static sampler:
+
+      ``sample(key, n) -> (vals: f32[n, P], active: bool[n, P])``
+
+  Every one of the P scalar hyperparameters gets a dense column; parameters
+  sitting under an unchosen ``hp.choice`` branch are still drawn (negligible
+  wasted FLOPs) but masked out in ``active``.  Dense vals + boolean mask is the
+  MXU/VPU-friendly encoding of the reference's ragged idxs/vals.
+
+* Conditional structure is static: each parameter carries the full chain of
+  ``(choice_param_id, branch_index)`` conditions under which it is live, so
+  ``active`` is a handful of fused equality/AND ops.
+
+* Sampling is batched by *family*, not per-parameter: one ``uniform`` draw for
+  every uniform-family column, one ``normal`` draw for every normal-family
+  column and one Gumbel-argmax for every categorical column, followed by
+  vectorized affine/exp/round transforms.  A 100-dim space costs 3 RNG calls,
+  not 100.
+
+Distribution semantics mirror ``hyperopt/pyll/stochastic.py`` (SURVEY.md §2):
+uniform, loguniform, quniform, qloguniform, normal, lognormal, qnormal,
+qlognormal, randint, uniformint, categorical (choice / pchoice).
+Quantized variants compute ``round(x / q) * q`` like the reference.
+"""
+
+from __future__ import annotations
+
+
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .exceptions import DuplicateLabel, InvalidAnnotatedParameter
+
+# ---------------------------------------------------------------------------
+# DSL expression nodes (what hp.* constructors build, what users nest in
+# dicts / lists / tuples)
+# ---------------------------------------------------------------------------
+
+# Distribution kind tags.
+UNIFORM = "uniform"
+LOGUNIFORM = "loguniform"
+QUNIFORM = "quniform"
+QLOGUNIFORM = "qloguniform"
+NORMAL = "normal"
+LOGNORMAL = "lognormal"
+QNORMAL = "qnormal"
+QLOGNORMAL = "qlognormal"
+RANDINT = "randint"
+UNIFORMINT = "uniformint"
+CATEGORICAL = "categorical"
+
+# Families used by the batched sampler / TPE posterior builder.
+_UNIFORM_FAMILY = (UNIFORM, LOGUNIFORM, QUNIFORM, QLOGUNIFORM, UNIFORMINT)
+_NORMAL_FAMILY = (NORMAL, LOGNORMAL, QNORMAL, QLOGNORMAL)
+_INT_KINDS = (RANDINT, UNIFORMINT, CATEGORICAL)
+_LOG_KINDS = (LOGUNIFORM, QLOGUNIFORM, LOGNORMAL, QLOGNORMAL)
+_Q_KINDS = (QUNIFORM, QLOGUNIFORM, QNORMAL, QLOGNORMAL)
+
+
+# Widest hp.randint range representable exactly in the f32 vals matrix.
+_MAX_RANDINT_RANGE = 2 ** 24
+# Above this many options a randint is sampled by integer draw instead of
+# materialized per-option logits (dense logits are what TPE's categorical
+# posterior consumes; wide randints use the quantized-continuous posterior).
+_DENSE_CAT_MAX = 1024
+
+
+class Expr:
+    """Base class for search-space leaf expressions built by ``hp.*``."""
+
+    __slots__ = ()
+
+
+class Param(Expr):
+    """A single scalar hyperparameter with a named prior distribution.
+
+    Mirrors the reference's ``scope.hyperopt_param(label, dist(...))`` wrapper
+    (``hyperopt/pyll_utils.py`` — SURVEY.md §2): the label travels with the node.
+    """
+
+    __slots__ = ("label", "kind", "low", "high", "mu", "sigma", "q", "probs")
+
+    def __init__(self, label, kind, low=None, high=None, mu=None, sigma=None,
+                 q=None, probs=None):
+        if not isinstance(label, str):
+            raise TypeError(f"hyperparameter label must be a str, got {label!r}")
+        self.label = label
+        self.kind = kind
+        self.low = low
+        self.high = high
+        self.mu = mu
+        self.sigma = sigma
+        self.q = q
+        self.probs = probs
+
+    def __repr__(self):
+        return f"Param({self.label!r}, {self.kind})"
+
+
+class Choice(Expr):
+    """``hp.choice`` / ``hp.pchoice``: a categorical index selecting one of
+    several sub-spaces.  The index itself is a :class:`Param` of kind
+    ``categorical``; the options may contain further nested expressions.
+    """
+
+    __slots__ = ("label", "options", "probs")
+
+    def __init__(self, label, options, probs=None):
+        if not isinstance(label, str):
+            raise TypeError(f"hyperparameter label must be a str, got {label!r}")
+        options = list(options)
+        if len(options) == 0:
+            raise ValueError(f"hp.choice({label!r}): needs at least one option")
+        if probs is not None:
+            probs = [float(p) for p in probs]
+            if len(probs) != len(options):
+                raise ValueError(
+                    f"hp.pchoice({label!r}): {len(probs)} probabilities for "
+                    f"{len(options)} options")
+            total = sum(probs)
+            if not np.isclose(total, 1.0, atol=1e-3):
+                raise ValueError(
+                    f"hp.pchoice({label!r}): probabilities sum to {total}, not 1")
+            probs = [p / total for p in probs]
+        self.label = label
+        self.options = options
+        self.probs = probs
+
+    def __repr__(self):
+        return f"Choice({self.label!r}, {len(self.options)} options)"
+
+
+# ---------------------------------------------------------------------------
+# Compiled representation
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ParamSpec:
+    """Flat compile-time record for one scalar hyperparameter column."""
+
+    pid: int
+    label: str
+    kind: str
+    # Distribution parameters (None where not applicable).
+    low: Optional[float] = None
+    high: Optional[float] = None
+    mu: Optional[float] = None
+    sigma: Optional[float] = None
+    q: Optional[float] = None
+    # Categorical: prior probabilities (uniform for randint / plain choice).
+    probs: Optional[tuple] = None
+    n_options: int = 0
+    # Conjunction of (choice pid, branch index) conditions under which this
+    # parameter is live.  Empty tuple = unconditional.
+    conditions: tuple = ()
+
+    @property
+    def is_int(self) -> bool:
+        return self.kind in _INT_KINDS
+
+    @property
+    def is_log(self) -> bool:
+        return self.kind in _LOG_KINDS
+
+    @property
+    def is_categorical_like(self) -> bool:
+        return self.kind in (RANDINT, CATEGORICAL)
+
+
+# Template node tags (host-side nested-structure reconstruction).
+_T_LITERAL = 0
+_T_PARAM = 1
+_T_CHOICE = 2
+_T_DICT = 3
+_T_LIST = 4
+_T_TUPLE = 5
+
+
+class CompiledSpace:
+    """A search space compiled to a batched XLA sampler + host decoder.
+
+    Public surface:
+
+    * ``sample(key, n)`` -> ``(vals f32[n, P], active bool[n, P])`` (jitted)
+    * ``decode_row(vals_row, active_row)`` -> the nested config the user's
+      objective receives (reference: ``Domain.memo_from_config`` +
+      ``pyll.rec_eval`` substitution, SURVEY.md §3.3)
+    * ``eval_point(point_dict)`` -> same, from a ``{label: value}`` dict
+      (reference: ``hyperopt/fmin.py::space_eval``)
+    * ``params`` — ordered list of :class:`ParamSpec`
+    """
+
+    def __init__(self, space):
+        self._labels_seen = {}
+        self.params: list[ParamSpec] = []
+        self._mutable_specs = []  # build buffer
+        self.template = self._build(space, conditions=())
+        self.params = self._mutable_specs
+        del self._mutable_specs
+        self.n_params = len(self.params)
+        self.by_label = {p.label: p for p in self.params}
+        self._sampler_cache = {}
+        self._build_groups()
+
+    # -- compile-time walk --------------------------------------------------
+
+    def _add_param(self, node: Param, conditions) -> int:
+        if node.label in self._labels_seen:
+            raise DuplicateLabel(
+                f"label {node.label!r} used more than once in the search space")
+        pid = len(self._mutable_specs)
+        self._labels_seen[node.label] = pid
+        kw = dict(pid=pid, label=node.label, kind=node.kind,
+                  conditions=tuple(conditions))
+        if node.kind == CATEGORICAL:
+            probs = node.probs
+            n = len(probs)
+            kw.update(probs=tuple(float(p) for p in probs), n_options=n)
+        elif node.kind == RANDINT:
+            low = int(node.low)
+            high = int(node.high)
+            n = high - low
+            if n <= 0:
+                raise ValueError(
+                    f"hp.randint({node.label!r}): empty range [{low}, {high})")
+            if n > _MAX_RANDINT_RANGE:
+                # Values are stored in an f32 SoA matrix on device; integers
+                # above 2**24 would silently lose precision.  Ranges this wide
+                # are seed-search idioms where model-based suggest carries no
+                # information anyway — reject loudly rather than corrupt.
+                raise ValueError(
+                    f"hp.randint({node.label!r}): range {n} exceeds "
+                    f"{_MAX_RANDINT_RANGE} (f32-exact integer limit); use "
+                    f"hp.quniform or shrink the range")
+            probs = tuple([1.0 / n] * n) if n <= _DENSE_CAT_MAX else None
+            kw.update(low=float(low), high=float(high), probs=probs,
+                      n_options=n)
+        else:
+            if node.kind in _UNIFORM_FAMILY:
+                low, high = float(node.low), float(node.high)
+                if not low < high:
+                    raise ValueError(
+                        f"hp.{node.kind}({node.label!r}): low {low} >= high {high}")
+                # For log kinds the bounds are in log space (reference DSL:
+                # loguniform(label, low, high) draws exp(uniform(low, high))).
+                kw.update(low=low, high=high)
+            else:
+                kw.update(mu=float(node.mu), sigma=float(node.sigma))
+            if node.kind in _Q_KINDS or node.kind == UNIFORMINT:
+                q = 1.0 if node.kind == UNIFORMINT else float(node.q)
+                if q <= 0:
+                    raise ValueError(f"hp.{node.kind}({node.label!r}): q must be > 0")
+                kw.update(q=q)
+        self._mutable_specs.append(ParamSpec(**kw))
+        return pid
+
+    def _build(self, node, conditions):
+        """Walk the nested structure, returning a template tree."""
+        if isinstance(node, Choice):
+            probs = node.probs or [1.0 / len(node.options)] * len(node.options)
+            idx_param = Param(node.label, CATEGORICAL, probs=probs)
+            pid = self._add_param(idx_param, conditions)
+            branches = []
+            for b, opt in enumerate(node.options):
+                branches.append(
+                    self._build(opt, conditions + ((pid, b),)))
+            return (_T_CHOICE, pid, tuple(branches))
+        if isinstance(node, Param):
+            pid = self._add_param(node, conditions)
+            return (_T_PARAM, pid)
+        if isinstance(node, dict):
+            items = tuple(
+                (k, self._build(v, conditions)) for k, v in node.items())
+            return (_T_DICT, items)
+        if isinstance(node, list):
+            return (_T_LIST, tuple(self._build(v, conditions) for v in node))
+        if isinstance(node, tuple):
+            return (_T_TUPLE, tuple(self._build(v, conditions) for v in node))
+        if isinstance(node, Expr):
+            raise InvalidAnnotatedParameter(f"unknown expression node {node!r}")
+        # Plain literal (int, float, str, None, np scalar, ...).
+        return (_T_LITERAL, node)
+
+    # -- sampler compilation ------------------------------------------------
+
+    def _build_groups(self):
+        """Partition params into batched sampling groups; precompute constants."""
+        uf, nf, cat, wide = [], [], [], []
+        for p in self.params:
+            if p.kind == CATEGORICAL or (p.kind == RANDINT and
+                                         p.probs is not None):
+                cat.append(p)
+            elif p.kind == RANDINT:
+                wide.append(p)  # integer draw, no per-option logits
+            elif p.kind in _UNIFORM_FAMILY:
+                uf.append(p)
+            else:
+                nf.append(p)
+        self._uf, self._nf, self._cat, self._wide = uf, nf, cat, wide
+
+        def f32(xs):
+            return np.asarray(xs, dtype=np.float32)
+
+        # Uniform family: draw u~U[0,1), x = a + (b-a)u in "fit space"
+        # (log space for loguniform/qloguniform), then exp / round / clip.
+        self._uf_a = f32([p.low if p.kind != UNIFORMINT else p.low - 0.5
+                          for p in uf])
+        self._uf_b = f32([p.high if p.kind != UNIFORMINT else p.high + 0.5
+                          for p in uf])
+        self._uf_log = np.asarray([p.is_log for p in uf], dtype=bool)
+        self._uf_q = f32([p.q if p.q else 0.0 for p in uf])
+        # uniformint draws quniform(q=1) over [low-0.5, high+0.5] like the
+        # reference (hyperopt/pyll_utils.py::hp_uniformint), then clips.
+        self._uf_clip_lo = f32([p.low if p.kind == UNIFORMINT else -np.inf
+                                for p in uf])
+        self._uf_clip_hi = f32([p.high if p.kind == UNIFORMINT else np.inf
+                                for p in uf])
+
+        self._nf_mu = f32([p.mu for p in nf])
+        self._nf_sigma = f32([p.sigma for p in nf])
+        self._nf_log = np.asarray([p.is_log for p in nf], dtype=bool)
+        self._nf_q = f32([p.q if p.q else 0.0 for p in nf])
+
+        kmax = max([p.n_options for p in cat], default=1)
+        self.cat_kmax = kmax
+        logits = np.full((len(cat), kmax), -np.inf, dtype=np.float32)
+        for i, p in enumerate(cat):
+            logits[i, : p.n_options] = np.log(np.asarray(p.probs))
+        self._cat_logits = logits
+        self._cat_offset = f32([p.low if p.kind == RANDINT else 0.0 for p in cat])
+
+        self._wide_low = np.asarray([int(p.low) for p in wide], dtype=np.int32)
+        self._wide_high = np.asarray([int(p.high) for p in wide], dtype=np.int32)
+
+        # Column permutation: concat(uf, nf, cat, wide) order -> pid order.
+        order = ([p.pid for p in uf] + [p.pid for p in nf]
+                 + [p.pid for p in cat] + [p.pid for p in wide])
+        self._inv_perm = np.argsort(np.asarray(order, dtype=np.int64)) \
+            if order else np.zeros(0, dtype=np.int64)
+
+        # Conditions, flattened for the mask computation.
+        self._cond_by_pid = [p.conditions for p in self.params]
+
+    def sample_traced(self, key, n: int):
+        """Draw ``n`` configurations; traceable inside jit (n static).
+
+        Returns ``(vals f32[n, P], active bool[n, P])``.
+        """
+        cols = []
+        k_u, k_n, k_c, k_w = jax.random.split(key, 4)
+        if self._uf:
+            u = jax.random.uniform(k_u, (n, len(self._uf)), dtype=jnp.float32)
+            x = self._uf_a + (self._uf_b - self._uf_a) * u
+            x = jnp.where(self._uf_log, jnp.exp(x), x)
+            x = jnp.where(self._uf_q > 0,
+                          jnp.round(x / jnp.where(self._uf_q > 0, self._uf_q, 1.0))
+                          * self._uf_q, x)
+            x = jnp.clip(x, self._uf_clip_lo, self._uf_clip_hi)
+            cols.append(x)
+        if self._nf:
+            z = jax.random.normal(k_n, (n, len(self._nf)), dtype=jnp.float32)
+            x = self._nf_mu + self._nf_sigma * z
+            x = jnp.where(self._nf_log, jnp.exp(x), x)
+            x = jnp.where(self._nf_q > 0,
+                          jnp.round(x / jnp.where(self._nf_q > 0, self._nf_q, 1.0))
+                          * self._nf_q, x)
+            cols.append(x)
+        if self._cat:
+            g = jax.random.gumbel(
+                k_c, (n, len(self._cat), self.cat_kmax), dtype=jnp.float32)
+            idx = jnp.argmax(self._cat_logits[None, :, :] + g, axis=-1)
+            cols.append(self._cat_offset + idx.astype(jnp.float32))
+        if self._wide:
+            w = jax.random.randint(
+                k_w, (n, len(self._wide)), self._wide_low, self._wide_high)
+            cols.append(w.astype(jnp.float32))
+        if cols:
+            vals = jnp.concatenate(cols, axis=1)[:, self._inv_perm]
+        else:
+            vals = jnp.zeros((n, 0), dtype=jnp.float32)
+        active = self.active_mask(vals)
+        return vals, active
+
+    def active_mask(self, vals):
+        """bool[n, P] liveness mask from the categorical columns of ``vals``."""
+        n = vals.shape[0]
+        masks = []
+        for pid, conds in enumerate(self._cond_by_pid):
+            if not conds:
+                masks.append(jnp.ones((n,), dtype=bool))
+            else:
+                m = jnp.ones((n,), dtype=bool)
+                for cpid, branch in conds:
+                    m = m & (vals[:, cpid] == branch)
+                masks.append(m)
+        if not masks:
+            return jnp.zeros((n, 0), dtype=bool)
+        return jnp.stack(masks, axis=1)
+
+    def _jitted_sampler(self, n: int):
+        fn = self._sampler_cache.get(n)
+        if fn is None:
+            fn = jax.jit(lambda key: self.sample_traced(key, n))
+            self._sampler_cache[n] = fn
+        return fn
+
+    def sample(self, key, n: int):
+        """Jitted entry point: draw n configurations."""
+        return self._jitted_sampler(int(n))(key)
+
+    # -- host-side decoding -------------------------------------------------
+
+    def _param_value(self, spec: ParamSpec, raw) -> Any:
+        if spec.kind == CATEGORICAL:
+            return int(raw)
+        if spec.kind in (RANDINT, UNIFORMINT):
+            return int(raw)
+        return float(raw)
+
+    def decode_row(self, vals_row, active_row=None):
+        """Reconstruct the nested user config from one sample row."""
+        vals_row = np.asarray(vals_row)
+
+        def rec(t):
+            tag = t[0]
+            if tag == _T_LITERAL:
+                return t[1]
+            if tag == _T_PARAM:
+                spec = self.params[t[1]]
+                return self._param_value(spec, vals_row[t[1]])
+            if tag == _T_CHOICE:
+                idx = int(vals_row[t[1]])
+                return rec(t[2][idx])
+            if tag == _T_DICT:
+                return {k: rec(v) for k, v in t[1]}
+            if tag == _T_LIST:
+                return [rec(v) for v in t[1]]
+            if tag == _T_TUPLE:
+                return tuple(rec(v) for v in t[1])
+            raise AssertionError(tag)
+
+        return rec(self.template)
+
+    def eval_point(self, point: dict):
+        """``space_eval``: substitute a ``{label: value}`` assignment.
+
+        Accepts values only for parameters on the active path (like the
+        reference's ``space_eval``); inactive labels may be present or absent.
+        Values may be scalars or length-1 sequences (trials ``vals`` style).
+        """
+
+        def get(label):
+            v = point[label]
+            if isinstance(v, (list, tuple, np.ndarray)):
+                if len(v) == 0:
+                    raise KeyError(label)
+                v = v[0]
+            return v
+
+        def rec(t):
+            tag = t[0]
+            if tag == _T_LITERAL:
+                return t[1]
+            if tag == _T_PARAM:
+                spec = self.params[t[1]]
+                return self._param_value(spec, get(spec.label))
+            if tag == _T_CHOICE:
+                spec = self.params[t[1]]
+                idx = int(get(spec.label))
+                return rec(t[2][idx])
+            if tag == _T_DICT:
+                return {k: rec(v) for k, v in t[1]}
+            if tag == _T_LIST:
+                return [rec(v) for v in t[1]]
+            if tag == _T_TUPLE:
+                return tuple(rec(v) for v in t[1])
+            raise AssertionError(tag)
+
+        return rec(self.template)
+
+    # -- misc ---------------------------------------------------------------
+
+    def active_path_pids(self, point: dict):
+        """pids of parameters live under assignment ``point`` (host-side)."""
+        out = []
+
+        def ok(spec):
+            for cpid, branch in spec.conditions:
+                clabel = self.params[cpid].label
+                if clabel not in point:
+                    return False
+                v = point[clabel]
+                if isinstance(v, (list, tuple, np.ndarray)):
+                    if len(v) == 0:
+                        return False
+                    v = v[0]
+                if int(v) != branch:
+                    return False
+            return True
+
+        for spec in self.params:
+            if ok(spec):
+                out.append(spec.pid)
+        return out
+
+    def __repr__(self):
+        return (f"CompiledSpace(P={self.n_params}, "
+                f"uf={len(self._uf)}, nf={len(self._nf)}, cat={len(self._cat)})")
+
+
+def compile_space(space) -> CompiledSpace:
+    """Compile a nested ``hp.*`` structure into a :class:`CompiledSpace`."""
+    if isinstance(space, CompiledSpace):
+        return space
+    return CompiledSpace(space)

@@ -1,0 +1,29 @@
+"""Auxiliary utilities (progress, early stopping, plotting, graphviz, rdists).
+
+Reference: ``hyperopt/early_stop.py``, ``progress.py``, ``plotting.py``,
+``graphviz.py``, ``rdists.py``, ``utils.py`` (SURVEY.md §2 L7).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def fast_isin(X, X_all):
+    """Boolean membership of X in X_all (reference: hyperopt/utils.py::fast_isin)."""
+    return np.isin(X, X_all)
+
+
+def get_most_recent_inds(obj):
+    """Indices of the newest version of each tid (reference:
+    hyperopt/utils.py::get_most_recent_inds — dedupe refreshed docs by
+    (tid, version))."""
+    data = np.rec.fromarrays(
+        [np.asarray([d["tid"] for d in obj]),
+         np.asarray([d.get("version", 0) for d in obj])],
+        names=["tid", "version"])
+    order = np.argsort(data, order=["tid", "version"])
+    sorted_data = data[order]
+    keep = np.ones(len(obj), dtype=bool)
+    keep[:-1] = sorted_data["tid"][1:] != sorted_data["tid"][:-1]
+    return order[keep]

@@ -1,0 +1,61 @@
+"""Progress reporting for the fmin loop.
+
+Reference: ``hyperopt/progress.py`` + ``std_out_err_redirect_tqdm.py``
+(SURVEY.md §2 L7): a tqdm bar with ``best loss:`` postfix, and a no-op
+variant.  tqdm is optional; without it progress reporting is a silent no-op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+
+try:
+    from tqdm import tqdm as _tqdm
+except Exception:  # pragma: no cover - tqdm is normally present
+    _tqdm = None
+
+
+class _ProgressHandle:
+    def update(self, n):
+        raise NotImplementedError
+
+    def postfix(self, best_loss):
+        raise NotImplementedError
+
+
+class _TqdmHandle(_ProgressHandle):
+    def __init__(self, bar):
+        self.bar = bar
+
+    def update(self, n):
+        if n > 0:
+            self.bar.update(n)
+
+    def postfix(self, best_loss):
+        self.bar.set_postfix_str(f"best loss: {best_loss:.6g}")
+
+
+class _NullHandle(_ProgressHandle):
+    def update(self, n):
+        pass
+
+    def postfix(self, best_loss):
+        pass
+
+
+@contextlib.contextmanager
+def default_callback(initial=0, total=None):
+    """tqdm progress context (reference: progress.py::default_callback)."""
+    if _tqdm is None:
+        yield _NullHandle()
+        return
+    with _tqdm(initial=initial, total=total, file=sys.stderr,
+               dynamic_ncols=True, disable=not sys.stderr.isatty()) as bar:
+        yield _TqdmHandle(bar)
+
+
+@contextlib.contextmanager
+def no_progress_callback(initial=0, total=None):
+    """Silent progress context (reference: progress.py::no_progress_callback)."""
+    yield _NullHandle()

@@ -275,7 +275,9 @@ class Trials:
             else:
                 self._trials = [t for t in self._dynamic_trials
                                 if t["exp_key"] == self._exp_key]
-            self._soa_cache = None
+            # _soa_cache is NOT cleared here: history() revalidates it by
+            # tid-prefix comparison, keeping rebuilds incremental. DONE-trial
+            # results are written exactly once, so the prefix cannot go stale.
 
     def insert_trial_doc(self, doc):
         return self.insert_trial_docs([doc])[0]
@@ -334,10 +336,15 @@ class Trials:
         return [r.get("status") for r in self.results]
 
     @property
+    def exp_key(self):
+        return self._exp_key
+
+    @property
     def best_trial(self):
         candidates = [
             t for t in self._trials
-            if t["result"].get("status") == STATUS_OK
+            if t["state"] == JOB_STATE_DONE
+            and t["result"].get("status") == STATUS_OK
             and t["result"].get("loss") is not None
         ]
         if not candidates:
@@ -397,17 +404,33 @@ class Trials:
         Cached until the next ``refresh()``.
         """
         with self._lock:
-            if self._soa_cache is not None and self._soa_cache[0] is cs:
-                return self._soa_cache[1]
             done = [t for t in self._trials if t["state"] == JOB_STATE_DONE]
             n, p = len(done), cs.n_params
+            new_tids = np.asarray([t["tid"] for t in done], dtype=np.int64)
+            # Incremental: trials are append-only in practice, so if the cached
+            # prefix still matches we only parse the newly-completed suffix
+            # (keeps total host-side work O(N*P) over a run, not O(N^2*P)).
+            start = 0
+            if (self._soa_cache is not None and self._soa_cache[0] is cs
+                    and len(self._soa_cache[1]["tids"]) <= n
+                    and np.array_equal(
+                        self._soa_cache[1]["tids"],
+                        new_tids[: len(self._soa_cache[1]["tids"])])):
+                old = self._soa_cache[1]
+                start = len(old["tids"])
+                if start == n:
+                    return old
             vals = np.zeros((n, p), dtype=np.float32)
             active = np.zeros((n, p), dtype=bool)
             loss = np.full((n,), np.inf, dtype=np.float32)
             ok = np.zeros((n,), dtype=bool)
-            tids = np.zeros((n,), dtype=np.int64)
-            for i, t in enumerate(done):
-                tids[i] = t["tid"]
+            if start:
+                vals[:start] = old["vals"]
+                active[:start] = old["active"]
+                loss[:start] = old["loss"]
+                ok[:start] = old["ok"]
+            for i in range(start, n):
+                t = done[i]
                 r = t["result"]
                 if r.get("status") == STATUS_OK and r.get("loss") is not None \
                         and np.isfinite(r["loss"]):
@@ -419,7 +442,8 @@ class Trials:
                     if len(v):
                         vals[i, spec.pid] = v[0]
                         active[i, spec.pid] = True
-            out = dict(vals=vals, active=active, loss=loss, ok=ok, tids=tids)
+            out = dict(vals=vals, active=active, loss=loss, ok=ok,
+                       tids=new_tids)
             self._soa_cache = (cs, out)
             return out
 

@@ -24,7 +24,8 @@ def suggest(new_ids, domain, trials, seed):
     key = jax.random.key(int(seed) % (2 ** 32))
     vals, active = domain.cs.sample(key, n)
     return base.docs_from_samples(domain.cs, new_ids,
-                                  np.asarray(vals), np.asarray(active))
+                                  np.asarray(vals), np.asarray(active),
+                                  exp_key=getattr(trials, "exp_key", None))
 
 
 def suggest_batch(new_ids, domain, trials, seed):

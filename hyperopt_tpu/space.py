@@ -133,6 +133,9 @@ class Choice(Expr):
                 raise ValueError(
                     f"hp.pchoice({label!r}): {len(probs)} probabilities for "
                     f"{len(options)} options")
+            if any(p < 0 for p in probs):
+                raise ValueError(
+                    f"hp.pchoice({label!r}): negative probability")
             total = sum(probs)
             if not np.isclose(total, 1.0, atol=1e-3):
                 raise ValueError(
@@ -182,6 +185,17 @@ class ParamSpec:
     @property
     def is_categorical_like(self) -> bool:
         return self.kind in (RANDINT, CATEGORICAL)
+
+
+def _point_value(point: dict, label: str):
+    """Scalar value of ``label`` in a point dict; unwraps length-1 sequences
+    (trials ``vals`` style); KeyError if absent or empty."""
+    v = point[label]
+    if isinstance(v, (list, tuple, np.ndarray)):
+        if len(v) == 0:
+            raise KeyError(label)
+        v = v[0]
+    return v
 
 
 # Template node tags (host-side nested-structure reconstruction).
@@ -434,9 +448,9 @@ class CompiledSpace:
             return int(raw)
         return float(raw)
 
-    def decode_row(self, vals_row, active_row=None):
-        """Reconstruct the nested user config from one sample row."""
-        vals_row = np.asarray(vals_row)
+    def _walk(self, getter):
+        """Reconstruct the nested user config; ``getter(pid)`` supplies the
+        raw value of each parameter reached along the active path."""
 
         def rec(t):
             tag = t[0]
@@ -444,9 +458,9 @@ class CompiledSpace:
                 return t[1]
             if tag == _T_PARAM:
                 spec = self.params[t[1]]
-                return self._param_value(spec, vals_row[t[1]])
+                return self._param_value(spec, getter(t[1]))
             if tag == _T_CHOICE:
-                idx = int(vals_row[t[1]])
+                idx = int(getter(t[1]))
                 return rec(t[2][idx])
             if tag == _T_DICT:
                 return {k: rec(v) for k, v in t[1]}
@@ -457,6 +471,11 @@ class CompiledSpace:
             raise AssertionError(tag)
 
         return rec(self.template)
+
+    def decode_row(self, vals_row, active_row=None):
+        """Reconstruct the nested user config from one sample row."""
+        vals_row = np.asarray(vals_row)
+        return self._walk(lambda pid: vals_row[pid])
 
     def eval_point(self, point: dict):
         """``space_eval``: substitute a ``{label: value}`` assignment.
@@ -465,35 +484,8 @@ class CompiledSpace:
         reference's ``space_eval``); inactive labels may be present or absent.
         Values may be scalars or length-1 sequences (trials ``vals`` style).
         """
-
-        def get(label):
-            v = point[label]
-            if isinstance(v, (list, tuple, np.ndarray)):
-                if len(v) == 0:
-                    raise KeyError(label)
-                v = v[0]
-            return v
-
-        def rec(t):
-            tag = t[0]
-            if tag == _T_LITERAL:
-                return t[1]
-            if tag == _T_PARAM:
-                spec = self.params[t[1]]
-                return self._param_value(spec, get(spec.label))
-            if tag == _T_CHOICE:
-                spec = self.params[t[1]]
-                idx = int(get(spec.label))
-                return rec(t[2][idx])
-            if tag == _T_DICT:
-                return {k: rec(v) for k, v in t[1]}
-            if tag == _T_LIST:
-                return [rec(v) for v in t[1]]
-            if tag == _T_TUPLE:
-                return tuple(rec(v) for v in t[1])
-            raise AssertionError(tag)
-
-        return rec(self.template)
+        return self._walk(lambda pid: _point_value(point,
+                                                   self.params[pid].label))
 
     # -- misc ---------------------------------------------------------------
 
@@ -503,14 +495,10 @@ class CompiledSpace:
 
         def ok(spec):
             for cpid, branch in spec.conditions:
-                clabel = self.params[cpid].label
-                if clabel not in point:
+                try:
+                    v = _point_value(point, self.params[cpid].label)
+                except KeyError:
                     return False
-                v = point[clabel]
-                if isinstance(v, (list, tuple, np.ndarray)):
-                    if len(v) == 0:
-                        return False
-                    v = v[0]
                 if int(v) != branch:
                     return False
             return True

@@ -1,0 +1,26 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Tests exercise multi-device sharding (shard_map / pjit over a Mesh) without
+TPU slices by running on 8 virtual CPU devices, per the reference's norm of
+real-but-local backends (SURVEY.md §4: TempMongo spawns a real mongod; here a
+real XLA CPU client with 8 devices plays that role).
+
+This must run before the first ``import jax`` anywhere in the test session,
+which is why it lives at the top of conftest.py.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,233 @@
+"""Trials / Domain / trial-doc tests (reference: ``tests/test_base.py``,
+SURVEY.md §4: doc validation, state machine, idxs/vals round-trips, Ctrl)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import hyperopt_tpu as ht
+from hyperopt_tpu import base, hp
+from hyperopt_tpu.exceptions import AllTrialsFailed, InvalidTrial
+
+
+def _mk_doc(tid, loss=None, state=base.JOB_STATE_NEW, labels=("x",)):
+    doc = base.new_trial_doc(tid)
+    doc["misc"]["idxs"] = {k: [tid] for k in labels}
+    doc["misc"]["vals"] = {k: [float(tid)] for k in labels}
+    if loss is not None:
+        doc["result"] = {"loss": loss, "status": ht.STATUS_OK}
+        doc["state"] = base.JOB_STATE_DONE
+    else:
+        doc["state"] = state
+    return doc
+
+
+def test_validate_missing_key():
+    doc = _mk_doc(0)
+    del doc["misc"]["cmd"]
+    with pytest.raises(InvalidTrial):
+        base.validate_trial_docs([doc])
+
+
+def test_validate_tid_mismatch():
+    doc = _mk_doc(0)
+    doc["misc"]["tid"] = 5
+    with pytest.raises(InvalidTrial):
+        base.validate_trial_docs([doc])
+
+
+def test_validate_idxs_vals_mismatch():
+    doc = _mk_doc(0)
+    doc["misc"]["idxs"]["x"] = [0, 1]
+    with pytest.raises(InvalidTrial):
+        base.validate_trial_docs([doc])
+
+
+def test_duplicate_tid_rejected():
+    t = ht.Trials()
+    t.insert_trial_docs([_mk_doc(0)])
+    with pytest.raises(InvalidTrial):
+        t.insert_trial_docs([_mk_doc(0)])
+
+
+def test_new_trial_ids_monotonic():
+    t = ht.Trials()
+    ids1 = t.new_trial_ids(3)
+    t.insert_trial_docs([_mk_doc(i) for i in ids1])
+    ids2 = t.new_trial_ids(2)
+    assert ids2[0] > max(ids1)
+    assert len(set(ids1 + ids2)) == 5
+
+
+def test_best_trial_and_argmin():
+    t = ht.Trials()
+    t.insert_trial_docs([_mk_doc(0, loss=3.0), _mk_doc(1, loss=1.0),
+                         _mk_doc(2, loss=2.0)])
+    t.refresh()
+    assert t.best_trial["tid"] == 1
+    assert t.argmin == {"x": 1.0}
+    assert t.losses() == [3.0, 1.0, 2.0]
+
+
+def test_best_trial_requires_done_state():
+    # regression: a checkpointed ok result on an ERROR/RUNNING trial must not
+    # win argmin.
+    t = ht.Trials()
+    good = _mk_doc(0, loss=1.0)
+    crashed = _mk_doc(1)
+    crashed["state"] = base.JOB_STATE_ERROR
+    crashed["result"] = {"loss": 0.0, "status": ht.STATUS_OK}
+    t.insert_trial_docs([good, crashed])
+    t.refresh()
+    assert t.best_trial["tid"] == 0
+
+
+def test_all_trials_failed():
+    t = ht.Trials()
+    with pytest.raises(AllTrialsFailed):
+        _ = t.best_trial
+
+
+def test_count_by_state():
+    t = ht.Trials()
+    t.insert_trial_docs([_mk_doc(0, loss=1.0), _mk_doc(1),
+                         _mk_doc(2, state=base.JOB_STATE_RUNNING)])
+    t.refresh()
+    assert t.count_by_state_synced(base.JOB_STATE_DONE) == 1
+    assert t.count_by_state_unsynced(
+        (base.JOB_STATE_NEW, base.JOB_STATE_RUNNING)) == 2
+
+
+def test_exp_key_filtering():
+    t = ht.Trials(exp_key="A")
+    doc_a = _mk_doc(0, loss=1.0)
+    doc_a["exp_key"] = "A"
+    doc_b = _mk_doc(1, loss=2.0)
+    doc_b["exp_key"] = "B"
+    t.insert_trial_docs([doc_a, doc_b])
+    t.refresh()
+    assert len(t) == 1 and t[0]["tid"] == 0
+
+
+def test_miscs_round_trip():
+    miscs = [{"tid": 0, "cmd": None, "idxs": {"x": [0], "y": []},
+              "vals": {"x": [1.5], "y": []}},
+             {"tid": 1, "cmd": None, "idxs": {"x": [1], "y": [1]},
+              "vals": {"x": [2.5], "y": [7.0]}}]
+    idxs, vals = base.miscs_to_idxs_vals(miscs)
+    assert idxs == {"x": [0, 1], "y": [1]}
+    assert vals == {"x": [1.5, 2.5], "y": [7.0]}
+    blank = [{"tid": 0, "cmd": None, "idxs": {}, "vals": {}},
+             {"tid": 1, "cmd": None, "idxs": {}, "vals": {}}]
+    base.miscs_update_idxs_vals(blank, idxs, vals)
+    assert blank[0]["vals"] == {"x": [1.5], "y": []}
+    assert blank[1]["vals"] == {"x": [2.5], "y": [7.0]}
+
+
+def test_spec_from_misc_skips_inactive():
+    misc = {"tid": 0, "cmd": None, "idxs": {"x": [0], "y": []},
+            "vals": {"x": [2.0], "y": []}}
+    assert base.spec_from_misc(misc) == {"x": 2.0}
+
+
+def test_trials_pickle_round_trip():
+    t = ht.Trials()
+    t.insert_trial_docs([_mk_doc(0, loss=1.5)])
+    t.refresh()
+    t2 = pickle.loads(pickle.dumps(t))
+    assert t2.best_trial["result"]["loss"] == 1.5
+    t2.insert_trial_docs([_mk_doc(1, loss=0.5)])  # still usable (lock rebuilt)
+    t2.refresh()
+    assert t2.best_trial["tid"] == 1
+
+
+def test_attachments():
+    t = ht.Trials()
+    doc = _mk_doc(0, loss=1.0)
+    t.insert_trial_docs([doc])
+    t.refresh()
+    att = t.trial_attachments(t[0])
+    att["blob"] = b"123"
+    assert "blob" in att and att["blob"] == b"123"
+    del att["blob"]
+    assert "blob" not in att
+
+
+def test_history_soa():
+    space = {"c": hp.choice("c", [{"x": hp.uniform("x", 0, 1)},
+                                  {"y": hp.uniform("y", 0, 1)}])}
+    cs = ht.compile_space(space)
+    t = ht.Trials()
+    d0 = base.new_trial_doc(0)
+    d0["misc"]["idxs"] = {"c": [0], "x": [0], "y": []}
+    d0["misc"]["vals"] = {"c": [0], "x": [0.25], "y": []}
+    d0["result"] = {"loss": 0.5, "status": ht.STATUS_OK}
+    d0["state"] = base.JOB_STATE_DONE
+    d1 = base.new_trial_doc(1)
+    d1["misc"]["idxs"] = {"c": [1], "x": [], "y": [1]}
+    d1["misc"]["vals"] = {"c": [1], "x": [], "y": [0.75]}
+    d1["result"] = {"status": ht.STATUS_FAIL}
+    d1["state"] = base.JOB_STATE_DONE
+    t.insert_trial_docs([d0, d1])
+    t.refresh()
+    h = t.history(cs)
+    assert h["vals"].shape == (2, 3)
+    px, py, pc = (cs.by_label["x"].pid, cs.by_label["y"].pid,
+                  cs.by_label["c"].pid)
+    assert h["vals"][0, px] == np.float32(0.25)
+    assert h["active"][0, px] and not h["active"][0, py]
+    assert h["active"][1, py] and not h["active"][1, px]
+    assert h["ok"][0] and not h["ok"][1]
+    assert h["loss"][0] == np.float32(0.5) and np.isinf(h["loss"][1])
+    # cache invalidation on refresh
+    assert t.history(cs) is h
+    t.insert_trial_docs([_mk_doc(2, loss=1.0, labels=("c",))])
+    t.refresh()
+    assert t.history(cs)["vals"].shape[0] == 3
+
+
+def test_domain_evaluate_normalization():
+    d = ht.Domain(lambda cfg: cfg["x"] * 2, {"x": hp.uniform("x", 0, 1)})
+    out = d.evaluate({"x": 0.5}, None)
+    assert out == {"loss": 1.0, "status": ht.STATUS_OK}
+    d2 = ht.Domain(lambda cfg: {"loss": 1.0, "status": ht.STATUS_OK,
+                                "extra": "kept"},
+                   {"x": hp.uniform("x", 0, 1)})
+    out2 = d2.evaluate({"x": 0.5}, None)
+    assert out2["extra"] == "kept"
+
+
+def test_domain_evaluate_bad_status():
+    d = ht.Domain(lambda cfg: {"status": "bogus"},
+                  {"x": hp.uniform("x", 0, 1)})
+    with pytest.raises(ht.exceptions.InvalidResultStatus):
+        d.evaluate({"x": 0.5}, None)
+
+
+def test_domain_evaluate_nonfinite_loss():
+    d = ht.Domain(lambda cfg: float("nan"), {"x": hp.uniform("x", 0, 1)})
+    with pytest.raises(ht.exceptions.InvalidLoss):
+        d.evaluate({"x": 0.5}, None)
+
+
+def test_domain_attachments_via_ctrl():
+    def fn(cfg):
+        return {"loss": 0.0, "status": ht.STATUS_OK,
+                "attachments": {"model": b"weights"}}
+
+    t = ht.Trials()
+    doc = _mk_doc(0)
+    t.insert_trial_docs([doc])
+    t.refresh()
+    d = ht.Domain(fn, {"x": hp.uniform("x", 0, 1)})
+    ctrl = ht.Ctrl(t, current_trial=t[0])
+    out = d.evaluate({"x": 0.5}, ctrl)
+    assert "attachments" not in out
+    assert t.trial_attachments(t[0])["model"] == b"weights"
+
+
+def test_trials_from_docs():
+    docs = [_mk_doc(0, loss=2.0), _mk_doc(1, loss=1.0)]
+    t = base.trials_from_docs(docs)
+    assert len(t) == 2 and t.best_trial["tid"] == 1

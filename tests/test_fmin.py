@@ -1,0 +1,198 @@
+"""fmin API tests (reference: ``tests/test_fmin.py`` — SURVEY.md §4:
+points_to_evaluate, trials_save_file resume, early_stop_fn, timeout,
+loss_threshold, exception propagation, space_eval round-trips)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import hyperopt_tpu as ht
+from hyperopt_tpu import hp, rand
+from hyperopt_tpu.exceptions import AllTrialsFailed
+
+from zoo import ZOO
+
+SPACE1 = {"x": hp.uniform("x", -5, 5)}
+
+
+def q1(d):
+    return (d["x"] - 3.0) ** 2
+
+
+def test_fmin_rand_converges():
+    best = ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=150,
+                   rstate=1, show_progressbar=False)
+    assert abs(best["x"] - 3.0) < 0.5
+
+
+def test_fmin_seeded_reproducible():
+    kw = dict(algo=rand.suggest, max_evals=20, show_progressbar=False)
+    b1 = ht.fmin(q1, SPACE1, rstate=np.random.default_rng(7), **kw)
+    b2 = ht.fmin(q1, SPACE1, rstate=np.random.default_rng(7), **kw)
+    assert b1 == b2
+
+
+def test_fmin_trials_populated():
+    trials = ht.Trials()
+    ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=17, trials=trials,
+            rstate=0, show_progressbar=False)
+    assert len(trials) == 17
+    assert all(s == ht.STATUS_OK for s in trials.statuses())
+    assert trials.best_trial["result"]["loss"] == min(trials.losses())
+
+
+def test_points_to_evaluate_run_first():
+    pts = [{"x": 3.0}, {"x": -3.0}]
+    seen = []
+    out = ht.fmin(lambda d: seen.append(d["x"]) or q1(d), SPACE1,
+                  algo=rand.suggest, max_evals=5,
+                  points_to_evaluate=pts, rstate=0, show_progressbar=False)
+    assert seen[:2] == [3.0, -3.0]  # seeded points evaluated first
+    # x=3.0 is the exact optimum: must win.
+    assert out == {"x": 3.0}
+
+
+def test_generate_trials_to_calculate():
+    t = ht.generate_trials_to_calculate([{"x": 1.0}, {"x": 2.0}])
+    assert len(t) == 2
+    assert t[0]["misc"]["vals"] == {"x": [1.0]}
+
+
+def test_trials_save_file_resume(tmp_path):
+    path = str(tmp_path / "trials.pkl")
+    ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=10, rstate=0,
+            trials_save_file=path, show_progressbar=False)
+    assert os.path.exists(path)
+    t2 = ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=25, rstate=1,
+                 trials_save_file=path, show_progressbar=False,
+                 return_argmin=False)
+    import pickle
+    with open(path, "rb") as f:
+        trials = pickle.load(f)
+    assert len(trials) == 25  # resumed the first 10, added 15
+
+
+def test_early_stop_no_progress():
+    calls = []
+
+    def fn(d):
+        calls.append(1)
+        return 1.0  # never improves after the first
+
+    ht.fmin(fn, SPACE1, algo=rand.suggest, max_evals=500, rstate=0,
+            early_stop_fn=ht.no_progress_loss(10), show_progressbar=False)
+    assert len(calls) < 50
+
+
+def test_timeout():
+    def slow(d):
+        time.sleep(0.02)
+        return d["x"] ** 2
+
+    t0 = time.time()
+    ht.fmin(slow, SPACE1, algo=rand.suggest, max_evals=10000, timeout=0.5,
+            rstate=0, show_progressbar=False)
+    assert time.time() - t0 < 5.0
+
+
+def test_loss_threshold():
+    trials = ht.Trials()
+    ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=5000, loss_threshold=5.0,
+            trials=trials, rstate=0, show_progressbar=False)
+    assert len(trials) < 5000
+    assert trials.best_trial["result"]["loss"] <= 5.0
+
+
+def test_invalid_timeout_rejected():
+    with pytest.raises(Exception):
+        ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=3, timeout=-1,
+                show_progressbar=False)
+
+
+def test_exception_propagates_by_default():
+    def bad(d):
+        raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError):
+        ht.fmin(bad, SPACE1, algo=rand.suggest, max_evals=3, rstate=0,
+                show_progressbar=False)
+
+
+def test_catch_eval_exceptions():
+    def flaky(d):
+        if d["x"] < 0:
+            raise RuntimeError("boom")
+        return d["x"]
+
+    trials = ht.Trials()
+    ht.fmin(flaky, SPACE1, algo=rand.suggest, max_evals=30, rstate=0,
+            catch_eval_exceptions=True, trials=trials, show_progressbar=False)
+    states = [t["state"] for t in trials]
+    assert ht.JOB_STATE_ERROR in states and ht.JOB_STATE_DONE in states
+
+
+def test_fail_status_trials_skipped_in_argmin():
+    def fn(d):
+        if d["x"] < 0:
+            return {"status": ht.STATUS_FAIL}
+        return {"loss": d["x"], "status": ht.STATUS_OK}
+
+    trials = ht.Trials()
+    ht.fmin(fn, SPACE1, algo=rand.suggest, max_evals=40, rstate=0,
+            trials=trials, show_progressbar=False)
+    assert trials.best_trial["result"]["loss"] >= 0
+
+
+def test_return_argmin_false_returns_loss():
+    out = ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=10, rstate=0,
+                  return_argmin=False, show_progressbar=False)
+    assert isinstance(out, float)
+
+
+def test_fmin_via_trials_method():
+    trials = ht.Trials()
+    best = trials.fmin(q1, SPACE1, algo=rand.suggest, max_evals=10,
+                       rstate=0, show_progressbar=False)
+    assert "x" in best and len(trials) == 10
+
+
+def test_space_eval_on_argmin_conditional():
+    z = ZOO["q1_choice"]
+    trials = ht.Trials()
+    best = ht.fmin(z.fn, z.space, algo=rand.suggest, max_evals=30, rstate=0,
+                   trials=trials, show_progressbar=False)
+    cfg = ht.space_eval(z.space, best)
+    assert np.isfinite(z.fn(cfg))
+
+
+def test_pass_expr_memo_ctrl():
+    seen = {}
+
+    def fn(expr, memo, ctrl):
+        seen["memo"] = memo
+        seen["ctrl"] = ctrl
+        return {"loss": memo["x"] ** 2, "status": ht.STATUS_OK}
+
+    fn.fmin_pass_expr_memo_ctrl = True
+    ht.fmin(fn, SPACE1, algo=rand.suggest, max_evals=3, rstate=0,
+            show_progressbar=False)
+    assert "x" in seen["memo"] and isinstance(seen["ctrl"], ht.Ctrl)
+
+
+def test_fmin_with_exp_key_trials():
+    # regression: suggest must stamp the Trials exp_key on new docs or
+    # refresh() filters every trial out and fmin returns nothing.
+    trials = ht.Trials(exp_key="exp-A")
+    best = ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=8, trials=trials,
+                   rstate=0, show_progressbar=False)
+    assert len(trials) == 8 and "x" in best
+    assert all(t["exp_key"] == "exp-A" for t in trials)
+
+
+def test_max_queue_len_batched_suggest():
+    trials = ht.Trials()
+    ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=12, max_queue_len=4,
+            trials=trials, rstate=0, show_progressbar=False)
+    assert len(trials) == 12

@@ -1,0 +1,48 @@
+"""Random-search suggest tests (reference: ``tests/test_rand.py``)."""
+
+import numpy as np
+import pytest
+
+import hyperopt_tpu as ht
+from hyperopt_tpu import hp, rand
+
+from zoo import ZOO, CONVERGENCE_DOMAINS
+
+
+def test_suggest_doc_schema():
+    z = ZOO["many_dists"]
+    domain = ht.Domain(z.fn, z.space)
+    trials = ht.Trials()
+    docs = rand.suggest([0, 1, 2], domain, trials, seed=42)
+    assert len(docs) == 3
+    ht.base.validate_trial_docs(docs)
+    for doc in docs:
+        assert doc["state"] == ht.JOB_STATE_NEW
+        # every label present; inactive ones with empty lists
+        assert set(doc["misc"]["vals"]) == {p.label for p in domain.cs.params}
+
+
+def test_suggest_seed_determinism():
+    z = ZOO["branin"]
+    domain = ht.Domain(z.fn, z.space)
+    trials = ht.Trials()
+    d1 = rand.suggest([0], domain, trials, seed=7)
+    d2 = rand.suggest([0], domain, trials, seed=7)
+    d3 = rand.suggest([0], domain, trials, seed=8)
+    assert d1[0]["misc"]["vals"] == d2[0]["misc"]["vals"]
+    assert d1[0]["misc"]["vals"] != d3[0]["misc"]["vals"]
+
+
+def test_empty_new_ids():
+    z = ZOO["quadratic1"]
+    domain = ht.Domain(z.fn, z.space)
+    assert rand.suggest([], domain, ht.Trials(), seed=0) == []
+
+
+@pytest.mark.parametrize("name", CONVERGENCE_DOMAINS)
+def test_rand_converges_on_zoo(name):
+    z = ZOO[name]
+    best = ht.fmin(z.fn, z.space, algo=rand.suggest, max_evals=z.budget,
+                   rstate=np.random.default_rng(123), show_progressbar=False,
+                   return_argmin=False)
+    assert best <= z.rand_thresh, f"{name}: {best} > {z.rand_thresh}"

@@ -1,0 +1,233 @@
+"""Space DSL + compiler tests: structure, bounds, masks, and KS-level
+distribution correctness (reference norms: ``test_pyll.py``, ``test_rdists.py``,
+``test_vectorize.py`` — SURVEY.md §4: statistical asserts, not exact-value)."""
+
+import jax
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import hyperopt_tpu as ht
+from hyperopt_tpu import hp
+from hyperopt_tpu.exceptions import DuplicateLabel
+
+from zoo import ZOO
+
+
+def _sample(space, n=4096, seed=0):
+    cs = ht.compile_space(space)
+    vals, active = cs.sample(jax.random.key(seed), n)
+    return cs, np.asarray(vals), np.asarray(active)
+
+
+# -- structure ---------------------------------------------------------------
+
+
+def test_duplicate_label_raises():
+    with pytest.raises(DuplicateLabel):
+        ht.compile_space({"a": hp.uniform("x", 0, 1),
+                          "b": hp.uniform("x", 0, 1)})
+
+
+def test_empty_choice_raises():
+    with pytest.raises(ValueError):
+        hp.choice("c", [])
+
+
+def test_pchoice_prob_validation():
+    with pytest.raises(ValueError):
+        hp.pchoice("c", [(0.5, 0), (0.2, 1)])  # sums to 0.7
+    with pytest.raises(ValueError):
+        hp.pchoice("c", [(-0.5, 0), (1.5, 1)])  # negative prob, sums to 1
+
+
+def test_label_must_be_str():
+    with pytest.raises(TypeError):
+        hp.uniform(3, 0, 1)
+
+
+def test_param_ordering_stable():
+    cs = ht.compile_space({"b": hp.uniform("b", 0, 1),
+                           "a": hp.normal("a", 0, 1)})
+    assert [p.label for p in cs.params] == ["b", "a"]
+    assert cs.by_label["a"].pid == 1
+
+
+# -- sampling bounds & dtypes ------------------------------------------------
+
+
+def test_uniform_bounds():
+    _, v, _ = _sample({"x": hp.uniform("x", -2, 7)})
+    assert v.min() >= -2 and v.max() <= 7
+
+
+def test_loguniform_bounds():
+    _, v, _ = _sample({"x": hp.loguniform("x", -3, 2)})
+    assert v.min() >= np.exp(-3) - 1e-6 and v.max() <= np.exp(2) + 1e-4
+
+
+def test_quniform_multiples():
+    _, v, _ = _sample({"x": hp.quniform("x", 0, 10, 2.5)})
+    assert np.allclose(v % 2.5, 0, atol=1e-5) or np.allclose(
+        (v % 2.5) - 2.5, 0, atol=1e-5)
+    assert set(np.unique(v)).issubset({0.0, 2.5, 5.0, 7.5, 10.0})
+
+
+def test_uniformint_inclusive_integer():
+    _, v, _ = _sample({"x": hp.uniformint("x", 3, 9)})
+    assert np.array_equal(v, np.round(v))
+    assert v.min() == 3 and v.max() == 9
+
+
+def test_randint_range():
+    _, v, _ = _sample({"x": hp.randint("x", 5, 25)})
+    assert np.array_equal(v, np.round(v))
+    assert v.min() >= 5 and v.max() <= 24
+    assert len(np.unique(v)) == 20
+
+
+def test_wide_randint_integer_draw():
+    # > _DENSE_CAT_MAX options: integer sampling path.
+    _, v, _ = _sample({"x": hp.randint("x", 100000)})
+    assert np.array_equal(v, np.round(v))
+    assert v.min() >= 0 and v.max() < 100000
+    assert len(np.unique(v)) > 3000
+
+
+def test_too_wide_randint_rejected():
+    with pytest.raises(ValueError):
+        ht.compile_space({"x": hp.randint("x", 2 ** 26)})
+
+
+def test_choice_indices_valid():
+    _, v, _ = _sample({"c": hp.choice("c", list("abcd"))})
+    assert set(np.unique(v)).issubset({0.0, 1.0, 2.0, 3.0})
+
+
+# -- KS / chi2 distribution tests -------------------------------------------
+
+
+def test_uniform_ks():
+    _, v, _ = _sample({"x": hp.uniform("x", -1, 3)}, n=8192)
+    assert st.kstest(v[:, 0], st.uniform(-1, 4).cdf).pvalue > 1e-3
+
+
+def test_loguniform_ks():
+    _, v, _ = _sample({"x": hp.loguniform("x", -2, 2)}, n=8192)
+    assert st.kstest(np.log(v[:, 0]), st.uniform(-2, 4).cdf).pvalue > 1e-3
+
+
+def test_normal_ks():
+    _, v, _ = _sample({"x": hp.normal("x", 3, 2)}, n=8192)
+    assert st.kstest(v[:, 0], st.norm(3, 2).cdf).pvalue > 1e-3
+
+
+def test_lognormal_ks():
+    _, v, _ = _sample({"x": hp.lognormal("x", 1, 0.5)}, n=8192)
+    assert st.kstest(np.log(v[:, 0]), st.norm(1, 0.5).cdf).pvalue > 1e-3
+
+
+def test_qnormal_chi2_vs_analytic():
+    _, v, _ = _sample({"x": hp.qnormal("x", 0, 1, 1)}, n=8192)
+    # P(q k) = Phi(k + .5) - Phi(k - .5)
+    for k in (-1, 0, 1):
+        expect = st.norm.cdf(k + 0.5) - st.norm.cdf(k - 0.5)
+        got = np.mean(v[:, 0] == k)
+        assert abs(got - expect) < 0.03
+
+
+def test_pchoice_frequencies():
+    _, v, _ = _sample({"c": hp.pchoice("c", [(0.2, "a"), (0.5, "b"),
+                                             (0.3, "c")])}, n=8192)
+    freq = np.bincount(v[:, 0].astype(int), minlength=3) / len(v)
+    assert np.allclose(freq, [0.2, 0.5, 0.3], atol=0.03)
+
+
+def test_randint_uniform_chi2():
+    _, v, _ = _sample({"x": hp.randint("x", 8)}, n=8192)
+    freq = np.bincount(v[:, 0].astype(int), minlength=8)
+    assert st.chisquare(freq).pvalue > 1e-3
+
+
+# -- conditional masks -------------------------------------------------------
+
+
+def test_active_mask_exclusive_branches():
+    cs, v, a = _sample({"c": hp.choice("c", [
+        {"x": hp.uniform("x", 0, 1)},
+        {"y": hp.uniform("y", 0, 1)},
+    ])})
+    pc = cs.by_label["c"].pid
+    px = cs.by_label["x"].pid
+    py = cs.by_label["y"].pid
+    assert a[:, pc].all()
+    assert np.array_equal(a[:, px], v[:, pc] == 0)
+    assert np.array_equal(a[:, py], v[:, pc] == 1)
+    assert not (a[:, px] & a[:, py]).any()
+
+
+def test_nested_choice_mask_conjunction():
+    cs, v, a = _sample({"c": hp.choice("c", [
+        {"d": hp.choice("d", [{"x": hp.uniform("x", 0, 1)}, "leaf"])},
+        "other",
+    ])})
+    px = cs.by_label["x"].pid
+    pc = cs.by_label["c"].pid
+    pd = cs.by_label["d"].pid
+    expect = (v[:, pc] == 0) & (v[:, pd] == 0)
+    assert np.array_equal(a[:, px], expect)
+
+
+# -- decode / eval_point -----------------------------------------------------
+
+
+def test_decode_row_nested_structure():
+    space = {"lr": hp.loguniform("lr", -5, 0),
+             "opt": hp.choice("opt", [
+                 {"name": "sgd", "momentum": hp.uniform("momentum", 0, 1)},
+                 {"name": "adam"},
+             ]),
+             "layers": [hp.uniformint("l1", 1, 4), hp.uniformint("l2", 1, 4)],
+             "frozen": ("tag", 42)}
+    cs, v, a = _sample(space, n=64)
+    for i in range(64):
+        d = cs.decode_row(v[i], a[i])
+        assert np.exp(-5) <= d["lr"] <= 1.0 + 1e-6
+        assert d["opt"]["name"] in ("sgd", "adam")
+        if d["opt"]["name"] == "sgd":
+            assert 0 <= d["opt"]["momentum"] <= 1
+        else:
+            assert "momentum" not in d["opt"]
+        assert isinstance(d["layers"][0], int)
+        assert d["frozen"] == ("tag", 42)
+
+
+def test_space_eval_round_trip():
+    space = {"c": hp.choice("c", [{"x": hp.uniform("x", 0, 1)},
+                                  {"y": hp.normal("y", 0, 1)}])}
+    out = ht.space_eval(space, {"c": 1, "y": 0.25})
+    assert out == {"c": {"y": 0.25}}
+    out = ht.space_eval(space, {"c": [0], "x": [0.5]})  # trials-vals style
+    assert out == {"c": {"x": 0.5}}
+
+
+def test_space_eval_int_coercion():
+    space = {"n": hp.uniformint("n", 1, 10)}
+    out = ht.space_eval(space, {"n": 4.0})
+    assert out == {"n": 4} and isinstance(out["n"], int)
+
+
+def test_zoo_spaces_compile_and_decode():
+    for z in ZOO.values():
+        cs, v, a = _sample(z.space, n=32, seed=7)
+        for i in range(32):
+            loss = z.fn(cs.decode_row(v[i], a[i]))
+            assert np.isfinite(loss)
+
+
+def test_sample_determinism():
+    cs = ht.compile_space({"x": hp.uniform("x", 0, 1),
+                           "c": hp.choice("c", [0, 1])})
+    v1, a1 = cs.sample(jax.random.key(42), 16)
+    v2, a2 = cs.sample(jax.random.key(42), 16)
+    assert np.array_equal(np.asarray(v1), np.asarray(v2))

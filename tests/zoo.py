@@ -1,0 +1,172 @@
+"""Domain zoo: canonical analytic search spaces shared by the algorithm tests.
+
+Modeled on the reference's ``hyperopt/tests/test_domains.py`` (SURVEY.md §4):
+a set of small, well-understood objectives + spaces that every suggest
+algorithm is swept over.  Each entry records the known best loss and a
+loose convergence threshold used by seeded statistical assertions.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from hyperopt_tpu import hp
+
+
+@dataclass
+class ZooDomain:
+    name: str
+    space: Any
+    fn: Callable
+    best_loss: float          # known global minimum (approx)
+    rand_thresh: float        # random search should get below this in budget
+    tpe_thresh: float         # model-based search should get below this
+    budget: int = 100         # max_evals for convergence tests
+
+
+def _quadratic1():
+    return ZooDomain(
+        name="quadratic1",
+        space={"x": hp.uniform("x", -5, 5)},
+        fn=lambda d: (d["x"] - 3.0) ** 2,
+        best_loss=0.0, rand_thresh=0.1, tpe_thresh=0.05, budget=80)
+
+
+def _q1_lognormal():
+    return ZooDomain(
+        name="q1_lognormal",
+        space={"x": hp.qlognormal("x", 0.0, 1.0, 1.0)},
+        fn=lambda d: max(d["x"], 0.0) * 1e-4 + (d["x"] - 3.0) ** 2 * 1e-2,
+        best_loss=0.0, rand_thresh=0.05, tpe_thresh=0.05, budget=80)
+
+
+def _q1_choice():
+    return ZooDomain(
+        name="q1_choice",
+        space={"p": hp.choice("p", [
+            {"kind": "flat", "x": hp.uniform("x_flat", -5, 5)},
+            {"kind": "centered", "x": hp.uniform("x_centered", -5, 5)},
+        ])},
+        fn=lambda d: ((d["p"]["x"] - 3.0) ** 2
+                      if d["p"]["kind"] == "centered"
+                      else 1.0 + d["p"]["x"] ** 2 * 0.01),
+        best_loss=0.0, rand_thresh=0.5, tpe_thresh=0.2, budget=120)
+
+
+def _n_arms(n=6):
+    # Bandit: arm i has loss i/10; best arm = 0.
+    return ZooDomain(
+        name="n_arms",
+        space={"arm": hp.choice("arm", list(range(n)))},
+        fn=lambda d: d["arm"] / 10.0,
+        best_loss=0.0, rand_thresh=0.0, tpe_thresh=0.0, budget=40)
+
+
+def _branin():
+    def branin(d):
+        x, y = d["x"], d["y"]
+        a, b, c = 1.0, 5.1 / (4 * math.pi ** 2), 5.0 / math.pi
+        r, s, t = 6.0, 10.0, 1.0 / (8 * math.pi)
+        return (a * (y - b * x ** 2 + c * x - r) ** 2
+                + s * (1 - t) * math.cos(x) + s)
+
+    return ZooDomain(
+        name="branin",
+        space={"x": hp.uniform("x", -5, 10), "y": hp.uniform("y", 0, 15)},
+        fn=branin,
+        best_loss=0.397887, rand_thresh=2.0, tpe_thresh=1.5, budget=150)
+
+
+def _distractor():
+    # Broad optimum at x=3 (depth -1), narrow deep distractor at x=-3
+    # (depth -2, width 0.02): model-based search must not tunnel-vision.
+    def fn(d):
+        x = d["x"]
+        return -(math.exp(-((x - 3) ** 2))
+                 + 2.0 * math.exp(-((x + 3) ** 2) / 0.02 ** 2))
+
+    return ZooDomain(
+        name="distractor",
+        space={"x": hp.uniform("x", -15, 15)},
+        fn=fn, best_loss=-2.0, rand_thresh=-0.5, tpe_thresh=-0.8, budget=150)
+
+
+def _gauss_wave():
+    def fn(d):
+        x = d["x"]
+        return -math.exp(-(x ** 2)) * (1 + 0.5 * math.cos(5 * x))
+
+    return ZooDomain(
+        name="gauss_wave",
+        space={"x": hp.uniform("x", -10, 10)},
+        fn=fn, best_loss=-1.5, rand_thresh=-0.8, tpe_thresh=-1.0, budget=120)
+
+
+def _gauss_wave2():
+    # Conditional: curve choice gates an extra amplitude parameter.
+    def fn(d):
+        x = d["x"]
+        c = d["curve"]
+        if c["kind"] == "plain":
+            return -math.exp(-(x ** 2))
+        return -c["amp"] * math.exp(-(x ** 2)) * math.cos(3 * x) ** 2
+
+    return ZooDomain(
+        name="gauss_wave2",
+        space={
+            "x": hp.uniform("x", -5, 5),
+            "curve": hp.choice("curve", [
+                {"kind": "plain"},
+                {"kind": "cos", "amp": hp.uniform("amp", 0.5, 2.0)},
+            ]),
+        },
+        fn=fn, best_loss=-2.0, rand_thresh=-0.9, tpe_thresh=-1.2, budget=150)
+
+
+def _many_dists():
+    # Wide mixed space touching every distribution kind (reference:
+    # test_domains.py::many_dists) — used as a "does everything run" sweep
+    # and as the 50-dim-style stress space.
+    space = {
+        "a": hp.choice("a", [0, 1, 2]),
+        "b": hp.randint("b", 10),
+        "bb": hp.randint("bb", 5, 25),
+        "c": hp.uniform("c", 0, 1),
+        "d": hp.loguniform("d", -3, 2),
+        "e": hp.quniform("e", 1, 10, 2),
+        "f": hp.qloguniform("f", 0, 3, 1),
+        "g": hp.normal("g", 4, 2),
+        "h": hp.lognormal("h", 0, 1),
+        "i": hp.qnormal("i", 0, 5, 1),
+        "j": hp.qlognormal("j", 0, 2, 1),
+        "k": hp.pchoice("k", [(0.1, 0), (0.9, 1)]),
+        "l": hp.uniformint("l", 1, 8),
+        "z": hp.choice("z", [
+            {"zz": hp.uniform("zz", 0, 1)},
+            {"zw": hp.normal("zw", 0, 1), "zc": hp.choice("zc", ["p", "q"])},
+        ]),
+    }
+
+    def fn(d):
+        val = (d["a"] + d["b"] * 0.01 + d["c"] + abs(d["g"] - 4) * 0.1
+               + d["e"] * 0.01 + d["k"] + d["l"] * 0.01)
+        z = d["z"]
+        val += z.get("zz", 0.0) + abs(z.get("zw", 0.0)) * 0.1
+        return float(val)
+
+    return ZooDomain(name="many_dists", space=space, fn=fn,
+                     best_loss=0.0, rand_thresh=1.0, tpe_thresh=1.0,
+                     budget=60)
+
+
+ZOO = {z.name: z for z in [
+    _quadratic1(), _q1_lognormal(), _q1_choice(), _n_arms(), _branin(),
+    _distractor(), _gauss_wave(), _gauss_wave2(), _many_dists(),
+]}
+
+CONVERGENCE_DOMAINS = ["quadratic1", "q1_choice", "n_arms", "branin",
+                       "distractor", "gauss_wave", "gauss_wave2"]

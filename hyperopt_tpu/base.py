@@ -169,7 +169,9 @@ def docs_from_samples(cs: CompiledSpace, new_ids, vals, active,
             if active[row, spec.pid]:
                 idxs_d[spec.label] = [tid]
                 v = vals[row, spec.pid]
-                vals_d[spec.label] = [int(v) if spec.is_int else float(v)]
+                # round() not int(): f32 integer values can sit a ulp below.
+                vals_d[spec.label] = [int(round(float(v))) if spec.is_int
+                                      else float(v)]
             else:
                 idxs_d[spec.label] = []
                 vals_d[spec.label] = []
@@ -478,9 +480,12 @@ class Ctrl:
     Passed to the objective when ``fmin(..., pass_expr_memo_ctrl=True)``.
     """
 
-    def __init__(self, trials: Trials, current_trial=None):
+    def __init__(self, trials: Trials, current_trial=None, workdir=None):
         self.trials = trials
         self.current_trial = current_trial
+        # Per-trial scratch directory, set by distributed workers
+        # (parallel.filestore.FileWorker) when configured with workdir=.
+        self.workdir = workdir
 
     @property
     def attachments(self):

@@ -1,2 +1,25 @@
-def suggest(new_ids, domain, trials, seed):
-    raise NotImplementedError('mix: coming next')
+"""Mixture suggest algorithm: route each suggest call to a sub-algorithm.
+
+Reference: ``hyperopt/mix.py::suggest`` (SURVEY.md §2): given
+``p_suggest=[(p, algo), ...]``, pick one sub-algorithm per call with
+probability ``p`` — e.g. an ε-greedy blend of random search and TPE::
+
+    fmin(fn, space, max_evals=100,
+         algo=partial(mix.suggest,
+                      p_suggest=[(0.1, rand.suggest), (0.9, tpe.suggest)]))
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def suggest(new_ids, domain, trials, seed, p_suggest):
+    """Call one of ``p_suggest``'s algorithms, chosen with its probability."""
+    ps = [p for p, _ in p_suggest]
+    if not np.isclose(sum(ps), 1.0, atol=1e-3):
+        raise ValueError(f"p_suggest probabilities sum to {sum(ps)}, not 1")
+    rng = np.random.default_rng(int(seed) % (2 ** 32))
+    idx = rng.choice(len(ps), p=np.asarray(ps) / sum(ps))
+    _, algo = p_suggest[idx]
+    return algo(new_ids, domain, trials, seed=int(rng.integers(2 ** 31 - 1)))

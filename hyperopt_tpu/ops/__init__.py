@@ -1,0 +1,16 @@
+"""XLA compute kernels — the TPU-native replacement for the reference's
+numpy hot loops (``hyperopt/tpe.py::GMM1_lpdf`` & friends, SURVEY.md §2).
+
+Everything in this package is pure, shape-static, jit/vmap-friendly JAX.
+"""
+
+from .gmm import (  # noqa: F401
+    gmm_log_qmass,
+    gmm_logpdf,
+    gmm_sample,
+    log_ndtr_diff,
+)
+from .parzen import (  # noqa: F401
+    fit_parzen,
+    forgetting_weights,
+)

@@ -1,0 +1,126 @@
+"""Truncated 1-D Gaussian-mixture kernels: log-pdf, quantized log-mass, sample.
+
+Reference semantics: ``hyperopt/tpe.py::GMM1 / GMM1_lpdf / LGMM1_lpdf /
+qGMM1_lpdf / qLGMM1_lpdf`` (~L60-160, SURVEY.md §2; mount empty, anchors from
+upstream).  Design differences, TPU-first:
+
+* The reference *samples* truncated mixtures by per-draw Python rejection
+  loops (``GMM1``: redraw until in bounds).  Rejection is data-dependent
+  control flow — hostile to XLA — so sampling here is **inverse-CDF**:
+  component via Gumbel-argmax, then ``u ~ U[Φ(a), Φ(b)]`` → ``ndtri(u)``.
+  Exact truncated sampling, fixed shapes, no loops.
+
+* Scoring works on whole candidate batches: ``[n_cand]`` candidates ×
+  ``[K]`` components broadcast to one ``[n_cand, K]`` logsumexp — the
+  MXU/VPU-shaped inner loop of the TPE suggest step, vmapped over
+  hyperparameter columns.
+
+* Log-kind parameters are scored entirely in fit (log) space.  The
+  ``1/x`` Jacobian the reference applies in ``LGMM1_lpdf`` cancels in the
+  EI difference ``llik_below − llik_above``, so it is omitted (documented
+  deviation; affects neither argmax nor sampling distributions).
+
+All functions operate on one parameter's mixture; callers ``vmap`` over the
+parameter axis.  Mixtures use zero-weight padding (``fit_parzen``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import log_ndtr, ndtri
+from jax.scipy.stats import norm
+
+_TINY = 1e-12
+
+
+def log_ndtr_diff(a, b):
+    """``log(Φ(b) − Φ(a))`` computed stably, assuming ``a <= b`` elementwise.
+
+    Handles ±inf bounds; uses the upper-tail symmetry ``Φ(b) − Φ(a) =
+    Φ(−a) − Φ(−b)`` when both bounds are positive to avoid catastrophic
+    cancellation.
+    """
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+    flip = a > 0.0
+    # Sanitize both branches: where() evaluates both sides, and inf−inf or
+    # log_ndtr(nan) would poison gradients/values.
+    lo = jnp.where(flip, -b, a)
+    hi = jnp.where(flip, -a, b)
+    llo = log_ndtr(lo)
+    lhi = log_ndtr(hi)
+    # d = log Φ(lo) − log Φ(hi) <= 0; equal −inf bounds → zero mass.
+    both_ninf = jnp.isneginf(llo) & jnp.isneginf(lhi)
+    d = jnp.where(both_ninf, -jnp.inf, llo - lhi)
+    d = jnp.minimum(d, 0.0)
+    return lhi + jnp.log1p(-jnp.exp(d))
+
+
+def _log_trunc_mass(logw, mu, sigma, trunc_lo, trunc_hi):
+    """Per-component ``log(w_k · mass_k)`` with ``mass_k`` the in-bounds
+    probability of component ``k``, plus the global normalizer
+    ``log Σ_k w_k · mass_k`` (the reference's ``p_accept``).  Padding
+    components (−inf logw) stay −inf."""
+    za = (trunc_lo - mu) / sigma
+    zb = (trunc_hi - mu) / sigma
+    log_wmass = logw + log_ndtr_diff(za, zb)
+    return log_wmass, jax.scipy.special.logsumexp(log_wmass)
+
+
+def gmm_logpdf(z, logw, mu, sigma, trunc_lo=-jnp.inf, trunc_hi=jnp.inf):
+    """Log-density of a truncated GMM at fit-space points ``z``.
+
+    ``z``: f32[n]; ``logw/mu/sigma``: f32[K] (−inf logw on padding).
+    Truncation renormalizes GLOBALLY — ``pdf(x) = Σ_k w_k N(x; k) /
+    Σ_k w_k mass_k`` — matching the distribution of the reference's
+    rejection sampler and its ``GMM1_lpdf`` ``p_accept`` normalizer.
+    Returns f32[n] (−inf outside the truncation bounds).
+    """
+    _, log_z = _log_trunc_mass(logw, mu, sigma, trunc_lo, trunc_hi)
+    lp = norm.logpdf(z[:, None], mu[None, :], sigma[None, :])     # [n, K]
+    out = jax.scipy.special.logsumexp(lp + logw[None, :], axis=-1) - log_z
+    in_bounds = (z >= trunc_lo) & (z <= trunc_hi)
+    return jnp.where(in_bounds, out, -jnp.inf)
+
+
+def gmm_log_qmass(zl, zh, logw, mu, sigma, trunc_lo=-jnp.inf,
+                  trunc_hi=jnp.inf):
+    """Log probability mass of a truncated GMM on fit-space bins
+    ``[zl, zh]`` — the quantized-distribution score.
+
+    Reference: ``tpe.py::qGMM1_lpdf / qLGMM1_lpdf`` — the probability that a
+    draw lands in the bin that rounds to the candidate value, renormalized by
+    the global truncation mass (``p_accept``).  ``zl/zh``: f32[n] bin edges
+    already clipped/mapped to fit space by the caller (−inf lower edge
+    encodes bins reaching the support boundary, e.g. value 0 of a
+    qlognormal).
+    """
+    _, log_z = _log_trunc_mass(logw, mu, sigma, trunc_lo, trunc_hi)
+    a = (jnp.maximum(zl, trunc_lo)[:, None] - mu[None, :]) / sigma[None, :]
+    b = (jnp.minimum(zh, trunc_hi)[:, None] - mu[None, :]) / sigma[None, :]
+    log_mass = log_ndtr_diff(a, jnp.maximum(a, b))                # [n, K]
+    return (jax.scipy.special.logsumexp(log_mass + logw[None, :], axis=-1)
+            - log_z)
+
+
+def gmm_sample(key, logw, mu, sigma, trunc_lo, trunc_hi, n):
+    """Draw ``n`` fit-space samples from a truncated GMM, inverse-CDF style.
+
+    Replaces the reference's rejection loop (``tpe.py::GMM1``) with an exact
+    fixed-shape equivalent: the component is drawn ∝ ``w_k · mass_k`` (what
+    rejection induces), then the truncated normal is sampled via
+    ``u ~ U[Φ(a), Φ(b)] → ndtri(u)``.
+    """
+    kc, ku = jax.random.split(key)
+    log_wmass, _ = _log_trunc_mass(logw, mu, sigma, trunc_lo, trunc_hi)
+    comp = jax.random.categorical(kc, log_wmass, shape=(n,))
+    m = mu[comp]
+    s = sigma[comp]
+    pa = jax.scipy.special.ndtr((trunc_lo - m) / s)
+    pb = jax.scipy.special.ndtr((trunc_hi - m) / s)
+    u = jax.random.uniform(ku, (n,), dtype=jnp.float32)
+    u = pa + u * (pb - pa)
+    # Clamp away from {0, 1}: ndtri(0/1) = ∓inf would escape the bounds.
+    u = jnp.clip(u, _TINY, 1.0 - 1e-7)
+    return ndtri(u) * s + m

@@ -1,0 +1,102 @@
+"""Adaptive-Parzen estimator fitting as fixed-shape XLA kernels.
+
+Reference semantics: ``hyperopt/tpe.py::adaptive_parzen_normal`` (~L200) and
+``linear_forgetting_weights`` (~L180) — SURVEY.md §2 (the reference mount was
+empty; anchors are upstream hyperopt symbols).  The reference builds a 1-D
+Parzen mixture per hyperparameter with Python list/array surgery per suggest
+call; here the same estimator is a pure function over **fixed-capacity padded
+buffers** so it jits once and ``vmap``s over all hyperparameter columns at
+once (SURVEY.md §7 "hard part 2": dynamic history → padded mixtures).
+
+Estimator (matching the reference's documented behavior):
+
+* observations are sorted and the prior is inserted as one extra component at
+  its sorted position;
+* each component's bandwidth is the max distance to its sorted neighbors
+  (one-sided at the edges; ``prior_sigma/2`` when there is a single
+  observation), clipped to ``[prior_sigma/min(100, 1+m), prior_sigma]``;
+* the prior component keeps ``sigma = prior_sigma`` and weight
+  ``prior_weight``; observation weights come from linear forgetting
+  (the newest ``LF`` observations weigh 1, older ones ramp down linearly);
+* weights are normalized to sum to 1.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def forgetting_weights(rank, n_obs, lf):
+    """Linear-forgetting weight for observations by recency rank.
+
+    ``rank`` — 0-based age order (0 = oldest observation); ``n_obs`` — number
+    of live observations; ``lf`` — linear-forgetting horizon.  The newest
+    ``lf`` observations get weight 1.0; older ones ramp linearly from
+    ``1/n_obs`` (reference: ``tpe.py::linear_forgetting_weights``:
+    ``concatenate([linspace(1/N, 1, N-LF), ones(LF)])``).
+
+    All args may be arrays (broadcast); returns f32 weights.
+    """
+    rank = jnp.asarray(rank, jnp.float32)
+    n_obs = jnp.asarray(n_obs, jnp.float32)
+    n_ramp = jnp.maximum(n_obs - lf, 0.0)
+    a = 1.0 / jnp.maximum(n_obs, 1.0)
+    denom = jnp.maximum(n_ramp - 1.0, 1.0)
+    ramp = a + (1.0 - a) * rank / denom
+    return jnp.where(rank < n_ramp, ramp, 1.0).astype(jnp.float32)
+
+
+def fit_parzen(x, w, n_obs, prior_mu, prior_sigma, prior_weight, out_cap):
+    """Fit a 1-D adaptive-Parzen mixture from padded observations.
+
+    Args:
+      x: f32[C] observation values in *fit space* (log space for log-kind
+        params), padded with ``+inf`` beyond the live observations.
+      w: f32[C] per-observation weights (linear forgetting), 0 on padding.
+      n_obs: i32 scalar — number of live observations (``n_obs + 1 <= out_cap``
+        must hold; callers guarantee it via the γ-split cap, SURVEY.md §2:
+        ``n_below <= linear_forgetting``).
+      prior_mu, prior_sigma, prior_weight: scalar prior-component parameters.
+      out_cap: static int — component capacity of the returned mixture.
+
+    Returns:
+      ``(weights f32[out_cap], mus f32[out_cap], sigmas f32[out_cap])`` sorted
+      ascending by ``mu``; padding slots have weight 0 (mu 0, sigma 1).
+    """
+    c = x.shape[0]
+    dt = jnp.float32
+    xs = jnp.concatenate([x.astype(dt), jnp.full((1,), prior_mu, dt)])
+    ws = jnp.concatenate([w.astype(dt), jnp.full((1,), prior_weight, dt)])
+    is_prior = jnp.zeros((c + 1,), bool).at[c].set(True)
+
+    # Stable ascending sort: +inf padding lands at the tail, the (finite)
+    # prior lands at its sorted position among the live observations — the
+    # reference's searchsorted insert.
+    order = jnp.argsort(xs)
+    s = xs[order][:out_cap]
+    sw = ws[order][:out_cap]
+    sp = is_prior[order][:out_cap]
+
+    idx = jnp.arange(out_cap)
+    m = jnp.asarray(n_obs, jnp.int32) + 1  # live components incl. prior
+    valid = idx < m
+
+    # Neighbor-gap bandwidths; edges are one-sided.  roll() wrap-around lanes
+    # are masked out by the idx guards.
+    left = s - jnp.roll(s, 1)
+    right = jnp.roll(s, -1) - s
+    sigma = jnp.maximum(jnp.where(idx >= 1, left, -jnp.inf),
+                        jnp.where(idx + 1 < m, right, -jnp.inf))
+    # Single observation: reference assigns it prior_sigma / 2.
+    sigma = jnp.where((n_obs == 1) & ~sp, 0.5 * prior_sigma, sigma)
+
+    maxsigma = prior_sigma
+    minsigma = prior_sigma / jnp.minimum(100.0, 1.0 + m.astype(dt))
+    sigma = jnp.clip(sigma, minsigma, maxsigma)
+    sigma = jnp.where(sp, prior_sigma, sigma)
+
+    sw = jnp.where(valid, sw, 0.0)
+    sw = sw / jnp.sum(sw)
+    mus = jnp.where(valid, s, 0.0)
+    sigma = jnp.where(valid, sigma, 1.0)
+    return sw, mus, sigma

@@ -1,0 +1,25 @@
+"""Multi-device execution: sharded EI scoring + multi-start TPE over a Mesh.
+
+The reference's parallelism is trial-level only (Mongo workers / Spark tasks,
+SURVEY.md §2 parallelism inventory); it has NO collective-communication
+layer.  The TPU-native equivalents here (per SURVEY.md §5.8):
+
+* **intra-slice (ICI)** — ``ShardedTpeKernel``: the TPE candidate axis is
+  sharded over the mesh with ``jax.sharding`` constraints; XLA inserts the
+  ``all_gather``/argmax-reduce collectives.
+* **multi-start** — ``multi_start_suggest``: K independent TPE posteriors
+  (distinct RNG streams) run one per mesh slot via ``shard_map``, proposing
+  K diverse configurations in one device program (the ``pmap`` multi-start
+  of BASELINE.md config 4).
+* **cross-host (DCN / host network)** — ``hyperopt_tpu.parallel.filestore``:
+  an elastic, durable trial store playing MongoDB's role (atomic claim,
+  owner stamps, experiment keys) for fleets of workers.
+"""
+
+from .sharded import (  # noqa: F401
+    ShardedTpeKernel,
+    default_mesh,
+    multi_start_suggest,
+    sharded_suggest,
+)
+from .filestore import FileTrials, FileWorker  # noqa: F401

@@ -1,0 +1,412 @@
+"""Durable, elastic, multi-process trial store + worker daemon.
+
+Reference: ``hyperopt/mongoexp.py`` (SURVEY.md §2/§3.4 — ``MongoJobs`` job
+CRUD + atomic reservation via ``find_and_modify`` owner stamps, ``MongoTrials``
+(async Trials), ``MongoWorker.run_one`` reserve→reconstruct-Domain→evaluate→
+write-result, CLI ``hyperopt-mongo-worker``).  The environment has no MongoDB
+or pymongo (SURVEY.md §7), and a TPU pod's hosts share fast storage, so the
+same contract is rebuilt on a **filesystem store**:
+
+* one JSON document per trial under ``<root>/<exp_key>/trials/<tid>.json``;
+* **atomic job reservation** via exclusive creation (``open(..., 'x')``) of a
+  ``<tid>.claim`` owner-stamp file — the POSIX equivalent of Mongo's atomic
+  ``find_and_modify`` (works on shared NFS/GCS-fuse mounts for multi-host);
+* tid allocation via exclusive-create counter files (server-side allocation
+  in Mongo);
+* the ``Domain`` travels to workers as a pickle in the experiment directory
+  (GridFS attachment in the reference);
+* workers are stateless and elastic: join/leave anytime, ``reserve_timeout``
+  bounds idle lifetime, ``max_consecutive_failures`` kills a sick worker —
+  the reference worker-daemon semantics (mongoexp.py::main_worker_helper);
+* **improvement over the reference** (SURVEY.md §5.3 notes the gap): crashed
+  workers' RUNNING jobs are requeued automatically by
+  ``FileTrials.requeue_stale`` instead of manual cleanup.
+
+Experiments are resumable by construction: re-running ``fmin`` with the same
+root + exp_key continues where the store left off (MongoTrials semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import pickle
+import socket
+import time
+from typing import Optional
+
+try:  # serialize objectives BY VALUE (lambdas, __main__ closures) — the
+    # same mechanism the reference's SparkTrials relies on (cloudpickled
+    # task closures over Spark RPC, SURVEY.md §3.5).
+    import cloudpickle as _pickler
+except ImportError:  # pragma: no cover
+    _pickler = pickle
+
+from .. import base
+from ..base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    Ctrl,
+    Trials,
+    coarse_utcnow,
+)
+
+logger = logging.getLogger(__name__)
+
+_DOMAIN_FILE = "domain.pkl"
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}.{time.monotonic_ns()}"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
+
+
+class FileTrials(Trials):
+    """Durable ``Trials`` over a shared directory (MongoTrials analog).
+
+    ``asynchronous = True``: ``fmin`` only enqueues documents; evaluation is
+    done by :class:`FileWorker` processes watching the same directory.
+    """
+
+    asynchronous = True
+
+    def __init__(self, root: str, exp_key: str = "default", refresh=True):
+        self.root = os.path.abspath(root)
+        self._exp_dir = os.path.join(self.root, exp_key)
+        self._trials_dir = os.path.join(self._exp_dir, "trials")
+        self._tids_dir = os.path.join(self._exp_dir, "tids")
+        os.makedirs(self._trials_dir, exist_ok=True)
+        os.makedirs(self._tids_dir, exist_ok=True)
+        # Incremental-refresh cache: filename -> (mtime_ns, size, doc).
+        # Re-parse only files that changed; idle polls cost one scandir.
+        self._doc_cache: dict = {}
+        super().__init__(exp_key=exp_key, refresh=refresh)
+
+    def __getstate__(self):
+        state = super().__getstate__()
+        state["_doc_cache"] = {}
+        return state
+
+    # -- document IO ---------------------------------------------------------
+
+    def _doc_path(self, tid: int) -> str:
+        return os.path.join(self._trials_dir, f"{tid}.json")
+
+    def _claim_path(self, tid: int) -> str:
+        return os.path.join(self._trials_dir, f"{tid}.claim")
+
+    def _write_doc(self, doc) -> None:
+        _atomic_write_json(self._doc_path(doc["tid"]), doc)
+
+    def _insert_trial_docs(self, docs):
+        for d in docs:
+            self._write_doc(d)
+        return [d["tid"] for d in docs]
+
+    def refresh(self):
+        with self._lock:
+            docs = []
+            seen = set()
+            for entry in os.scandir(self._trials_dir):
+                name = entry.name
+                if not name.endswith(".json"):
+                    continue
+                seen.add(name)
+                try:
+                    st = entry.stat()
+                    key = (st.st_mtime_ns, st.st_size)
+                    cached = self._doc_cache.get(name)
+                    if cached is not None and cached[0] == key:
+                        docs.append(cached[1])
+                        continue
+                    with open(entry.path) as f:
+                        doc = json.load(f)
+                    self._doc_cache[name] = (key, doc)
+                    docs.append(doc)
+                except (json.JSONDecodeError, OSError):
+                    continue  # mid-replace read; next refresh catches it
+            for stale in set(self._doc_cache) - seen:
+                del self._doc_cache[stale]
+            docs.sort(key=lambda d: d["tid"])
+            self._dynamic_trials = docs
+            self._ids = {d["tid"] for d in docs}
+            self._trials = [d for d in docs
+                            if self._exp_key in (None, d.get("exp_key"))]
+
+    def new_trial_ids(self, n):
+        out = []
+        i = max(self._ids, default=-1) + 1
+        while len(out) < n:
+            try:
+                fd = os.open(os.path.join(self._tids_dir, str(i)),
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                out.append(i)
+            except FileExistsError:
+                pass
+            i += 1
+        return out
+
+    # -- domain shipping (GridFS-attachment analog) --------------------------
+
+    def save_domain(self, domain) -> None:
+        path = os.path.join(self._exp_dir, _DOMAIN_FILE)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            _pickler.dump(domain, f)
+        os.replace(tmp, path)
+
+    def load_domain(self):
+        with open(os.path.join(self._exp_dir, _DOMAIN_FILE), "rb") as f:
+            return pickle.load(f)
+
+    def fmin(self, fn, space, algo, max_evals, **kwargs):
+        from ..base import Domain
+        try:
+            self.save_domain(Domain(fn, space,
+                                    pass_expr_memo_ctrl=kwargs.get(
+                                        "pass_expr_memo_ctrl")))
+        except (pickle.PicklingError, AttributeError, TypeError) as e:
+            # Unpicklable objective (lambda/closure): cross-process workers
+            # must then be constructed with an explicit domain=...;
+            # same-process workers are unaffected.
+            logger.warning("objective not picklable (%s); workers must be "
+                           "given the domain explicitly", e)
+        return super().fmin(fn, space, algo, max_evals, **kwargs)
+
+    # -- reservation (the race-safety mechanism) -----------------------------
+
+    def reserve(self, owner: str) -> Optional[dict]:
+        """Atomically claim one NEW trial for ``owner``; None if none left.
+
+        The exclusive-create of the ``.claim`` file is the commit point —
+        exactly one process can win it (reference: ``MongoJobs.reserve``'s
+        ``find_and_modify`` NEW→RUNNING with owner stamp).
+        """
+        self.refresh()
+        for doc in self._trials:
+            if doc["state"] != JOB_STATE_NEW:
+                continue
+            try:
+                fd = os.open(self._claim_path(doc["tid"]),
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                continue
+            with os.fdopen(fd, "w") as f:
+                f.write(owner)
+            doc["state"] = JOB_STATE_RUNNING
+            doc["owner"] = owner
+            doc["book_time"] = coarse_utcnow()
+            doc["refresh_time"] = doc["book_time"]
+            self._write_doc(doc)
+            return doc
+        return None
+
+    def heartbeat(self, doc, owner: Optional[str] = None) -> bool:
+        """Stamp a RUNNING trial as alive (so ``requeue_stale`` spares it).
+
+        Owner-fenced like :meth:`write_result`: a presumed-dead worker whose
+        trial was requeued must not resurrect its stale doc over the new
+        claimant's state."""
+        if owner is not None and not self.owns(doc, owner):
+            return False
+        doc["refresh_time"] = coarse_utcnow()
+        self._write_doc(doc)
+        return True
+
+    def owns(self, doc, owner: str) -> bool:
+        """True iff ``owner`` still holds the claim on ``doc``'s trial.
+
+        A stale worker loses its claim when ``requeue_stale`` deletes the
+        claim file (and another worker may have re-created it)."""
+        try:
+            with open(self._claim_path(doc["tid"])) as f:
+                return f.read() == owner
+        except FileNotFoundError:
+            return False
+
+    def write_result(self, doc, owner: Optional[str] = None) -> bool:
+        """Publish a result; refuses (returns False) if ``owner`` no longer
+        holds the claim — a requeued-and-reassigned trial must not be
+        overwritten by the original (presumed-dead) worker's late write."""
+        if owner is not None and not self.owns(doc, owner):
+            logger.warning("dropping result for tid %s: claim lost by %s",
+                           doc["tid"], owner)
+            return False
+        doc["refresh_time"] = coarse_utcnow()
+        self._write_doc(doc)
+        return True
+
+    def requeue_stale(self, timeout: float) -> int:
+        """Requeue trials whose owner went silent for ``timeout`` seconds
+        (fixes the reference's manual-cleanup gap, SURVEY.md §5.3).
+
+        Two stale shapes: (a) RUNNING docs with no heartbeat for ``timeout``
+        (worker died mid-evaluation); (b) NEW docs shadowed by an old orphan
+        claim file (worker died between winning the claim and persisting the
+        RUNNING doc) — those claims are cleared so ``reserve`` sees the trial
+        again."""
+        now = time.time()
+        n = 0
+        self.refresh()
+        for doc in self._trials:
+            claim = self._claim_path(doc["tid"])
+            if doc["state"] == JOB_STATE_RUNNING:
+                last = doc.get("refresh_time") or doc.get("book_time") or 0
+                if now - last > timeout:
+                    try:
+                        os.unlink(claim)
+                    except FileNotFoundError:
+                        pass
+                    doc["state"] = JOB_STATE_NEW
+                    doc["owner"] = None
+                    self._write_doc(doc)
+                    n += 1
+            elif doc["state"] == JOB_STATE_NEW:
+                try:
+                    if now - os.stat(claim).st_mtime > timeout:
+                        os.unlink(claim)
+                        n += 1
+                except (FileNotFoundError, OSError):
+                    pass
+        if n:
+            self.refresh()
+        return n
+
+
+class FileWorker:
+    """Stateless evaluation daemon (reference: ``mongoexp.py::MongoWorker``).
+
+    ``run_one``: reserve a job → reconstruct the Domain → evaluate → write
+    the result.  ``run``: loop with ``poll_interval`` until ``reserve_timeout``
+    elapses with nothing to do, or ``max_consecutive_failures`` trips.
+    """
+
+    def __init__(self, root, exp_key="default", domain=None,
+                 poll_interval=0.1, reserve_timeout=None,
+                 max_consecutive_failures=4, workdir=None,
+                 heartbeat_interval=15.0):
+        self.trials = FileTrials(root, exp_key=exp_key)
+        self._domain = domain
+        self.poll_interval = poll_interval
+        self.reserve_timeout = reserve_timeout
+        self.max_consecutive_failures = max_consecutive_failures
+        self.workdir = workdir
+        self.heartbeat_interval = heartbeat_interval
+        # uuid suffix: same-process workers (threads) must not share an
+        # identity, or owns() could confuse their claims.
+        import uuid
+        self.owner = (f"{socket.gethostname()}:{os.getpid()}:"
+                      f"{uuid.uuid4().hex[:8]}")
+
+    @property
+    def domain(self):
+        if self._domain is None:
+            self._domain = self.trials.load_domain()
+        return self._domain
+
+    def run_one(self) -> bool:
+        """Reserve and evaluate one trial; False if the queue was empty."""
+        import threading
+
+        doc = self.trials.reserve(self.owner)
+        if doc is None:
+            return False
+        ctrl = Ctrl(self.trials, current_trial=doc)
+        # Heartbeat while the (arbitrarily long) objective runs, so
+        # requeue_stale can tell a live worker from a crashed one.
+        stop_hb = threading.Event()
+
+        def _beat():
+            while not stop_hb.wait(self.heartbeat_interval):
+                try:
+                    self.trials.heartbeat(doc, owner=self.owner)
+                except OSError:
+                    pass
+
+        hb = threading.Thread(target=_beat, daemon=True)
+        hb.start()
+        try:
+            if self.workdir:
+                # Per-trial scratch dir, exposed via ctrl (NOT os.chdir —
+                # workers may share a process as threads, and chdir is
+                # process-global; the reference could chdir because each
+                # MongoWorker job ran in its own subprocess).
+                wd = os.path.join(self.workdir, str(doc["tid"]))
+                os.makedirs(wd, exist_ok=True)
+                ctrl.workdir = wd
+            spec = base.spec_from_misc(doc["misc"])
+            result = self.domain.evaluate(spec, ctrl)
+        except Exception as e:
+            logger.error("worker job exception (tid %s): %s", doc["tid"], e)
+            doc["state"] = JOB_STATE_ERROR
+            doc["misc"]["error"] = (type(e).__name__, str(e))
+            self.trials.write_result(doc, owner=self.owner)
+            raise
+        else:
+            doc["state"] = JOB_STATE_DONE
+            doc["result"] = result
+            return self.trials.write_result(doc, owner=self.owner)
+        finally:
+            stop_hb.set()
+
+    def run(self) -> int:
+        """Serve jobs until idle past ``reserve_timeout``; returns #done."""
+        n_done = 0
+        failures = 0
+        idle_since = time.time()
+        while True:
+            try:
+                worked = self.run_one()
+            except Exception:
+                failures += 1
+                if failures >= self.max_consecutive_failures:
+                    logger.error("worker exiting after %d consecutive "
+                                 "failures", failures)
+                    return n_done
+                worked = True  # the queue wasn't empty
+            else:
+                if worked:
+                    failures = 0
+                    n_done += 1
+            if worked:
+                idle_since = time.time()
+            else:
+                if (self.reserve_timeout is not None
+                        and time.time() - idle_since > self.reserve_timeout):
+                    return n_done
+                time.sleep(self.poll_interval)
+
+
+def main(argv=None):
+    """CLI: ``python -m hyperopt_tpu.parallel.filestore --root DIR ...``
+    (reference: console script ``hyperopt-mongo-worker``)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="hyperopt_tpu file-store worker daemon")
+    p.add_argument("--root", required=True, help="shared experiment root dir")
+    p.add_argument("--exp-key", default="default")
+    p.add_argument("--poll-interval", type=float, default=0.1)
+    p.add_argument("--reserve-timeout", type=float, default=None,
+                   help="exit after this many idle seconds")
+    p.add_argument("--max-consecutive-failures", type=int, default=4)
+    p.add_argument("--workdir", default=None)
+    args = p.parse_args(argv)
+    worker = FileWorker(args.root, exp_key=args.exp_key,
+                        poll_interval=args.poll_interval,
+                        reserve_timeout=args.reserve_timeout,
+                        max_consecutive_failures=args.max_consecutive_failures,
+                        workdir=args.workdir)
+    n = worker.run()
+    logger.info("worker done: %d trials evaluated", n)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,2 +1,464 @@
-def suggest(new_ids, domain, trials, seed):
-    raise NotImplementedError('tpe: coming next')
+"""Tree-structured Parzen Estimator — the flagship suggest algorithm.
+
+Reference: ``hyperopt/tpe.py`` (SURVEY.md §2/§3.2 — ``suggest`` ~L800,
+``adaptive_parzen_normal`` ~L200, ``GMM1_lpdf`` ~L60-140, ``ap_split_trials``
+~L700, ``build_posterior`` ~L450, ``broadcast_best``; the reference mount was
+empty, anchors are upstream hyperopt symbols).  Defaults match the reference:
+``prior_weight=1.0, n_startup_jobs=20, n_EI_candidates=24, gamma=0.25,
+linear_forgetting=25``.
+
+Algorithm (reference semantics):
+
+1. Until ``n_startup_jobs`` trials finish, fall back to random search.
+2. γ-split: sort finished trials by loss; the best
+   ``n_below = min(ceil(gamma · sqrt(N)), linear_forgetting)`` form the
+   "below" set, the rest "above".
+3. Per hyperparameter, fit adaptive-Parzen mixtures to the below and above
+   observations (prior-anchored bandwidths, linear-forgetting weights).
+4. Draw ``n_EI_candidates`` from the below model and keep the candidate
+   maximizing the EI surrogate ``log p(x|below) − log p(x|above)``,
+   independently per hyperparameter (the reference's factorized posterior +
+   ``broadcast_best``).
+
+TPU-first design (NOT a translation — SURVEY.md §7):
+
+* The reference re-*builds and interprets* a pyll posterior graph every
+  suggest call (``build_posterior`` + ``rec_eval``), walking Python nodes per
+  hyperparameter.  Here the whole suggest step is **one jitted XLA program**
+  over the dense trial history (``Trials.history``): γ-split by ranked sort,
+  Parzen fits ``vmap``ed over hyperparameter columns, candidate scoring as a
+  single ``[n_cand, K]`` batched logsumexp per column (``ops.gmm``).
+* Dynamic history sizes are bucketed to powers of two and padded
+  (zero-weight mixture components), so recompilation is O(log N) over a whole
+  run instead of per-trial ragged shapes.
+* Conditional (``hp.choice``) subspaces use the dense activity mask from
+  ``CompiledSpace`` instead of ragged idxs/vals: a parameter's observation
+  set is ``mask & split`` — no Python bookkeeping in the hot path.
+* Candidate batches are embarrassingly shardable: ``parallel`` runs this
+  same kernel with the candidate axis sharded over a device mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import base, rand
+from .ops import (
+    fit_parzen,
+    forgetting_weights,
+    gmm_log_qmass,
+    gmm_logpdf,
+    gmm_sample,
+)
+from .space import (
+    CATEGORICAL,
+    LOGNORMAL,
+    LOGUNIFORM,
+    QLOGNORMAL,
+    QLOGUNIFORM,
+    QNORMAL,
+    QUNIFORM,
+    RANDINT,
+    UNIFORM,
+    UNIFORMINT,
+    CompiledSpace,
+)
+
+_default_prior_weight = 1.0
+_default_n_startup_jobs = 20
+_default_n_EI_candidates = 24
+_default_gamma = 0.25
+_default_linear_forgetting = 25
+
+_TINY = 1e-12
+_LOG_KINDS = (LOGUNIFORM, QLOGUNIFORM, LOGNORMAL, QLOGNORMAL)
+
+
+class _ContGroup:
+    """Static compile-time arrays for one group of continuous columns.
+
+    ``is_q`` distinguishes the two scoring paths (density vs quantized mass);
+    it is uniform within a group so the jitted code branches at trace time.
+    """
+
+    def __init__(self, specs, is_q):
+        self.is_q = is_q
+        self.pids = np.asarray([s.pid for s in specs], np.int32)
+        n = len(specs)
+        self.is_log = np.zeros(n, bool)
+        self.q = np.zeros(n, np.float32)
+        self.fit_lo = np.full(n, -np.inf, np.float32)
+        self.fit_hi = np.full(n, np.inf, np.float32)
+        self.prior_mu = np.zeros(n, np.float32)
+        self.prior_sigma = np.ones(n, np.float32)
+        self.clip_lo = np.full(n, -np.inf, np.float32)
+        self.clip_hi = np.full(n, np.inf, np.float32)
+        for i, s in enumerate(specs):
+            self.is_log[i] = s.kind in _LOG_KINDS
+            if s.q:
+                self.q[i] = s.q
+            if s.kind in (UNIFORM, LOGUNIFORM, QUNIFORM, QLOGUNIFORM):
+                lo, hi = s.low, s.high  # log kinds: DSL bounds are log-space
+            elif s.kind == UNIFORMINT:
+                lo, hi = s.low - 0.5, s.high + 0.5
+                self.q[i] = 1.0
+                self.clip_lo[i], self.clip_hi[i] = s.low, s.high
+            elif s.kind == RANDINT:
+                # Wide randint (no dense per-option logits): treated as a
+                # quantized uniform over the integer lattice [low, high).
+                lo, hi = s.low - 0.5, s.high - 0.5
+                self.q[i] = 1.0
+                self.clip_lo[i], self.clip_hi[i] = s.low, s.high - 1
+            else:
+                # Normal family: unbounded; prior is (mu, sigma) in fit space
+                # (reference: ap_normal_sampler and log/q variants).
+                self.prior_mu[i] = s.mu
+                self.prior_sigma[i] = s.sigma
+                continue
+            self.fit_lo[i], self.fit_hi[i] = lo, hi
+            # Reference ap_uniform_sampler prior: mid-point mean, full-width
+            # sigma (tpe.py::adaptive_parzen_normal call sites).
+            self.prior_mu[i] = 0.5 * (lo + hi)
+            self.prior_sigma[i] = hi - lo
+
+    def __len__(self):
+        return len(self.pids)
+
+
+class _TpeKernel:
+    """One jitted TPE suggest step for a fixed (space, N-bucket, n_cand, LF).
+
+    Call signature (all device work, one XLA program):
+      ``(key, vals[N,P], active[N,P], loss[N], ok[N], gamma, prior_weight)
+      -> (best_vals[P], best_active[P])``
+    """
+
+    def __init__(self, cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
+                 split: str = "sqrt"):
+        self.cs = cs
+        self.n_cap = n_cap
+        self.n_cand = n_cand
+        self.lf = lf
+        if split not in ("sqrt", "quantile"):
+            raise ValueError(f"split must be 'sqrt' or 'quantile', got {split!r}")
+        self.split = split
+
+        cont_q, cont_n, cat = [], [], []
+        for s in cs.params:
+            if s.kind == CATEGORICAL or (s.kind == RANDINT
+                                         and s.probs is not None):
+                cat.append(s)
+            elif s.kind in (QUNIFORM, QLOGUNIFORM, QNORMAL, QLOGNORMAL,
+                            UNIFORMINT, RANDINT):
+                cont_q.append(s)
+            else:
+                cont_n.append(s)
+        self.groups = [g for g in (_ContGroup(cont_n, is_q=False),
+                                   _ContGroup(cont_q, is_q=True)) if len(g)]
+        self.cat_pids = np.asarray([s.pid for s in cat], np.int32)
+        self.cat_kmax = max([s.n_options for s in cat], default=1)
+        priors = np.zeros((len(cat), self.cat_kmax), np.float32)
+        offsets = np.zeros(len(cat), np.float32)
+        for i, s in enumerate(cat):
+            priors[i, : s.n_options] = s.probs
+            if s.kind == RANDINT:
+                offsets[i] = s.low
+        self.cat_priors = priors
+        self.cat_offsets = offsets
+
+        self._fn = jax.jit(self._suggest_one)
+
+    # -- sharding hook -------------------------------------------------------
+
+    # Candidate-axis scoring is embarrassingly parallel; subclasses
+    # (parallel.ShardedTpeKernel) constrain these arrays onto a device mesh
+    # and let XLA insert the collectives (argmax reduce rides ICI).
+    def _constrain_cand(self, x, axis=-1):
+        """Hook: apply a sharding constraint to an array whose ``axis`` is
+        the candidate axis.  Identity for the single-device kernel."""
+        return x
+
+    # Score chunking: the above-model lpdf broadcast is [C, n_cand, N+1];
+    # for 100k-candidate sweeps that is tens of GB if materialized, so the
+    # candidate axis is processed in lax.map chunks beyond this threshold.
+    score_chunk = 4096
+
+    def _chunked_score(self, score_fn, arrs):
+        n_cand = arrs[0].shape[-1]
+        if n_cand <= self.score_chunk:
+            return score_fn(*arrs)
+        chunk = self.score_chunk
+        n_pad = (-n_cand) % chunk
+        padded = [jnp.pad(a, ((0, 0), (0, n_pad)), mode="edge") for a in arrs]
+        stacked = tuple(
+            a.reshape(a.shape[0], -1, chunk).transpose(1, 0, 2)
+            for a in padded)                                  # [B, C, chunk]
+        out = jax.lax.map(lambda xs: score_fn(*xs), stacked)
+        c = out.shape[1]
+        return out.transpose(1, 0, 2).reshape(c, -1)[:, :n_cand]
+
+    # -- shared helpers ------------------------------------------------------
+
+    def _split(self, loss, ok, gamma):
+        """γ-split by ranked loss.
+
+        ``split='sqrt'`` (default, reference parity: tpe.py::ap_split_trials)
+        uses ``n_below = min(ceil(gamma·sqrt(N)), LF)`` — a deliberately tiny
+        below set that keeps early TPE exploratory.  ``split='quantile'`` is
+        the TPE-paper γ-quantile ``n_below = min(ceil(gamma·N), LF)``, which
+        concentrates much faster on low-dimensional problems ("beat the
+        reference" mode)."""
+        n_ok = jnp.sum(ok)
+        n_f = n_ok.astype(jnp.float32)
+        if self.split == "sqrt":
+            n_below = jnp.ceil(gamma * jnp.sqrt(n_f))
+        else:
+            n_below = jnp.ceil(gamma * n_f)
+        n_below = jnp.minimum(n_below.astype(jnp.int32),
+                              jnp.minimum(self.lf, n_ok))
+        # Stable double-argsort rank: ok trials occupy ranks [0, n_ok).
+        rank = jnp.argsort(jnp.argsort(loss))
+        below = ok & (rank < n_below)
+        above = ok & (rank >= n_below)
+        return below, above
+
+    def _set_weights(self, set_mask, act):
+        """Per-column observation weights for one split set.
+
+        ``set_mask[N] & act[N, C]`` selects the observations; weights are
+        linear-forgetting by recency rank within the set (rows are in trial
+        order), zero elsewhere.  Returns (mask, weights, n_set)."""
+        m = set_mask[:, None] & act
+        n_set = jnp.sum(m, axis=0)
+        rank_in = jnp.cumsum(m, axis=0) - 1
+        w = forgetting_weights(rank_in, n_set[None, :], self.lf)
+        return m, jnp.where(m, w, 0.0), n_set
+
+    # -- continuous columns --------------------------------------------------
+
+    def _cont_best(self, g: _ContGroup, key, vals, active, below, above,
+                   prior_weight):
+        z = vals[:, g.pids]
+        z = jnp.where(g.is_log, jnp.log(jnp.maximum(z, _TINY)), z)
+        act = active[:, g.pids]
+        c = len(g)
+
+        def models(set_mask, cap):
+            m, w, n_set = self._set_weights(set_mask, act)
+            x = jnp.where(m, z, jnp.inf)
+            fit = jax.vmap(partial(fit_parzen, out_cap=cap),
+                           in_axes=(1, 1, 0, 0, 0, None))
+            return fit(x, w, n_set, jnp.asarray(g.prior_mu),
+                       jnp.asarray(g.prior_sigma), prior_weight)
+
+        # Below mixtures are small (≤ LF+1 components, and never more than
+        # the history bucket holds); above mixtures span the full bucketed
+        # history — that [n_cand, N+1] broadcast is the dominant FLOP block
+        # of the step.
+        wb, mub, sgb = models(below, min(self.lf, self.n_cap) + 1)
+        wa, mua, sga = models(above, self.n_cap + 1)
+        lwb, lwa = jnp.log(wb), jnp.log(wa)
+
+        keys = jax.random.split(key, c)
+        fit_lo = jnp.asarray(g.fit_lo)
+        fit_hi = jnp.asarray(g.fit_hi)
+        zc = jax.vmap(
+            lambda k, lw, mu, sg, lo, hi:
+            gmm_sample(k, lw, mu, sg, lo, hi, self.n_cand)
+        )(keys, lwb, mub, sgb, fit_lo, fit_hi)              # [C, n_cand]
+        zc = self._constrain_cand(zc)
+
+        x_nat = jnp.where(g.is_log[:, None], jnp.exp(zc), zc)
+        if g.is_q:
+            q = jnp.asarray(g.q)[:, None]
+            v = jnp.round(x_nat / q) * q
+            v = jnp.clip(v, jnp.asarray(g.clip_lo)[:, None],
+                         jnp.asarray(g.clip_hi)[:, None])
+            el, eh = v - 0.5 * q, v + 0.5 * q
+            is_log = g.is_log[:, None]
+            zl = jnp.where(is_log,
+                           jnp.where(el > 0,
+                                     jnp.log(jnp.maximum(el, _TINY)),
+                                     -jnp.inf),
+                           el)
+            zh = jnp.where(is_log, jnp.log(jnp.maximum(eh, _TINY)), eh)
+
+            def ei_q(zl_, zh_):
+                sb = jax.vmap(gmm_log_qmass, in_axes=(0,) * 7)
+                return (sb(zl_, zh_, lwb, mub, sgb, fit_lo, fit_hi)
+                        - sb(zl_, zh_, lwa, mua, sga, fit_lo, fit_hi))
+
+            ei = self._chunked_score(ei_q, (zl, zh))
+        else:
+            v = x_nat
+
+            def ei_n(z_):
+                sb = jax.vmap(gmm_logpdf, in_axes=(0,) * 6)
+                return (sb(z_, lwb, mub, sgb, fit_lo, fit_hi)
+                        - sb(z_, lwa, mua, sga, fit_lo, fit_hi))
+
+            ei = self._chunked_score(ei_n, (zc,))
+
+        # EI surrogate & per-column winner (reference: broadcast_best).
+        bi = jnp.argmax(ei, axis=1)
+        return v[jnp.arange(c), bi]
+
+    # -- categorical columns -------------------------------------------------
+
+    def _cat_best(self, key, vals, active, below, above, prior_weight):
+        d = len(self.cat_pids)
+        kmax = self.cat_kmax
+        idx = vals[:, self.cat_pids] - self.cat_offsets    # [N, D]
+        act = active[:, self.cat_pids]
+        onehot = (idx[:, :, None] ==
+                  jnp.arange(kmax, dtype=jnp.float32)[None, None, :])
+
+        def log_post(set_mask):
+            # Weighted counts + prior pseudocounts (reference:
+            # tpe.py::ap_categorical_sampler — bincount with forgetting
+            # weights, prior-smoothed by prior_weight·p·sqrt(1+N)).
+            m, w, n_set = self._set_weights(set_mask, act)
+            counts = jnp.einsum("nd,ndk->dk", w,
+                                onehot.astype(jnp.float32))
+            strength = prior_weight * jnp.sqrt(1.0 + n_set.astype(jnp.float32))
+            pseudo = counts + jnp.asarray(self.cat_priors) * strength[:, None]
+            return jnp.log(pseudo / jnp.sum(pseudo, axis=1, keepdims=True))
+
+        lpb = log_post(below)
+        lpa = log_post(above)
+        g = self._constrain_cand(
+            jax.random.gumbel(key, (d, self.n_cand, kmax),
+                              dtype=jnp.float32), axis=1)
+        cand = jnp.argmax(lpb[:, None, :] + g, axis=-1)    # [D, n_cand]
+        score = (jnp.take_along_axis(lpb, cand, axis=1)
+                 - jnp.take_along_axis(lpa, cand, axis=1))
+        bi = jnp.argmax(score, axis=1)
+        best = cand[jnp.arange(d), bi].astype(jnp.float32)
+        return best + self.cat_offsets
+
+    # -- the step ------------------------------------------------------------
+
+    def _suggest_one(self, key, vals, active, loss, ok, gamma, prior_weight):
+        below, above = self._split(loss, ok, gamma)
+        row = jnp.zeros((self.cs.n_params,), jnp.float32)
+        k_cat, *k_cont = jax.random.split(key, 1 + len(self.groups))
+        for g, kg in zip(self.groups, k_cont):
+            row = row.at[jnp.asarray(g.pids)].set(
+                self._cont_best(g, kg, vals, active, below, above,
+                                prior_weight))
+        if len(self.cat_pids):
+            row = row.at[jnp.asarray(self.cat_pids)].set(
+                self._cat_best(k_cat, vals, active, below, above,
+                               prior_weight))
+        act_row = self.cs.active_mask(row[None, :])[0]
+        return row, act_row
+
+    def __call__(self, key, vals, active, loss, ok, gamma, prior_weight):
+        return self._fn(key, vals, active, loss, ok,
+                        jnp.float32(gamma), jnp.float32(prior_weight))
+
+
+# ---------------------------------------------------------------------------
+# kernel cache & history padding
+# ---------------------------------------------------------------------------
+
+
+def _bucket(n: int) -> int:
+    """Power-of-two history capacity (min 32) — bounds recompiles to O(log N)."""
+    return max(32, 1 << max(n - 1, 1).bit_length())
+
+
+def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
+               split: str = "sqrt") -> _TpeKernel:
+    cache = getattr(cs, "_tpe_kernels", None)
+    if cache is None:
+        cache = cs._tpe_kernels = {}
+    k = (n_cap, n_cand, lf, split)
+    if k not in cache:
+        cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split)
+    return cache[k]
+
+
+def _padded_history(h, n_cap):
+    n, p = h["vals"].shape
+    vals = np.zeros((n_cap, p), np.float32)
+    active = np.zeros((n_cap, p), bool)
+    loss = np.full((n_cap,), np.inf, np.float32)
+    ok = np.zeros((n_cap,), bool)
+    vals[:n] = h["vals"]
+    active[:n] = h["active"]
+    loss[:n] = h["loss"]
+    ok[:n] = h["ok"]
+    return vals, active, loss, ok
+
+
+# ---------------------------------------------------------------------------
+# public suggest API (the `algo=` plugin boundary)
+# ---------------------------------------------------------------------------
+
+
+def suggest(new_ids, domain, trials, seed,
+            prior_weight=_default_prior_weight,
+            n_startup_jobs=_default_n_startup_jobs,
+            n_EI_candidates=_default_n_EI_candidates,
+            gamma=_default_gamma,
+            linear_forgetting=_default_linear_forgetting,
+            split="sqrt",
+            verbose=True):
+    """TPE suggest (reference signature: ``hyperopt/tpe.py::suggest`` ~L800).
+
+    Bind hyperparameters with ``functools.partial(tpe.suggest, gamma=...)``
+    exactly like the reference.  ``split='quantile'`` opts into the
+    TPE-paper γ-quantile below-set (faster concentration than the
+    reference's ``gamma·sqrt(N)``); see :func:`suggest_quantile`.
+    """
+    vals, active = suggest_batch(
+        new_ids, domain, trials, seed, prior_weight=prior_weight,
+        n_startup_jobs=n_startup_jobs, n_EI_candidates=n_EI_candidates,
+        gamma=gamma, linear_forgetting=linear_forgetting, split=split)
+    return base.docs_from_samples(domain.cs, new_ids, vals, active,
+                                  exp_key=getattr(trials, "exp_key", None))
+
+
+def suggest_batch(new_ids, domain, trials, seed,
+                  prior_weight=_default_prior_weight,
+                  n_startup_jobs=_default_n_startup_jobs,
+                  n_EI_candidates=_default_n_EI_candidates,
+                  gamma=_default_gamma,
+                  linear_forgetting=_default_linear_forgetting,
+                  split="sqrt"):
+    """Raw (vals[n, P], active[n, P]) suggestions without doc packaging."""
+    cs = domain.cs
+    n = len(new_ids)
+    if n == 0 or cs.n_params == 0:
+        return (np.zeros((n, cs.n_params), np.float32),
+                np.ones((n, cs.n_params), bool))
+    h = trials.history(cs)
+    if int(h["ok"].sum()) < n_startup_jobs:
+        v, a = rand.suggest_batch(new_ids, domain, trials, seed)
+        return np.asarray(v), np.asarray(a)
+    kern = get_kernel(cs, _bucket(h["vals"].shape[0]),
+                      int(n_EI_candidates), int(linear_forgetting), split)
+    hv, ha, hl, hok = _padded_history(h, kern.n_cap)
+    key = jax.random.key(int(seed) % (2 ** 32))
+    rows, acts = [], []
+    for i in range(n):
+        r, a = kern(jax.random.fold_in(key, i), hv, ha, hl, hok,
+                    gamma, prior_weight)
+        rows.append(np.asarray(r))
+        acts.append(np.asarray(a))
+    return np.stack(rows), np.stack(acts)
+
+
+def suggest_quantile(new_ids, domain, trials, seed, **kwargs):
+    """TPE with the TPE-paper γ-quantile split (``n_below = ceil(gamma·N)``,
+    capped at ``linear_forgetting``) — concentrates markedly faster than the
+    reference's ``gamma·sqrt(N)`` schedule on low-dimensional problems while
+    keeping every other reference semantic.  The "beat the baseline" default.
+    """
+    kwargs.setdefault("split", "quantile")
+    return suggest(new_ids, domain, trials, seed, **kwargs)

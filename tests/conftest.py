@@ -5,17 +5,30 @@ TPU slices by running on 8 virtual CPU devices, per the reference's norm of
 real-but-local backends (SURVEY.md §4: TempMongo spawns a real mongod; here a
 real XLA CPU client with 8 devices plays that role).
 
+Running tests on the real TPU would also serialize the whole suite behind a
+single tunneled chip (and contend with benchmarks), so the CPU platform is
+forced *hard*: the environment's sitecustomize force-selects its accelerator
+plugin via ``jax.config`` (which beats the JAX_PLATFORMS env var), so the
+config itself is overridden back to cpu before any backend initialization.
+
 This must run before the first ``import jax`` anywhere in the test session,
 which is why it lives at the top of conftest.py.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize may have force-selected an accelerator
+# plugin via jax.config (which beats the env var); undo it before any
+# backend initialization.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

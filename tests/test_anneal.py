@@ -1,0 +1,69 @@
+"""Annealing + mixture suggest tests (reference: tests/test_anneal.py —
+run suggest on zoo domains, assert convergence/shape invariants)."""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, anneal, fmin, mix, partial, rand, tpe
+
+from zoo import ZOO
+
+
+def _run(name, algo, seed, max_evals=None):
+    z = ZOO[name]
+    t = Trials()
+    fmin(z.fn, z.space, algo=algo, max_evals=max_evals or z.budget,
+         trials=t, rstate=np.random.default_rng(seed),
+         show_progressbar=False)
+    return t
+
+
+class TestAnneal:
+    @pytest.mark.parametrize("name", ["quadratic1", "branin", "q1_choice"])
+    def test_converges(self, name):
+        z = ZOO[name]
+        best = np.median([
+            _run(name, anneal.suggest, s).best_trial["result"]["loss"]
+            for s in (0, 1, 2)])
+        assert best <= z.rand_thresh, best
+
+    def test_shrinks_toward_incumbent(self):
+        # After many trials the neighborhood is small: late suggestions
+        # cluster near the best observed x.
+        t = _run("quadratic1", anneal.suggest, 0, max_evals=80)
+        xs = [d["misc"]["vals"]["x"][0] for d in t.trials]
+        late = np.asarray(xs[60:])
+        assert np.abs(late - 3.0).mean() < np.abs(np.asarray(xs[:20]) - 3.0).mean()
+
+    def test_conditional_space_docs_valid(self):
+        t = _run("gauss_wave2", anneal.suggest, 0, max_evals=40)
+        for doc in t:
+            vals = doc["misc"]["vals"]
+            if vals["curve"][0] == 0:
+                assert vals["amp"] == []
+            else:
+                assert len(vals["amp"]) == 1
+
+    def test_mixed_dists_run(self):
+        t = _run("many_dists", anneal.suggest, 0, max_evals=30)
+        assert len(t) == 30
+        assert t.best_trial["result"]["loss"] is not None
+
+
+class TestMix:
+    def test_routes_between_algos(self):
+        algo = partial(mix.suggest, p_suggest=[(0.5, rand.suggest),
+                                               (0.5, anneal.suggest)])
+        t = _run("quadratic1", algo, 0, max_evals=40)
+        assert len(t) == 40
+
+    def test_probability_validation(self):
+        algo = partial(mix.suggest, p_suggest=[(0.5, rand.suggest)])
+        with pytest.raises(ValueError):
+            _run("quadratic1", algo, 0, max_evals=5)
+
+    def test_epsilon_greedy_tpe(self):
+        algo = partial(mix.suggest, p_suggest=[(0.2, rand.suggest),
+                                               (0.8, tpe.suggest)])
+        t = _run("quadratic1", algo, 1, max_evals=60)
+        assert t.best_trial["result"]["loss"] <= ZOO["quadratic1"].rand_thresh

@@ -1,0 +1,225 @@
+"""Sharded/multi-start suggest + file-store distributed backend tests.
+
+Reference test norms (SURVEY.md §4): *real-but-local* backends — the Mongo
+tests spawn a real mongod and run real workers against it.  Here the 8-device
+virtual CPU mesh (conftest) plays the slice's role for sharding tests, and
+real FileWorker instances (threads sharing one store directory, plus a
+subprocess for the CLI path) play the elastic-worker role.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from hyperopt_tpu import JOB_STATE_DONE, JOB_STATE_NEW, Trials, fmin, hp, rand
+from hyperopt_tpu.base import Domain
+from hyperopt_tpu.parallel import (
+    FileTrials,
+    FileWorker,
+    default_mesh,
+    multi_start_suggest,
+    sharded_suggest,
+)
+from hyperopt_tpu.parallel.sharded import CAND_AXIS, START_AXIS
+
+from zoo import ZOO
+
+
+def _quad_space():
+    return {"x": hp.uniform("x", -5, 5)}
+
+
+def _quad(d):
+    return (d["x"] - 3.0) ** 2
+
+
+class TestShardedSuggest:
+    def test_8way_candidate_sharding(self):
+        assert len(jax.devices()) == 8, "conftest should force 8 CPU devices"
+        mesh = default_mesh(n_starts=1)
+        assert mesh.shape[CAND_AXIS] == 8
+        from functools import partial
+        t = Trials()
+        fmin(_quad, _quad_space(),
+             algo=partial(sharded_suggest, mesh=mesh, n_EI_candidates=512),
+             max_evals=40, trials=t, rstate=np.random.default_rng(0),
+             show_progressbar=False)
+        assert t.best_trial["result"]["loss"] < ZOO["quadratic1"].rand_thresh
+
+    def test_rejects_indivisible_candidates(self):
+        mesh = default_mesh(n_starts=1)
+        from functools import partial
+        t = Trials()
+        with pytest.raises(ValueError, match="divisible"):
+            fmin(_quad, _quad_space(),
+                 algo=partial(sharded_suggest, mesh=mesh,
+                              n_EI_candidates=100),
+                 max_evals=25, trials=t, rstate=np.random.default_rng(0),
+                 show_progressbar=False)
+
+    def test_2d_mesh(self):
+        # dp=2 starts × sp=4 candidate shards.
+        mesh = default_mesh(n_starts=2)
+        assert mesh.shape == {START_AXIS: 2, CAND_AXIS: 4}
+
+
+class TestMultiStart:
+    def test_k_distinct_proposals_one_call(self):
+        mesh = Mesh(np.asarray(jax.devices()), (START_AXIS,))
+        from functools import partial
+        t = Trials()
+        fmin(_quad, _quad_space(),
+             algo=partial(multi_start_suggest, mesh=mesh),
+             max_evals=48, max_queue_len=8, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert len(t) == 48
+        # The 8 proposals of one post-startup batch are distinct.
+        xs = [d["misc"]["vals"]["x"][0] for d in t.trials[40:48]]
+        assert len(set(xs)) == len(xs)
+        assert t.best_trial["result"]["loss"] < 0.5
+
+
+class TestFileStore:
+    def test_workers_drain_queue(self, tmp_path):
+        root = str(tmp_path)
+        dom = Domain(_quad, _quad_space())
+        ft = FileTrials(root, exp_key="e1")
+        workers = [FileWorker(root, exp_key="e1", domain=dom,
+                              poll_interval=0.01, reserve_timeout=5)
+                   for _ in range(3)]
+        threads = [threading.Thread(target=w.run) for w in workers]
+        for th in threads:
+            th.start()
+        fmin(_quad, _quad_space(), algo=rand.suggest, max_evals=24,
+             trials=ft, rstate=np.random.default_rng(0),
+             show_progressbar=False)
+        for th in threads:
+            th.join()
+        ft.refresh()
+        assert len(ft) == 24
+        assert all(d["state"] == JOB_STATE_DONE for d in ft)
+        # every evaluated trial carries an owner stamp
+        assert all(d["owner"] for d in ft)
+
+    def test_atomic_claim_no_double_evaluation(self, tmp_path):
+        # Many workers, few jobs: each job must be evaluated exactly once.
+        root = str(tmp_path)
+        dom = Domain(_quad, _quad_space())
+        ft = FileTrials(root, exp_key="e1")
+        docs = rand.suggest(ft.new_trial_ids(10), dom, ft, 0)
+        ft.insert_trial_docs(docs)
+        counts = {}
+        lock = threading.Lock()
+
+        class CountingWorker(FileWorker):
+            def run_one(self):
+                doc = self.trials.reserve(self.owner)
+                if doc is None:
+                    return False
+                with lock:
+                    counts[doc["tid"]] = counts.get(doc["tid"], 0) + 1
+                doc["state"] = JOB_STATE_DONE
+                doc["result"] = {"status": "ok", "loss": 1.0}
+                self.trials.write_result(doc, owner=self.owner)
+                return True
+
+        ws = [CountingWorker(root, exp_key="e1", domain=dom,
+                             poll_interval=0.005, reserve_timeout=1)
+              for _ in range(6)]
+        threads = [threading.Thread(target=w.run) for w in ws]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert sorted(counts) == list(range(10))
+        assert all(c == 1 for c in counts.values()), counts
+
+    def test_requeue_stale_and_ownership_fencing(self, tmp_path):
+        root = str(tmp_path)
+        dom = Domain(_quad, _quad_space())
+        ft = FileTrials(root, exp_key="e1")
+        docs = rand.suggest(ft.new_trial_ids(1), dom, ft, 0)
+        ft.insert_trial_docs(docs)
+        # Worker A claims, then "crashes" (no heartbeat).
+        a = FileWorker(root, exp_key="e1", domain=dom)
+        doc_a = a.trials.reserve(a.owner)
+        assert doc_a is not None
+        time.sleep(0.1)
+        assert ft.requeue_stale(timeout=0.05) == 1
+        ft.refresh()
+        assert ft.trials[0]["state"] == JOB_STATE_NEW
+        # Worker B claims the requeued job and finishes it.
+        b = FileWorker(root, exp_key="e1", domain=dom)
+        assert b.run_one() is True
+        # A's late write must be rejected.
+        doc_a["state"] = JOB_STATE_DONE
+        doc_a["result"] = {"status": "ok", "loss": 999.0}
+        assert a.trials.write_result(doc_a, owner=a.owner) is False
+        ft.refresh()
+        assert ft.trials[0]["result"]["loss"] != 999.0
+
+    def test_worker_failure_isolation(self, tmp_path):
+        # A raising objective marks trials ERROR; worker survives until
+        # max_consecutive_failures then exits.
+        root = str(tmp_path)
+
+        def boom(d):
+            raise RuntimeError("boom")
+
+        dom = Domain(boom, _quad_space())
+        ft = FileTrials(root, exp_key="e1")
+        docs = rand.suggest(ft.new_trial_ids(5), dom, ft, 0)
+        ft.insert_trial_docs(docs)
+        w = FileWorker(root, exp_key="e1", domain=dom, poll_interval=0.01,
+                       reserve_timeout=0.5, max_consecutive_failures=3)
+        n = w.run()
+        assert n == 0
+        ft.refresh()
+        from hyperopt_tpu import JOB_STATE_ERROR
+        assert sum(1 for d in ft if d["state"] == JOB_STATE_ERROR) == 3
+
+    def test_cli_worker_subprocess(self, tmp_path):
+        # The console entry point evaluates jobs from a pickled domain
+        # (mongoexp's hyperopt-mongo-worker path, SURVEY.md §3.4).
+        root = str(tmp_path)
+        dom = Domain(_quad, _quad_space())  # module-level fn: picklable
+        ft = FileTrials(root, exp_key="e1")
+        ft.save_domain(dom)
+        docs = rand.suggest(ft.new_trial_ids(4), dom, ft, 0)
+        ft.insert_trial_docs(docs)
+        # PYTHONPATH must cover both the package and this test module:
+        # the pickled Domain references _quad by module ('test_parallel').
+        repo = os.path.dirname(os.path.dirname(__file__))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="",
+                   PYTHONPATH=f"{repo}:{os.path.dirname(__file__)}")
+        proc = subprocess.run(
+            [sys.executable, "-m", "hyperopt_tpu.parallel.filestore",
+             "--root", root, "--exp-key", "e1", "--reserve-timeout", "2",
+             "--poll-interval", "0.05"],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        ft.refresh()
+        assert all(d["state"] == JOB_STATE_DONE for d in ft)
+
+    def test_resume_by_exp_key(self, tmp_path):
+        root = str(tmp_path)
+        dom = Domain(_quad, _quad_space())
+        ft = FileTrials(root, exp_key="e1")
+        docs = rand.suggest(ft.new_trial_ids(3), dom, ft, 0)
+        ft.insert_trial_docs(docs)
+        # A fresh handle on the same store sees the same experiment;
+        # tid allocation continues without collision.
+        ft2 = FileTrials(root, exp_key="e1")
+        assert len(ft2._dynamic_trials) == 3
+        assert ft2.new_trial_ids(2) == [3, 4]
+        # Different exp_key is isolated.
+        other = FileTrials(root, exp_key="e2")
+        assert len(other) == 0

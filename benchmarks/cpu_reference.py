@@ -1,0 +1,112 @@
+"""CPU-reference TPE suggest step: interpreted numpy, reference-style.
+
+This mirrors the computational shape of upstream hyperopt's suggest path
+(SURVEY.md §3.2 / §6): a Python loop over hyperparameters, per-parameter
+numpy array math for the adaptive-Parzen fit, candidate sampling and
+GMM-lpdf EI scoring — i.e. per-node interpretation, no fusion, no batching
+across parameters.  It is the denominator for the north star's "≥100× CPU
+``tpe.suggest``" claim (upstream itself is not installable here — no
+network, SURVEY.md Provenance) and a second conformance oracle for the XLA
+kernels.
+
+Deliberately NOT optimized beyond what numpy gives for free — that is the
+point of the comparison.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy import stats
+
+
+def forgetting_weights(n, lf):
+    if n == 0:
+        return np.zeros(0)
+    if n <= lf:
+        return np.ones(n)
+    return np.concatenate([np.linspace(1.0 / n, 1.0, n - lf), np.ones(lf)])
+
+
+def adaptive_parzen(mus, weights, prior_mu, prior_sigma, prior_weight):
+    """Reference-style Parzen fit (tpe.py::adaptive_parzen_normal shape)."""
+    n = len(mus)
+    order = np.argsort(mus)
+    prior_pos = int(np.searchsorted(mus[order], prior_mu))
+    srtd_mus = np.insert(mus[order], prior_pos, prior_mu)
+    srtd_w = np.insert(weights[order], prior_pos, prior_weight)
+    sigma = np.zeros_like(srtd_mus)
+    if n == 0:
+        sigma[:] = prior_sigma
+    elif n == 1:
+        sigma[:] = prior_sigma * 0.5
+    else:
+        sigma[1:-1] = np.maximum(srtd_mus[1:-1] - srtd_mus[:-2],
+                                 srtd_mus[2:] - srtd_mus[1:-1])
+        sigma[0] = srtd_mus[1] - srtd_mus[0]
+        sigma[-1] = srtd_mus[-1] - srtd_mus[-2]
+    maxsigma = prior_sigma
+    minsigma = prior_sigma / min(100.0, 1.0 + len(srtd_mus))
+    sigma = np.clip(sigma, minsigma, maxsigma)
+    sigma[prior_pos] = prior_sigma
+    srtd_w = srtd_w / srtd_w.sum()
+    return srtd_w, srtd_mus, sigma
+
+
+def gmm_lpdf(x, w, mu, sigma, lo=-np.inf, hi=np.inf):
+    """Truncated-GMM log-pdf, global renormalization (GMM1_lpdf shape)."""
+    mass = w * (stats.norm.cdf(hi, mu, sigma) - stats.norm.cdf(lo, mu, sigma))
+    p = np.zeros_like(x, dtype=float)
+    for wk, mk, sk in zip(w, mu, sigma):      # per-component, per the
+        p += wk * stats.norm.pdf(x, mk, sk)   # interpreted style
+    with np.errstate(divide="ignore"):
+        out = np.log(p) - np.log(mass.sum())
+    out[(x < lo) | (x > hi)] = -np.inf
+    return out
+
+
+def gmm_sample(rng, w, mu, sigma, lo, hi, n):
+    """Rejection sampling, like the reference's GMM1."""
+    out = []
+    while len(out) < n:
+        k = rng.choice(len(w), p=w / w.sum())
+        draw = rng.normal(mu[k], sigma[k])
+        if lo <= draw <= hi:
+            out.append(draw)
+    return np.asarray(out)
+
+
+def suggest_step(vals, active, loss, ok, bounds, n_cand=24, gamma=0.25,
+                 lf=25, prior_weight=1.0, seed=0):
+    """One full CPU suggest step over continuous uniform columns.
+
+    vals/active: [N, P]; bounds: [(lo, hi)] * P.  Returns best value per
+    column.  Python-loops over parameters like the reference's per-node
+    posterior build + rec_eval.
+    """
+    rng = np.random.default_rng(seed)
+    n_ok = int(ok.sum())
+    n_below = min(int(np.ceil(gamma * np.sqrt(n_ok))), lf, n_ok)
+    order = np.argsort(np.where(ok, loss, np.inf))
+    below_rows = set(order[:n_below].tolist())
+    best = np.zeros(vals.shape[1])
+    for p in range(vals.shape[1]):
+        lo, hi = bounds[p]
+        prior_mu, prior_sigma = 0.5 * (lo + hi), hi - lo
+        rows = np.nonzero(active[:, p] & ok)[0]
+        b_rows = np.asarray([r for r in rows if r in below_rows], dtype=int)
+        a_rows = np.asarray([r for r in rows if r not in below_rows],
+                            dtype=int)
+
+        def fit(rws):
+            obs = vals[rws, p]
+            w = forgetting_weights(len(obs), lf)
+            return adaptive_parzen(obs, w, prior_mu, prior_sigma,
+                                   prior_weight)
+
+        bw, bmu, bsg = fit(b_rows)
+        aw, amu, asg = fit(a_rows)
+        cand = gmm_sample(rng, bw, bmu, bsg, lo, hi, n_cand)
+        ei = gmm_lpdf(cand, bw, bmu, bsg, lo, hi) \
+            - gmm_lpdf(cand, aw, amu, asg, lo, hi)
+        best[p] = cand[int(np.argmax(ei))]
+    return best

@@ -1,0 +1,197 @@
+"""Benchmark suite: the five BASELINE.md configs + CPU-reference comparison.
+
+Each benchmark prints one JSON line; ``python benchmarks/suite.py`` runs all
+and a trailing summary.  The repo-root ``bench.py`` (the driver's hook) runs
+only the headline metric.
+
+Configs (BASELINE.md / BASELINE.json):
+  1. tpe.suggest on 2-dim Branin, 200 trials           — end-to-end fmin
+  2. batched TPE, 1k candidates, 20-dim Rosenbrock      — single-chip vmap
+  3. 50-dim mixed uniform/loguniform/choice space       — suggest latency
+  4. multi-start TPE across the device mesh             — 8 posteriors/step
+  5. 100-dim space, 100k-candidate EI sweep per step    — the long axis
+plus:
+  0. CPU-reference interpreted-numpy suggest step       — the ≥100× denominator
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+import time
+
+import numpy as np
+
+
+def _emit(name, value, unit, extra=None):
+    rec = {"metric": name, "value": round(float(value), 4), "unit": unit}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def _flagship(n_dims):
+    from __graft_entry__ import _flagship_space
+
+    return _flagship_space(n_dims)
+
+
+def _suggest_latency(n_dims, n_cand, n_hist, reps=10):
+    import jax
+
+    from hyperopt_tpu.space import compile_space
+    from hyperopt_tpu.tpe import _bucket, _padded_history, get_kernel
+    from __graft_entry__ import _history
+
+    cs = compile_space(_flagship(n_dims))
+    kern = get_kernel(cs, _bucket(n_hist), n_cand, 25)
+    hv, ha, hl, hok = _padded_history(_history(cs, n_hist), kern.n_cap)
+    key = jax.random.key(0)
+    out = kern(key, hv, ha, hl, hok, 0.25, 1.0)
+    jax.block_until_ready(out)
+    ts = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        out = kern(jax.random.fold_in(key, i), hv, ha, hl, hok, 0.25, 1.0)
+        jax.block_until_ready(out)
+        ts.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(ts))
+
+
+def bench_cpu_reference():
+    """Interpreted-numpy suggest step, 24 candidates (upstream's default) and
+    the north-star shape (10k candidates), 50 uniform dims, 1k history."""
+    from benchmarks.cpu_reference import suggest_step
+
+    rng = np.random.default_rng(0)
+    n, p = 1000, 50
+    vals = rng.uniform(-5, 5, (n, p))
+    active = np.ones((n, p), bool)
+    loss = (vals ** 2).sum(axis=1)
+    ok = np.ones(n, bool)
+    bounds = [(-5.0, 5.0)] * p
+
+    t0 = time.perf_counter()
+    suggest_step(vals, active, loss, ok, bounds, n_cand=24)
+    ms24 = (time.perf_counter() - t0) * 1e3
+    _emit("cpu_ref_suggest_24cand_50dim", ms24, "ms")
+
+    t0 = time.perf_counter()
+    suggest_step(vals, active, loss, ok, bounds, n_cand=10_000)
+    ms10k = (time.perf_counter() - t0) * 1e3
+    _emit("cpu_ref_suggest_10kcand_50dim", ms10k, "ms")
+    return ms10k
+
+
+def bench_1_branin():
+    import hyperopt_tpu as ho
+    from hyperopt_tpu import hp
+
+    def branin(d):
+        x, y = d["x"], d["y"]
+        b, c = 5.1 / (4 * math.pi ** 2), 5.0 / math.pi
+        t = 1.0 / (8 * math.pi)
+        return ((y - b * x ** 2 + c * x - 6.0) ** 2
+                + 10.0 * (1 - t) * math.cos(x) + 10.0)
+
+    space = {"x": hp.uniform("x", -5, 10), "y": hp.uniform("y", 0, 15)}
+    t = ho.Trials()
+    t0 = time.perf_counter()
+    ho.fmin(branin, space, algo=ho.tpe.suggest, max_evals=200, trials=t,
+            rstate=np.random.default_rng(0), show_progressbar=False)
+    dt = time.perf_counter() - t0
+    _emit("branin_200trials_e2e", dt, "s",
+          {"best_loss": round(t.best_trial["result"]["loss"], 4),
+           "trials_per_sec": round(200 / dt, 2)})
+
+
+def bench_2_rosenbrock():
+    import hyperopt_tpu as ho
+    from hyperopt_tpu import hp
+
+    nd = 20
+
+    def rosen(d):
+        x = np.asarray([d[f"x{i}"] for i in range(nd)])
+        return float(np.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2
+                            + (1 - x[:-1]) ** 2))
+
+    space = {f"x{i}": hp.uniform(f"x{i}", -2, 2) for i in range(nd)}
+    algo = ho.partial(ho.tpe.suggest, n_EI_candidates=1000,
+                      split="quantile")
+    t = ho.Trials()
+    t0 = time.perf_counter()
+    ho.fmin(rosen, space, algo=algo, max_evals=150, trials=t,
+            rstate=np.random.default_rng(0), show_progressbar=False)
+    dt = time.perf_counter() - t0
+    _emit("rosenbrock20d_1kcand_150trials", dt, "s",
+          {"best_loss": round(t.best_trial["result"]["loss"], 2),
+           "trials_per_sec": round(150 / dt, 2)})
+
+
+def bench_3_mixed50():
+    ms = _suggest_latency(n_dims=50, n_cand=10_000, n_hist=1000)
+    _emit("tpe_suggest_latency_10k_cand_50dim", ms, "ms",
+          {"vs_baseline": round(50.0 / ms, 3)})
+    return ms
+
+
+def bench_4_multistart():
+    import jax
+    from jax.sharding import Mesh
+
+    import hyperopt_tpu as ho
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.parallel import multi_start_suggest
+    from hyperopt_tpu.parallel.sharded import START_AXIS
+
+    devices = jax.devices()
+    mesh = Mesh(np.asarray(devices), (START_AXIS,))
+    nd = 10
+    space = {f"x{i}": hp.uniform(f"x{i}", -5, 5) for i in range(nd)}
+
+    def sphere(d):
+        return float(sum(d[f"x{i}"] ** 2 for i in range(nd)))
+
+    algo = ho.partial(multi_start_suggest, mesh=mesh)
+    t = ho.Trials()
+    k = len(devices)
+    t0 = time.perf_counter()
+    ho.fmin(sphere, space, algo=algo, max_evals=24 + 8 * k, trials=t,
+            max_queue_len=k, rstate=np.random.default_rng(0),
+            show_progressbar=False)
+    dt = time.perf_counter() - t0
+    _emit("multistart_tpe_e2e", dt, "s",
+          {"n_devices": k, "trials": len(t),
+           "best_loss": round(t.best_trial["result"]["loss"], 3)})
+
+
+def bench_5_100k_sweep():
+    ms = _suggest_latency(n_dims=100, n_cand=100_000, n_hist=1000, reps=5)
+    _emit("tpe_suggest_latency_100k_cand_100dim", ms, "ms")
+
+
+def main(argv=None):
+    which = set(argv or sys.argv[1:])
+
+    def want(k):
+        return not which or k in which
+
+    if want("cpu"):
+        bench_cpu_reference()
+    if want("1"):
+        bench_1_branin()
+    if want("2"):
+        bench_2_rosenbrock()
+    if want("3"):
+        bench_3_mixed50()
+    if want("4"):
+        bench_4_multistart()
+    if want("5"):
+        bench_5_100k_sweep()
+
+
+if __name__ == "__main__":
+    main()

@@ -14,7 +14,18 @@ algorithms, distributed trial stores), with the numeric core compiled to XLA:
   ``jax.sharding`` / ``shard_map``.
 """
 
-from . import anneal, atpe, hp, mix, rand, tpe  # noqa: F401
+from . import (  # noqa: F401
+    anneal,
+    atpe,
+    criteria,
+    graphviz,
+    hp,
+    mix,
+    plotting,
+    rand,
+    rdists,
+    tpe,
+)
 from .base import (  # noqa: F401
     Ctrl,
     Domain,
@@ -54,6 +65,7 @@ __version__ = "0.1.0"
 __all__ = [
     "fmin", "FMinIter", "space_eval", "generate_trials_to_calculate",
     "partial", "hp", "tpe", "rand", "anneal", "mix", "atpe",
+    "criteria", "rdists", "plotting", "graphviz",
     "Trials", "trials_from_docs", "Domain", "Ctrl",
     "CompiledSpace", "compile_space", "no_progress_loss",
     "STATUS_NEW", "STATUS_RUNNING", "STATUS_SUSPENDED", "STATUS_OK",

@@ -1,2 +1,113 @@
-def suggest(new_ids, domain, trials, seed):
-    raise NotImplementedError('atpe: coming next')
+"""Adaptive TPE: self-tuning TPE hyperparameters.
+
+Reference: ``hyperopt/atpe.py`` (~1400 LoC, SURVEY.md §2) — "Adaptive TPE"
+(contributed by ElectricBrain) uses **pretrained LightGBM models** + JSON
+scaling parameters shipped with the package to predict good TPE
+hyperparameters (``gamma``, ``n_EI_candidates``, lockout masks, …) per
+problem.
+
+Documented deviation: this environment has no lightgbm and no network to
+fetch the reference's model files (SURVEY.md §7 environment facts), and
+shipping opaque pretrained artifacts would be contrary to a from-scratch
+build anyway.  The same *capability* — per-problem adaptation of the TPE
+hyperparameters — is provided by an online **portfolio bandit**:
+
+* a small portfolio of TPE configurations spanning the knobs the reference's
+  models predict (γ value and schedule, ``n_EI_candidates``,
+  ``prior_weight``), seeded by problem features (dimensionality, categorical
+  fraction — the reference's model inputs);
+* each suggest call picks a configuration by Thompson sampling over its
+  observed improvement record (Beta posterior per arm), so configurations
+  that keep finding better losses get chosen more;
+* the arm's reward is "the suggested trial improved the best-so-far loss".
+
+This keeps ATPE's plugin signature (``atpe.suggest`` drop-in, same as the
+reference) with self-contained, inspectable adaptation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import tpe
+from .base import JOB_STATE_DONE, JOB_STATE_ERROR, STATUS_OK
+from .space import CATEGORICAL
+
+
+def _portfolio(cs):
+    """TPE-configuration arms, scaled by problem features."""
+    n_params = max(cs.n_params, 1)
+    cat_frac = (sum(1 for p in cs.params if p.kind == CATEGORICAL)
+                / n_params)
+    # Wider spaces benefit from more EI candidates; heavily categorical
+    # spaces from stronger priors (smoothing).
+    base_cand = int(np.clip(24 * np.sqrt(n_params), 24, 512))
+    pw = 1.0 + cat_frac
+    return [
+        dict(gamma=0.25, split="sqrt", n_EI_candidates=base_cand,
+             prior_weight=pw),
+        dict(gamma=0.25, split="quantile", n_EI_candidates=base_cand,
+             prior_weight=pw),
+        dict(gamma=0.15, split="quantile", n_EI_candidates=base_cand * 2,
+             prior_weight=pw),
+        dict(gamma=0.5, split="sqrt", n_EI_candidates=base_cand,
+             prior_weight=2.0 * pw),   # exploratory arm
+    ]
+
+
+class _BanditState:
+    """Per-experiment Thompson-sampling state, attached to the Trials."""
+
+    def __init__(self, n_arms):
+        self.wins = np.ones(n_arms)    # Beta(1,1) priors
+        self.losses = np.ones(n_arms)
+        self.pending = {}              # tid -> (arm, best_loss_at_suggest)
+
+    def pick(self, rng):
+        return int(np.argmax(rng.beta(self.wins, self.losses)))
+
+    def settle(self, trials):
+        """Score resolved suggestions: did the trial beat the best loss
+        recorded when it was proposed?"""
+        by_tid = {t["tid"]: t for t in trials}
+        for tid in list(self.pending):
+            t = by_tid.get(tid)
+            if t is None or t["state"] not in (JOB_STATE_DONE,
+                                               JOB_STATE_ERROR):
+                continue
+            arm, best_then = self.pending.pop(tid)
+            r = t["result"]
+            loss = r.get("loss") if r.get("status") == STATUS_OK else None
+            if loss is not None and (best_then is None or loss < best_then):
+                self.wins[arm] += 1.0
+            else:
+                self.losses[arm] += 1.0
+
+
+def _state(trials, n_arms) -> _BanditState:
+    st = getattr(trials, "_atpe_state", None)
+    if st is None or len(st.wins) != n_arms:
+        st = trials._atpe_state = _BanditState(n_arms)
+    return st
+
+
+def suggest(new_ids, domain, trials, seed,
+            n_startup_jobs=tpe._default_n_startup_jobs,
+            linear_forgetting=tpe._default_linear_forgetting):
+    """Adaptive-TPE suggest (drop-in for ``hyperopt/atpe.py::suggest``)."""
+    arms = _portfolio(domain.cs)
+    st = _state(trials, len(arms))
+    st.settle(trials)
+    rng = np.random.default_rng(int(seed) % (2 ** 32))
+    arm = st.pick(rng)
+    cfg = arms[arm]
+    try:
+        best = trials.best_trial["result"]["loss"]
+    except Exception:
+        best = None
+    docs = tpe.suggest(new_ids, domain, trials, seed,
+                       n_startup_jobs=n_startup_jobs,
+                       linear_forgetting=linear_forgetting, **cfg)
+    for d in docs:
+        st.pending[d["tid"]] = (arm, best)
+    return docs

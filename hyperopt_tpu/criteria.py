@@ -1,0 +1,62 @@
+"""Standalone Bayesian-optimization acquisition criteria.
+
+Reference: ``hyperopt/criteria.py`` (~80 LoC, SURVEY.md §2): Gaussian EI /
+logEI / UCB formulas — historical utilities largely unused by the TPE path,
+kept for API parity.  Here they are jax.numpy implementations (jit/vmap
+friendly, usable on device) with the same signatures.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.special import log_ndtr
+from jax.scipy.stats import norm
+
+
+def EI_empirical(samples, thresh):
+    """Expected improvement over ``thresh`` from empirical samples:
+    ``mean(max(samples - thresh, 0))`` (reference: criteria.py::EI_empirical).
+    """
+    samples = jnp.asarray(samples)
+    return jnp.maximum(samples - thresh, 0.0).mean()
+
+
+def EI_gaussian(mean, var, thresh):
+    """Analytic expected improvement of N(mean, var) over ``thresh``
+    (reference: criteria.py::EI_gaussian)."""
+    sigma = jnp.sqrt(var)
+    score = (mean - thresh) / sigma
+    return sigma * (score * norm.cdf(score) + norm.pdf(score))
+
+
+def logEI_gaussian(mean, var, thresh):
+    """log(EI_gaussian), numerically stable deep into the negative-score
+    tail (reference: criteria.py::logEI_gaussian — which switches to an
+    asymptotic form; here the stable path uses log-space arithmetic)."""
+    sigma = jnp.sqrt(var)
+    score = (mean - thresh) / sigma
+    # EI = sigma * (score * Phi(score) + phi(score)).  For very negative
+    # score, Phi(score)*score + phi(score) -> phi(score) * (1 - |score|...)
+    # — compute both terms in log space and combine.
+    log_phi = norm.logpdf(score)
+    log_Phi = log_ndtr(score)
+    # score * Phi + phi == phi + score * Phi; sign(score) decides the path.
+    pos = jnp.log1p(jnp.exp(log_Phi + jnp.log(jnp.maximum(score, 1e-38))
+                            - log_phi)) + log_phi
+    # moderately negative score: phi - |score| * Phi > 0; log1p form.
+    neg = log_phi + jnp.log1p(
+        -jnp.exp(jnp.minimum(log_Phi
+                             + jnp.log(jnp.maximum(-score, 1e-38))
+                             - log_phi, -1e-7)))
+    # deep tail (score << 0): Mills-ratio asymptotics,
+    # EI ~ sigma * phi(s) / s^2 * (1 - 3/s^2).
+    s2 = jnp.maximum(score * score, 1e-38)
+    deep = log_phi - jnp.log(s2) + jnp.log1p(-jnp.minimum(3.0 / s2, 0.5))
+    out = jnp.where(score >= 0, pos, jnp.where(score > -6.0, neg, deep))
+    return jnp.log(sigma) + out
+
+
+def UCB(mean, var, zscore):
+    """Upper confidence bound: ``mean + zscore * sqrt(var)``
+    (reference: criteria.py::UCB)."""
+    return mean + jnp.sqrt(var) * zscore

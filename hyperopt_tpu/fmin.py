@@ -88,7 +88,11 @@ class FMinIter:
                  asynchronous=None, max_queue_len=1,
                  poll_interval_secs=0.1, max_evals=None,
                  timeout=None, loss_threshold=None,
-                 show_progressbar=True, verbose=False):
+                 show_progressbar=True, verbose=False, trace_dir=None):
+        from .utils.tracing import NullTracer, Tracer
+        trace_dir = trace_dir or os.environ.get("HYPEROPT_TPU_TRACE_DIR")
+        self.tracer = (Tracer(trace_dir, device_trace=True) if trace_dir
+                       else NullTracer())
         self.algo = algo
         self.domain = domain
         self.trials = trials
@@ -184,7 +188,8 @@ class FMinIter:
             seed = int(self.rstate.integers(2 ** 31 - 1))
             new_ids = trials.new_trial_ids(n_to_enqueue)
             trials.refresh()
-            new_trials = self.algo(new_ids, self.domain, trials, seed)
+            with self.tracer.span("suggest"):
+                new_trials = self.algo(new_ids, self.domain, trials, seed)
             if new_trials is None or len(new_trials) == 0:
                 stopped = True
             else:
@@ -195,7 +200,8 @@ class FMinIter:
             time.sleep(self.poll_interval_secs)
             trials.refresh()
         else:
-            self.serial_evaluate()
+            with self.tracer.span("evaluate"):
+                self.serial_evaluate()
 
         self._save_trials()
 
@@ -255,8 +261,13 @@ class FMinIter:
 
     def exhaust(self):
         """Run until ``max_evals`` complete (or a stop condition fires)."""
-        self._loop()
-        self.block_until_done()
+        self.tracer.start_device_trace()
+        try:
+            self._loop()
+            self.block_until_done()
+        finally:
+            self.tracer.stop_device_trace()
+            self.tracer.dump()
         return self
 
 
@@ -268,7 +279,7 @@ def fmin(fn, space, algo=None, max_evals=None,
          verbose=True, return_argmin=True,
          points_to_evaluate=None, max_queue_len=1,
          show_progressbar=True, early_stop_fn=None,
-         trials_save_file=""):
+         trials_save_file="", trace_dir=None):
     """Minimize ``fn`` over ``space`` using ``algo``.
 
     Reference-parity signature: ``hyperopt/fmin.py::fmin`` (SURVEY.md §2 L5).
@@ -330,7 +341,7 @@ def fmin(fn, space, algo=None, max_evals=None,
                     max_evals=max_evals, timeout=timeout,
                     loss_threshold=loss_threshold,
                     show_progressbar=show_progressbar and verbose,
-                    verbose=verbose)
+                    verbose=verbose, trace_dir=trace_dir)
     rval.catch_eval_exceptions = catch_eval_exceptions
     rval.exhaust()
     rval._save_trials()

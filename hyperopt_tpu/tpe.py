@@ -78,11 +78,19 @@ _TINY = 1e-12
 _LOG_KINDS = (LOGUNIFORM, QLOGUNIFORM, LOGNORMAL, QLOGNORMAL)
 
 
+# A bounded quantized column's support is a lattice of at most this many
+# points; above it, fall back to per-candidate scoring.
+_LATTICE_CAP = 4096
+
+
 class _ContGroup:
     """Static compile-time arrays for one group of continuous columns.
 
-    ``is_q`` distinguishes the two scoring paths (density vs quantized mass);
-    it is uniform within a group so the jitted code branches at trace time.
+    ``is_q`` distinguishes the two scoring paths (density vs quantized
+    mass); it is uniform within a group so the jitted code branches at trace
+    time.  Bounded q-columns additionally carry lattice metadata
+    (``lat_k0``, ``lat_len``): their EI is computed once per lattice point
+    and gathered per candidate — identical argmax, ~n_cand/L less work.
     """
 
     def __init__(self, specs, is_q):
@@ -97,22 +105,44 @@ class _ContGroup:
         self.prior_sigma = np.ones(n, np.float32)
         self.clip_lo = np.full(n, -np.inf, np.float32)
         self.clip_hi = np.full(n, np.inf, np.float32)
+        # Natural-space value bounds of the quantized lattice (k indexes of
+        # v = k·q); lat_len = 0 marks "no bounded lattice".
+        self.lat_k0 = np.zeros(n, np.int64)
+        self.lat_len = np.zeros(n, np.int64)
         for i, s in enumerate(specs):
             self.is_log[i] = s.kind in _LOG_KINDS
             if s.q:
                 self.q[i] = s.q
             if s.kind in (UNIFORM, LOGUNIFORM, QUNIFORM, QLOGUNIFORM):
                 lo, hi = s.low, s.high  # log kinds: DSL bounds are log-space
+                if s.kind in (QUNIFORM, QLOGUNIFORM):
+                    # Float math first: exp(high) or (high-low)/q can be
+                    # astronomically large (even inf) for legal spaces; int
+                    # conversion must wait until after the cap check.
+                    if s.kind == QUNIFORM:
+                        k0f = np.floor(s.low / s.q + 0.5)
+                        k1f = np.floor(s.high / s.q + 0.5)
+                    else:  # QLOGUNIFORM: lattice over natural values
+                        k0f = np.floor(np.exp(s.low) / s.q + 0.5)
+                        k1f = np.floor(np.exp(s.high) / s.q + 0.5)
+                    if np.isfinite(k1f) and np.isfinite(k0f) \
+                            and k1f - k0f < _LATTICE_CAP:
+                        self.lat_k0[i] = int(k0f)
+                        self.lat_len[i] = int(k1f) - int(k0f) + 1
             elif s.kind == UNIFORMINT:
                 lo, hi = s.low - 0.5, s.high + 0.5
                 self.q[i] = 1.0
                 self.clip_lo[i], self.clip_hi[i] = s.low, s.high
+                self.lat_k0[i] = int(s.low)
+                self.lat_len[i] = int(s.high - s.low) + 1
             elif s.kind == RANDINT:
                 # Wide randint (no dense per-option logits): treated as a
                 # quantized uniform over the integer lattice [low, high).
                 lo, hi = s.low - 0.5, s.high - 0.5
                 self.q[i] = 1.0
                 self.clip_lo[i], self.clip_hi[i] = s.low, s.high - 1
+                self.lat_k0[i] = int(s.low)
+                self.lat_len[i] = int(s.high - s.low)
             else:
                 # Normal family: unbounded; prior is (mu, sigma) in fit space
                 # (reference: ap_normal_sampler and log/q variants).
@@ -157,8 +187,24 @@ class _TpeKernel:
                 cont_q.append(s)
             else:
                 cont_n.append(s)
+        # Bounded q-columns with a small support lattice get the
+        # score-lattice-and-gather path; the rest score per candidate.
+        probe = _ContGroup(cont_q, is_q=True)
+        lattice_ok = (probe.lat_len > 0) & (probe.lat_len <= _LATTICE_CAP)
+        q_lat = [s for s, okl in zip(cont_q, lattice_ok) if okl]
+        q_full = [s for s, okl in zip(cont_q, lattice_ok) if not okl]
+        lat_group = _ContGroup(q_lat, is_q=True)
+        if len(lat_group):
+            lat_group.use_lattice = True
+            lmax = int(lat_group.lat_len.max())
+            lat_group.lat_vals = (
+                (lat_group.lat_k0[:, None] + np.arange(lmax)[None, :])
+                * lat_group.q[:, None].astype(np.float64)
+            ).astype(np.float32)
         self.groups = [g for g in (_ContGroup(cont_n, is_q=False),
-                                   _ContGroup(cont_q, is_q=True)) if len(g)]
+                                   _ContGroup(q_full, is_q=True),
+                                   lat_group)
+                       if len(g)]
         self.cat_pids = np.asarray([s.pid for s in cat], np.int32)
         self.cat_kmax = max([s.n_options for s in cat], default=1)
         priors = np.zeros((len(cat), self.cat_kmax), np.float32)
@@ -278,21 +324,35 @@ class _TpeKernel:
             v = jnp.round(x_nat / q) * q
             v = jnp.clip(v, jnp.asarray(g.clip_lo)[:, None],
                          jnp.asarray(g.clip_hi)[:, None])
-            el, eh = v - 0.5 * q, v + 0.5 * q
             is_log = g.is_log[:, None]
-            zl = jnp.where(is_log,
-                           jnp.where(el > 0,
-                                     jnp.log(jnp.maximum(el, _TINY)),
-                                     -jnp.inf),
-                           el)
-            zh = jnp.where(is_log, jnp.log(jnp.maximum(eh, _TINY)), eh)
+
+            def q_edges(vals_nat):
+                el, eh = vals_nat - 0.5 * q, vals_nat + 0.5 * q
+                zl = jnp.where(is_log,
+                               jnp.where(el > 0,
+                                         jnp.log(jnp.maximum(el, _TINY)),
+                                         -jnp.inf),
+                               el)
+                zh = jnp.where(is_log,
+                               jnp.log(jnp.maximum(eh, _TINY)), eh)
+                return zl, zh
 
             def ei_q(zl_, zh_):
                 sb = jax.vmap(gmm_log_qmass, in_axes=(0,) * 7)
                 return (sb(zl_, zh_, lwb, mub, sgb, fit_lo, fit_hi)
                         - sb(zl_, zh_, lwa, mua, sga, fit_lo, fit_hi))
 
-            ei = self._chunked_score(ei_q, (zl, zh))
+            if getattr(g, "use_lattice", False):
+                # Score each lattice point once, gather per candidate —
+                # identical argmax to per-candidate scoring at 1/L the cost.
+                lat_v = jnp.asarray(g.lat_vals)            # [C, L]
+                ei_lat = ei_q(*q_edges(lat_v))
+                idx = jnp.round(v / q).astype(jnp.int32) \
+                    - jnp.asarray(g.lat_k0, jnp.int32)[:, None]
+                idx = jnp.clip(idx, 0, lat_v.shape[1] - 1)
+                ei = jnp.take_along_axis(ei_lat, idx, axis=1)
+            else:
+                ei = self._chunked_score(ei_q, q_edges(v))
         else:
             v = x_nat
 

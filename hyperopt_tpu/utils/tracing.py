@@ -1,0 +1,96 @@
+"""Tracing / profiling instrumentation for the optimization loop.
+
+The reference has no tracing subsystem (SURVEY.md §5.1 — closest: verbose
+logging + tqdm postfix).  The TPU build adds the recommended equivalent:
+wall-clock spans around the loop phases (suggest / evaluate / store) plus
+optional XLA device traces via ``jax.profiler`` for TensorBoard.
+
+Enable with ``fmin(..., trace_dir="/tmp/trace")`` or the
+``HYPEROPT_TPU_TRACE_DIR`` environment variable.  The span summary is
+written to ``<trace_dir>/loop_trace.json``; device traces (if jax.profiler
+is usable) land in the same directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Optional
+
+
+class Tracer:
+    """Accumulates named wall-clock spans; optionally drives jax.profiler."""
+
+    def __init__(self, trace_dir: Optional[str] = None,
+                 device_trace: bool = False):
+        self.trace_dir = trace_dir
+        self.device_trace = device_trace and trace_dir is not None
+        self.totals = defaultdict(float)
+        self.counts = defaultdict(int)
+        self._started = False
+        if trace_dir:
+            os.makedirs(trace_dir, exist_ok=True)
+
+    # -- spans ---------------------------------------------------------------
+
+    @contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[name] += dt
+            self.counts[name] += 1
+
+    # -- device traces -------------------------------------------------------
+
+    def start_device_trace(self):
+        if not self.device_trace or self._started:
+            return
+        try:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+            self._started = True
+        except Exception:  # profiler unavailable on this backend
+            self.device_trace = False
+
+    def stop_device_trace(self):
+        if not self._started:
+            return
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        self._started = False
+
+    # -- summary -------------------------------------------------------------
+
+    def summary(self) -> dict:
+        out = {}
+        for name, total in sorted(self.totals.items()):
+            n = self.counts[name]
+            out[name] = {"total_s": round(total, 6), "count": n,
+                         "mean_ms": round(1e3 * total / max(n, 1), 3)}
+        return out
+
+    def dump(self) -> Optional[str]:
+        if not self.trace_dir:
+            return None
+        path = os.path.join(self.trace_dir, "loop_trace.json")
+        with open(path, "w") as f:
+            json.dump(self.summary(), f, indent=2)
+        return path
+
+
+class NullTracer(Tracer):
+    """No-op tracer (no dir, no device traces); spans still cost ~0."""
+
+    def __init__(self):
+        super().__init__(trace_dir=None, device_trace=False)

@@ -1,0 +1,190 @@
+"""Auxiliary-subsystem tests: rdists oracles vs compiled samplers, criteria,
+plotting (Agg smoke), graphviz DOT, atpe, tracing, utils.
+
+Reference patterns: tests/test_rdists.py (KS/chi² of samplers against the
+scipy-style oracles), test_plotting.py (Agg backend smoke), test_atpe.py
+(suggest runs + converges), SURVEY.md §4.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+from scipy import stats
+
+from hyperopt_tpu import (
+    Trials,
+    atpe,
+    criteria,
+    fmin,
+    graphviz,
+    hp,
+    plotting,
+    rdists,
+    tpe,
+)
+from hyperopt_tpu.space import compile_space
+from hyperopt_tpu.utils import fast_isin, get_most_recent_inds
+from hyperopt_tpu.utils.tracing import Tracer
+
+from zoo import ZOO
+
+
+def _draws(space, n=4000, seed=0):
+    cs = compile_space(space)
+    vals, active = cs.sample(jax.random.key(seed), n)
+    return np.asarray(vals)[:, 0]
+
+
+class TestRdistsOracles:
+    """The compiled device samplers must match the independent numpy/scipy
+    oracles — KS for continuous, chi² for quantized (reference testing norm).
+    """
+
+    def test_loguniform(self):
+        s = _draws({"x": hp.loguniform("x", -3, 2)})
+        d, p = stats.kstest(s, rdists.loguniform_gen(-3, 2).cdf)
+        assert p > 0.01, (d, p)
+
+    def test_lognormal(self):
+        s = _draws({"x": hp.lognormal("x", 0.5, 1.2)})
+        d, p = stats.kstest(s, rdists.lognorm_gen(0.5, 1.2).cdf)
+        assert p > 0.01, (d, p)
+
+    @pytest.mark.parametrize("gen,space", [
+        (rdists.quniform_gen(0, 10, 2),
+         {"x": hp.quniform("x", 0, 10, 2)}),
+        (rdists.qnormal_gen(0, 3, 1),
+         {"x": hp.qnormal("x", 0, 3, 1)}),
+        (rdists.qlognormal_gen(0, 1, 1),
+         {"x": hp.qlognormal("x", 0, 1, 1)}),
+        (rdists.qloguniform_gen(0, 3, 1),
+         {"x": hp.qloguniform("x", 0, 3, 1)}),
+    ])
+    def test_quantized_chi2(self, gen, space):
+        s = _draws(space, n=6000)
+        lattice = gen.support_lattice(s.min(), s.max())
+        pm = gen.pmf(lattice)
+        # merge the tail mass beyond the observed lattice into bounds
+        counts = np.array([(s == v).sum() for v in lattice], float)
+        keep = pm * len(s) >= 5  # chi² validity
+        if keep.sum() < 2:
+            pytest.skip("degenerate lattice")
+        obs = counts[keep]
+        exp = pm[keep] * len(s)
+        # renormalize over kept bins
+        exp *= obs.sum() / exp.sum()
+        chi2, p = stats.chisquare(obs, exp)
+        assert p > 0.005, (chi2, p)
+
+    def test_uniformint_bounds(self):
+        s = _draws({"x": hp.uniformint("x", 1, 6)}, n=2000)
+        assert set(np.unique(s)) <= set(range(1, 7))
+        # roughly uniform
+        counts = np.bincount(s.astype(int))[1:7]
+        assert counts.min() > 2000 / 6 * 0.7
+
+
+class TestCriteria:
+    def test_ei_gaussian_vs_empirical(self, rng):
+        mean, var, thresh = 1.0, 4.0, 2.0
+        samples = rng.normal(mean, np.sqrt(var), 200_000)
+        emp = float(criteria.EI_empirical(samples, thresh))
+        ana = float(criteria.EI_gaussian(mean, var, thresh))
+        assert abs(emp - ana) < 0.02, (emp, ana)
+
+    def test_log_ei_matches_ei(self):
+        for mean, var, thresh in [(1, 4, 2), (0, 1, 0), (0, 1, 3)]:
+            ana = float(criteria.EI_gaussian(mean, var, thresh))
+            lg = float(criteria.logEI_gaussian(mean, var, thresh))
+            assert abs(np.log(ana) - lg) < 1e-3, (mean, var, thresh)
+
+    def test_log_ei_deep_tail_finite(self):
+        # thresh far above mean: EI underflows, logEI must stay finite
+        lg = float(criteria.logEI_gaussian(0.0, 1.0, 20.0))
+        assert np.isfinite(lg) and lg < -100
+
+    def test_ucb(self):
+        assert float(criteria.UCB(1.0, 4.0, 2.0)) == pytest.approx(5.0)
+
+
+class TestPlotting:
+    @pytest.fixture
+    def ran_trials(self):
+        z = ZOO["gauss_wave2"]
+        t = Trials()
+        fmin(z.fn, z.space, algo=tpe.suggest, max_evals=30, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        return t, z
+
+    def test_history_histogram_vars(self, ran_trials):
+        import matplotlib
+        matplotlib.use("Agg", force=True)
+        t, z = ran_trials
+        assert plotting.main_plot_history(t, do_show=False) is not None
+        assert plotting.main_plot_histogram(t, do_show=False) is not None
+        axes = plotting.main_plot_vars(t, space=z.space, do_show=False)
+        assert axes is not None
+
+
+class TestGraphviz:
+    def test_dot_output_structure(self):
+        z = ZOO["gauss_wave2"]
+        dot = graphviz.dot_hyperparameters(z.space)
+        assert dot.startswith("digraph")
+        assert dot.rstrip().endswith("}")
+        assert "curve" in dot and "amp" in dot and "choice" in dot
+        # one node per scalar param at least
+        assert dot.count("->") >= 4
+
+
+class TestAtpe:
+    def test_converges_and_adapts(self):
+        z = ZOO["quadratic1"]
+        t = Trials()
+        fmin(z.fn, z.space, algo=atpe.suggest, max_evals=z.budget, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert t.best_trial["result"]["loss"] <= z.rand_thresh
+        st = t._atpe_state
+        # bandit has settled outcomes for the post-startup suggestions
+        assert st.wins.sum() + st.losses.sum() > len(st.wins) * 2
+
+    def test_conditional_space(self):
+        z = ZOO["q1_choice"]
+        t = Trials()
+        fmin(z.fn, z.space, algo=atpe.suggest, max_evals=60, trials=t,
+             rstate=np.random.default_rng(1), show_progressbar=False)
+        assert t.best_trial["result"]["loss"] <= 1.0
+
+
+class TestTracing:
+    def test_spans_and_dump(self, tmp_path):
+        z = ZOO["quadratic1"]
+        t = Trials()
+        fmin(z.fn, z.space, algo=tpe.suggest, max_evals=8, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False,
+             trace_dir=str(tmp_path))
+        data = json.load(open(tmp_path / "loop_trace.json"))
+        assert data["suggest"]["count"] == 8
+        assert data["evaluate"]["count"] == 8
+        assert data["suggest"]["total_s"] >= 0
+
+    def test_null_tracer_costless(self):
+        tr = Tracer(None)
+        with tr.span("x"):
+            pass
+        assert tr.dump() is None
+
+
+class TestUtils:
+    def test_fast_isin(self):
+        assert list(fast_isin(np.array([1, 2, 3]), np.array([2, 3]))) == \
+            [False, True, True]
+
+    def test_get_most_recent_inds(self):
+        docs = [{"tid": 0, "version": 0}, {"tid": 0, "version": 1},
+                {"tid": 1, "version": 0}]
+        inds = get_most_recent_inds(docs)
+        assert sorted(inds) == [1, 2]

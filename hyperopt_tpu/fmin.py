@@ -259,6 +259,16 @@ class FMinIter:
                     break  # no forward progress possible
         return self
 
+    def __iter__(self):
+        """Step-wise iteration (reference: FMinIter is its own iterator):
+        yields the number of completed trials after each batch."""
+        while not self._stopped(self.n_done()):
+            before = self.n_done()
+            stopped = self.run_one_batch()
+            yield self.n_done()
+            if stopped or (self.n_done() == before and not self.asynchronous):
+                break
+
     def exhaust(self):
         """Run until ``max_evals`` complete (or a stop condition fires)."""
         self.tracer.start_device_trace()

@@ -1,0 +1,120 @@
+"""Pallas TPU kernel for the TPE hot op: fused EI mixture scoring.
+
+The dominant FLOP block of a TPE suggest step is, per hyperparameter column,
+``logsumexp_k(logw_k + N(z | mu_k, sigma_k))`` against TWO mixtures (below /
+above) over the whole candidate batch — ``[n_cand, K]`` elementwise + reduce
+(SURVEY.md §3.2's numpy hot loop; ``ops/gmm.py::gmm_logpdf`` is the XLA
+version).  XLA fuses each logsumexp well, but the below-score, above-score
+and their difference are separate HLOs; this kernel does the whole EI in ONE
+VMEM pass per candidate tile:
+
+    ei[c, n] = LSE_k(cb_b[c,k] - 0.5·((z[c,n]-mu_b[c,k])/sg_b[c,k])²)
+             - LSE_k(cb_a[c,k] - 0.5·((z[c,n]-mu_a[c,k])/sg_a[c,k])²)
+
+where ``cb = logw - log(sigma) - ½log(2π)`` is folded on the host.  Grid =
+(param column, candidate tile): each program reads one column's mixtures
+(tiny, stays in VMEM) and one candidate tile, writes one EI tile.  Purely
+VPU-shaped (8×128 lanes); no HBM round-trip for the [n, K] intermediates.
+
+Truncation normalizers (``log Σ w·mass``) are per-column scalars — callers
+fold them in afterwards (they cancel in the argmax anyway).  Candidates are
+drawn inside the truncation bounds by construction, so no bounds masking.
+
+``interpret=True`` runs the same kernel on CPU (used by tests; also the
+fallback if the Pallas TPU lowering is unavailable).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+def _ei_kernel(z_ref, cbb_ref, mub_ref, sgb_ref, cba_ref, mua_ref, sga_ref,
+               out_ref):
+    z = z_ref[0, :]                                    # [T]
+
+    def lse(cb_ref, mu_ref, sg_ref):
+        cb = cb_ref[0, :]                              # [K]
+        mu = mu_ref[0, :]
+        sg = sg_ref[0, :]
+        t = (z[:, None] - mu[None, :]) / sg[None, :]   # [T, K]
+        term = cb[None, :] - 0.5 * t * t
+        m = jnp.max(term, axis=-1, keepdims=True)      # [T, 1]
+        # padding components carry cb = -inf -> exp(-inf - m) = 0
+        s = jnp.sum(jnp.exp(term - m), axis=-1)        # [T]
+        return m[:, 0] + jnp.log(s)
+
+    out_ref[0, :] = lse(cbb_ref, mub_ref, sgb_ref) \
+        - lse(cba_ref, mua_ref, sga_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def ei_scores(z, logw_b, mu_b, sg_b, logw_a, mu_a, sg_a,
+              tile=512, interpret=False):
+    """Fused EI scores for a group of columns.
+
+    Args:
+      z: f32[C, n] candidates in fit space.
+      logw_*/mu_*/sg_*: f32[C, K*] below/above mixtures (−inf logw padding).
+      tile: candidate-tile length (multiple of 128).
+      interpret: run the Pallas interpreter (CPU/debug).
+
+    Returns f32[C, n]:
+      ``logsumexp_k N(z|below) − logsumexp_k N(z|above)`` (un-normalized by
+      the truncation masses — per-column constants, fold in if needed).
+    """
+    from jax.experimental import pallas as pl
+
+    c, n = z.shape
+    cb_b = logw_b - jnp.log(sg_b) - _HALF_LOG_2PI
+    cb_a = logw_a - jnp.log(sg_a) - _HALF_LOG_2PI
+
+    def pad_k(x, fill):
+        k = x.shape[1]
+        kp = -(-k // 128) * 128
+        return jnp.pad(x, ((0, 0), (0, kp - k)), constant_values=fill)
+
+    cb_b, mu_b, sg_b = pad_k(cb_b, -jnp.inf), pad_k(mu_b, 0), pad_k(sg_b, 1)
+    cb_a, mu_a, sg_a = pad_k(cb_a, -jnp.inf), pad_k(mu_a, 0), pad_k(sg_a, 1)
+    np_ = -(-n // tile) * tile
+    z_p = jnp.pad(z, ((0, 0), (0, np_ - n)), mode="edge")
+
+    kb, ka = mu_b.shape[1], mu_a.shape[1]
+    grid = (c, np_ // tile)
+    col = lambda i, j: (i, 0)  # noqa: E731 — one column's mixtures per step
+    out = pl.pallas_call(
+        _ei_kernel,
+        out_shape=jax.ShapeDtypeStruct((c, np_), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, tile), lambda i, j: (i, j)),
+            pl.BlockSpec((1, kb), col), pl.BlockSpec((1, kb), col),
+            pl.BlockSpec((1, kb), col),
+            pl.BlockSpec((1, ka), col), pl.BlockSpec((1, ka), col),
+            pl.BlockSpec((1, ka), col),
+        ],
+        out_specs=pl.BlockSpec((1, tile), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(z_p, cb_b, mu_b, sg_b, cb_a, mu_a, sg_a)
+    return out[:, :n]
+
+
+def pallas_available() -> bool:
+    """True when the Pallas TPU lowering path should work natively."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def ei_scores_auto(z, logw_b, mu_b, sg_b, logw_a, mu_a, sg_a):
+    """ei_scores with automatic native-vs-interpret selection."""
+    return ei_scores(z, logw_b, mu_b, sg_b, logw_a, mu_a, sg_a,
+                     interpret=not pallas_available())

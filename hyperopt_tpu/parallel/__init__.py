@@ -23,3 +23,4 @@ from .sharded import (  # noqa: F401
     sharded_suggest,
 )
 from .filestore import FileTrials, FileWorker  # noqa: F401
+from .pool import PoolTrials  # noqa: F401

@@ -1,0 +1,103 @@
+"""Multi-host composition: jax.distributed + shared trial store.
+
+The reference scales across machines with MongoDB + worker daemons
+(SURVEY.md §3.4); the TPU-native equivalent is two tiers (SURVEY.md §5.8):
+
+* **intra-slice (ICI)** — handled by ``parallel.sharded`` (the mesh spans
+  all hosts' devices once ``jax.distributed`` is initialized; ``shard_map``
+  collectives ride ICI).
+* **cross-host (DCN / shared storage)** — the elastic
+  :class:`~hyperopt_tpu.parallel.filestore.FileTrials` store on a mount all
+  hosts see (GCS-fuse / NFS), playing MongoDB's role.
+
+This module is the thin glue: initialize the distributed runtime, build the
+global mesh, and run either the driver role (suggest + enqueue) or the
+worker role (evaluate).  On a single host it degrades to the local mesh —
+which is how it is exercised in CI (no multi-host hardware here; the
+single-controller code path is identical by jax.distributed's design).
+
+Typical pod usage (same program on every host)::
+
+    from hyperopt_tpu.parallel import multihost
+    mesh = multihost.initialize()          # no-op args on single host
+    if multihost.is_coordinator():
+        multihost.run_driver(fn, space, store_root="/gcs/exp",
+                             max_evals=1000, mesh=mesh)
+    else:
+        multihost.run_worker(store_root="/gcs/exp")
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None):
+    """Initialize jax.distributed (no-op on single-process) and return the
+    global ``(dp, sp)`` mesh over ALL hosts' devices."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    from .sharded import default_mesh
+
+    return default_mesh(devices=jax.devices(), n_starts=1)
+
+
+def is_coordinator() -> bool:
+    return jax.process_index() == 0
+
+
+def run_driver(fn, space, store_root: str, max_evals: int, mesh=None,
+               exp_key: str = "default", n_EI_candidates: int = 4096,
+               stale_timeout: float = 600.0, **fmin_kwargs):
+    """Coordinator role: mesh-sharded TPE suggest + durable enqueue.
+
+    Workers (``run_worker`` on other hosts, or ``hyperopt-tpu-worker``
+    processes anywhere with the mount) evaluate; stale jobs from dead
+    workers are requeued automatically each loop.
+    """
+    from functools import partial
+
+    from .. import fmin
+    from .filestore import FileTrials
+    from .sharded import sharded_suggest
+
+    trials = FileTrials(store_root, exp_key=exp_key)
+    # Ship the Domain to workers explicitly (fmin is entered with
+    # allow_trials_fmin=False below, so FileTrials.fmin's save doesn't run).
+    from ..base import Domain
+
+    trials.save_domain(Domain(fn, space))
+    algo = partial(sharded_suggest, mesh=mesh,
+                   n_EI_candidates=n_EI_candidates)
+
+    base_early_stop = fmin_kwargs.pop("early_stop_fn", None)
+
+    def early_stop(trials_, *args):
+        trials_.requeue_stale(stale_timeout)
+        if base_early_stop is not None:
+            return base_early_stop(trials_, *args)
+        return False, args
+
+    return fmin(fn, space, algo=algo, max_evals=max_evals, trials=trials,
+                early_stop_fn=early_stop, allow_trials_fmin=False,
+                **fmin_kwargs)
+
+
+def run_worker(store_root: str, exp_key: str = "default", **worker_kwargs):
+    """Worker role: evaluate trials from the shared store until idle."""
+    from .filestore import FileWorker
+
+    worker = FileWorker(store_root, exp_key=exp_key, **worker_kwargs)
+    n = worker.run()
+    logger.info("multihost worker done: %d trials", n)
+    return n

@@ -1,0 +1,146 @@
+"""Parallel in-process trial evaluation: the ``SparkTrials`` capability slot.
+
+Reference: ``hyperopt/spark.py::SparkTrials`` (~650 LoC, SURVEY.md §2/§3.5):
+an asynchronous ``Trials`` whose ``_SparkFMinState`` launches one thread per
+in-flight trial, each running the objective on a Spark executor, with a
+``parallelism`` cap, per-trial ``timeout`` cancellation and graceful
+degradation **to plain threads when no Spark is available** — which is
+exactly the degradation mode this environment dictates (no pyspark,
+SURVEY.md §7).
+
+``PoolTrials`` keeps that contract: ``asynchronous = True``; ``fmin``
+enqueues documents; a ThreadPoolExecutor evaluates them concurrently
+(``parallelism`` workers); per-trial ``trial_timeout`` marks overruns as
+errors.  The intended use is objectives that release the GIL (JAX device
+computations — one host thread per in-flight step is the standard JAX
+async-dispatch pattern) or do IO; combine with
+``parallel.multi_start_suggest`` + ``fmin(max_queue_len=K)`` so K proposals
+are generated in one device program and evaluated concurrently.
+
+For multi-process / multi-host parallelism use
+:class:`~hyperopt_tpu.parallel.filestore.FileTrials` instead.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from .. import base
+from ..base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    Ctrl,
+    Trials,
+    coarse_utcnow,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class PoolTrials(Trials):
+    """Thread-pool-evaluated Trials (SparkTrials' local-degradation mode).
+
+    Parameters mirror the reference: ``parallelism`` (max in-flight
+    objectives; Spark capped it at the executor count), ``trial_timeout``
+    (seconds; overrun trials are marked ERROR like Spark's cancellation
+    path).
+    """
+
+    asynchronous = True
+
+    def __init__(self, parallelism: int = 4, trial_timeout=None,
+                 exp_key=None, refresh=True):
+        if parallelism < 1:
+            raise ValueError("parallelism must be >= 1")
+        self.parallelism = parallelism
+        self.trial_timeout = trial_timeout
+        self._pool = None
+        self._inflight: set = set()
+        self._domain = None
+        super().__init__(exp_key=exp_key, refresh=refresh)
+
+    def __getstate__(self):
+        state = super().__getstate__()
+        state["_pool"] = None
+        state["_inflight"] = set()
+        state["_domain"] = None
+        return state
+
+    # -- hook: fmin gives us the domain, then our refresh() dispatches -------
+
+    def fmin(self, fn, space, algo, max_evals, **kwargs):
+        from ..base import Domain
+        self._domain = Domain(fn, space, pass_expr_memo_ctrl=kwargs.get(
+            "pass_expr_memo_ctrl"))
+        # Keep the queue as wide as the pool (the reference's SparkTrials
+        # derives max_queue_len from parallelism the same way).
+        kwargs.setdefault("max_queue_len", self.parallelism)
+        try:
+            return super().fmin(fn, space, algo, max_evals, **kwargs)
+        finally:
+            self.shutdown()
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.parallelism,
+                thread_name_prefix="hyperopt-tpu-pool")
+        return self._pool
+
+    def shutdown(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    # -- evaluation ----------------------------------------------------------
+
+    def _run_trial(self, doc):
+        ctrl = Ctrl(self, current_trial=doc)
+        deadline_err = None
+        t0 = time.time()
+        try:
+            spec = base.spec_from_misc(doc["misc"])
+            result = self._domain.evaluate(spec, ctrl)
+            if self.trial_timeout is not None \
+                    and time.time() - t0 > self.trial_timeout:
+                deadline_err = (f"trial {doc['tid']} exceeded "
+                                f"trial_timeout={self.trial_timeout}s")
+        except Exception as e:
+            logger.error("pool job exception (tid %s): %s", doc["tid"], e)
+            with self._lock:
+                doc["state"] = JOB_STATE_ERROR
+                doc["misc"]["error"] = (type(e).__name__, str(e))
+                doc["refresh_time"] = coarse_utcnow()
+        else:
+            with self._lock:
+                if deadline_err is None:
+                    doc["state"] = JOB_STATE_DONE
+                    doc["result"] = result
+                else:
+                    doc["state"] = JOB_STATE_ERROR
+                    doc["misc"]["error"] = ("Timeout", deadline_err)
+                doc["refresh_time"] = coarse_utcnow()
+        finally:
+            with self._lock:
+                self._inflight.discard(doc["tid"])
+
+    def refresh(self):
+        # FMinIter polls refresh() in its async loop; dispatch NEW docs to
+        # the pool here (the reference's _SparkFMinState does the same from
+        # its polling thread).
+        with self._lock:
+            if self._domain is not None:
+                for doc in self._dynamic_trials:
+                    if doc["state"] == JOB_STATE_NEW \
+                            and doc["tid"] not in self._inflight \
+                            and len(self._inflight) < self.parallelism:
+                        doc["state"] = JOB_STATE_RUNNING
+                        doc["book_time"] = coarse_utcnow()
+                        self._inflight.add(doc["tid"])
+                        self._ensure_pool().submit(self._run_trial, doc)
+        super().refresh()

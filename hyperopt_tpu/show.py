@@ -1,0 +1,88 @@
+"""Experiment inspection CLI.
+
+Reference: ``hyperopt/mongoexp.py::main_show`` / ``main_plot`` utilities
+(SURVEY.md §2): summarize a live experiment's state from its store.
+
+Usage::
+
+    python -m hyperopt_tpu.show --root /shared/exp --exp-key e1
+    python -m hyperopt_tpu.show --pickle trials.pkl [--plot history.png]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+import sys
+from collections import Counter
+
+from .base import (
+    JOB_STATE_CANCEL,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    Trials,
+)
+from .exceptions import AllTrialsFailed
+
+_STATE_NAMES = {JOB_STATE_NEW: "new", JOB_STATE_RUNNING: "running",
+                JOB_STATE_DONE: "done", JOB_STATE_ERROR: "error",
+                JOB_STATE_CANCEL: "cancel"}
+
+
+def summarize(trials: Trials, out=sys.stdout) -> None:
+    states = Counter(t["state"] for t in trials)
+    print(f"trials: {len(trials)}", file=out)
+    for s, name in _STATE_NAMES.items():
+        if states.get(s):
+            print(f"  {name:8s} {states[s]}", file=out)
+    try:
+        best = trials.best_trial
+        print(f"best loss: {best['result']['loss']:.6g} "
+              f"(tid {best['tid']})", file=out)
+        point = {k: v[0] for k, v in best["misc"]["vals"].items() if v}
+        for k in sorted(point):
+            print(f"  {k} = {point[k]}", file=out)
+    except AllTrialsFailed:
+        print("best loss: (no successful trials yet)", file=out)
+    owners = Counter(t.get("owner") for t in trials if t.get("owner"))
+    if owners:
+        print("workers:", file=out)
+        for owner, n in owners.most_common():
+            print(f"  {owner}: {n}", file=out)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="inspect a hyperopt_tpu "
+                                            "experiment")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--root", help="file-store experiment root")
+    src.add_argument("--pickle", help="trials_save_file pickle")
+    p.add_argument("--exp-key", default="default")
+    p.add_argument("--plot", default=None,
+                   help="write a loss-history PNG to this path")
+    args = p.parse_args(argv)
+
+    if args.root:
+        from .parallel.filestore import FileTrials
+        trials = FileTrials(args.root, exp_key=args.exp_key)
+    else:
+        with open(args.pickle, "rb") as f:
+            trials = pickle.load(f)
+        trials.refresh()
+
+    summarize(trials)
+
+    if args.plot:
+        import matplotlib
+        matplotlib.use("Agg", force=True)
+        from . import plotting
+        ax = plotting.main_plot_history(trials, do_show=False)
+        ax.figure.savefig(args.plot, dpi=120)
+        print(f"wrote {args.plot}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

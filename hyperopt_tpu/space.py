@@ -518,3 +518,32 @@ def compile_space(space) -> CompiledSpace:
     if isinstance(space, CompiledSpace):
         return space
     return CompiledSpace(space)
+
+
+def expr_to_config(space):
+    """Per-label distribution + activation-condition metadata.
+
+    Reference: ``hyperopt/pyll_utils.py::expr_to_config`` — walks the pyll
+    graph extracting, for every hyperparameter label, its distribution and
+    the conditions under which it participates.  The compiled representation
+    already carries exactly this, so this is a (re-)exported view::
+
+        {label: {"dist": kind, "args": {...}, "conditions": (
+                    (gating_label, branch_index), ...)}}
+    """
+    cs = compile_space(space)
+    out = {}
+    for p in cs.params:
+        args = {k: getattr(p, k) for k in ("low", "high", "mu", "sigma", "q")
+                if getattr(p, k) is not None}
+        if p.kind == CATEGORICAL:
+            args["upper"] = p.n_options
+            if p.probs is not None:
+                args["p"] = p.probs
+        out[p.label] = {
+            "dist": p.kind,
+            "args": args,
+            "conditions": tuple((cs.params[cpid].label, branch)
+                                for cpid, branch in p.conditions),
+        }
+    return out

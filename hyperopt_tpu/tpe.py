@@ -40,6 +40,7 @@ TPU-first design (NOT a translation — SURVEY.md §7):
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -76,6 +77,25 @@ _default_linear_forgetting = 25
 
 _TINY = 1e-12
 _LOG_KINDS = (LOGUNIFORM, QLOGUNIFORM, LOGNORMAL, QLOGNORMAL)
+
+
+def _pallas_mode() -> str:
+    """Select the density-EI execution path.
+
+    ``HYPEROPT_TPU_PALLAS``: ``0`` → plain XLA, ``1``/unset → the fused
+    Pallas kernel natively on TPU (XLA elsewhere), ``interpret`` → Pallas
+    interpreter (CPU correctness testing).
+    """
+    env = os.environ.get("HYPEROPT_TPU_PALLAS", "auto")
+    if env == "0":
+        return "off"
+    if env == "interpret":
+        return "interpret"
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    return "native" if on_tpu else "off"
 
 
 # A bounded quantized column's support is a lattice of at most this many
@@ -176,6 +196,7 @@ class _TpeKernel:
         if split not in ("sqrt", "quantile"):
             raise ValueError(f"split must be 'sqrt' or 'quantile', got {split!r}")
         self.split = split
+        self.pallas = _pallas_mode()
 
         cont_q, cont_n, cat = [], [], []
         for s in cs.params:
@@ -355,13 +376,24 @@ class _TpeKernel:
                 ei = self._chunked_score(ei_q, q_edges(v))
         else:
             v = x_nat
+            if self.pallas != "off":
+                # Fused single-pass Pallas kernel (ops/pallas_gmm.py).  The
+                # per-column truncation normalizers are constants along the
+                # candidate axis and cancel in the argmax, so they are not
+                # folded in here.
+                from .ops.pallas_gmm import ei_scores
 
-            def ei_n(z_):
-                sb = jax.vmap(gmm_logpdf, in_axes=(0,) * 6)
-                return (sb(z_, lwb, mub, sgb, fit_lo, fit_hi)
-                        - sb(z_, lwa, mua, sga, fit_lo, fit_hi))
+                tile = 512 if self.n_cap <= 2048 else 256
+                ei = ei_scores(zc, lwb, mub, sgb, lwa, mua, sga,
+                               tile=tile,
+                               interpret=self.pallas == "interpret")
+            else:
+                def ei_n(z_):
+                    sb = jax.vmap(gmm_logpdf, in_axes=(0,) * 6)
+                    return (sb(z_, lwb, mub, sgb, fit_lo, fit_hi)
+                            - sb(z_, lwa, mua, sga, fit_lo, fit_hi))
 
-            ei = self._chunked_score(ei_n, (zc,))
+                ei = self._chunked_score(ei_n, (zc,))
 
         # EI surrogate & per-column winner (reference: broadcast_best).
         bi = jnp.argmax(ei, axis=1)
@@ -437,7 +469,7 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
     cache = getattr(cs, "_tpe_kernels", None)
     if cache is None:
         cache = cs._tpe_kernels = {}
-    k = (n_cap, n_cand, lf, split)
+    k = (n_cap, n_cand, lf, split, _pallas_mode())
     if k not in cache:
         cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split)
     return cache[k]

@@ -1,0 +1,60 @@
+"""Pallas EI-kernel conformance: the fused kernel (interpret mode on CPU)
+must match the XLA path (ops/gmm.py) up to the per-column truncation
+normalizer it deliberately omits."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp, tpe
+from hyperopt_tpu.ops import gmm_logpdf
+from hyperopt_tpu.ops.gmm import _log_trunc_mass
+from hyperopt_tpu.ops.pallas_gmm import ei_scores
+
+
+def _random_mixture(rng, c, k, k_live):
+    logw = np.full((c, k), -np.inf, np.float32)
+    for i in range(c):
+        w = rng.random(k_live) + 0.1
+        logw[i, :k_live] = np.log(w / w.sum())
+    mu = np.where(np.isfinite(logw), rng.normal(0, 3, (c, k)), 0.0)
+    sg = np.where(np.isfinite(logw), rng.uniform(0.3, 3, (c, k)), 1.0)
+    return (jnp.asarray(logw), jnp.asarray(mu.astype(np.float32)),
+            jnp.asarray(sg.astype(np.float32)))
+
+
+class TestPallasEiKernel:
+    @pytest.mark.parametrize("c,n,kb,ka", [(3, 300, 8, 40), (1, 64, 2, 130)])
+    def test_matches_xla_path(self, rng, c, n, kb, ka):
+        below = _random_mixture(rng, c, kb, kb - 1)
+        above = _random_mixture(rng, c, ka, ka - 3)
+        z = jnp.asarray(rng.normal(0, 3, (c, n)).astype(np.float32))
+
+        got = np.asarray(ei_scores(z, *below, *above, tile=128,
+                                   interpret=True))
+
+        lo = jnp.full((c,), -jnp.inf)
+        hi = jnp.full((c,), jnp.inf)
+        sb = jax.vmap(gmm_logpdf, in_axes=(0,) * 6)
+        want = np.asarray(sb(z, *below, lo, hi) - sb(z, *above, lo, hi))
+        # The kernel omits the per-column normalizer difference (a constant
+        # along the candidate axis): add it back before comparing.
+        _, zb = jax.vmap(_log_trunc_mass, in_axes=(0, 0, 0, None, None))(
+            below[0], below[1], below[2], -jnp.inf, jnp.inf)
+        _, za = jax.vmap(_log_trunc_mass, in_axes=(0, 0, 0, None, None))(
+            above[0], above[1], above[2], -jnp.inf, jnp.inf)
+        shift = np.asarray(za - zb)[:, None]
+        np.testing.assert_allclose(got + shift, want, rtol=2e-4, atol=2e-4)
+        # constant shift leaves the winner unchanged
+        np.testing.assert_array_equal(np.argmax(got, 1), np.argmax(want, 1))
+
+    def test_end_to_end_interpret_mode(self, monkeypatch):
+        # A whole TPE run through the Pallas (interpret) path converges the
+        # same way the XLA path does.
+        monkeypatch.setenv("HYPEROPT_TPU_PALLAS", "interpret")
+        t = Trials()
+        fmin(lambda d: (d["x"] - 3.0) ** 2, {"x": hp.uniform("x", -5, 5)},
+             algo=tpe.suggest, max_evals=40, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert t.best_trial["result"]["loss"] < 0.5

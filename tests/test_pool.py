@@ -1,0 +1,143 @@
+"""PoolTrials (SparkTrials-analog) tests: parallelism caps, timeouts,
+failure paths — the reference's test_spark.py concerns on the local pool
+(SURVEY.md §4)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    Trials,
+    fmin,
+    hp,
+    rand,
+    space_eval,
+    tpe,
+)
+from hyperopt_tpu.parallel import PoolTrials
+from hyperopt_tpu.fmin import FMinIter
+from hyperopt_tpu.base import Domain
+from hyperopt_tpu.space import expr_to_config
+
+
+def _space():
+    return {"x": hp.uniform("x", -5, 5)}
+
+
+class TestPoolTrials:
+    def test_parallel_evaluation(self):
+        seen = set()
+        lock = threading.Lock()
+
+        def fn(d):
+            with lock:
+                seen.add(threading.current_thread().name)
+            time.sleep(0.01)
+            return (d["x"] - 3.0) ** 2
+
+        t = PoolTrials(parallelism=4)
+        best = fmin(fn, _space(), algo=rand.suggest, max_evals=20, trials=t,
+                    rstate=np.random.default_rng(0), show_progressbar=False)
+        assert len(t) == 20
+        assert all(d["state"] == JOB_STATE_DONE for d in t)
+        assert "x" in best
+        assert len(seen) > 1  # actually used multiple pool threads
+
+    def test_parallelism_cap(self):
+        active = []
+        peak = []
+        lock = threading.Lock()
+
+        def fn(d):
+            with lock:
+                active.append(1)
+                peak.append(len(active))
+            time.sleep(0.03)
+            with lock:
+                active.pop()
+            return d["x"] ** 2
+
+        t = PoolTrials(parallelism=2)
+        fmin(fn, _space(), algo=rand.suggest, max_evals=10, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert max(peak) <= 2
+
+    def test_trial_timeout_marks_error(self):
+        def fn(d):
+            time.sleep(0.2)
+            return d["x"] ** 2
+
+        t = PoolTrials(parallelism=2, trial_timeout=0.05)
+        with pytest.raises(Exception):
+            fmin(fn, _space(), algo=rand.suggest, max_evals=4, trials=t,
+                 rstate=np.random.default_rng(0), show_progressbar=False)
+        assert all(d["state"] == JOB_STATE_ERROR for d in t)
+
+    def test_exception_isolation(self):
+        def fn(d):
+            if d["x"] < 0:
+                raise RuntimeError("negative")
+            return d["x"] ** 2
+
+        t = PoolTrials(parallelism=3)
+        fmin(fn, _space(), algo=rand.suggest, max_evals=16, trials=t,
+             rstate=np.random.default_rng(3), show_progressbar=False)
+        states = {d["state"] for d in t}
+        assert JOB_STATE_DONE in states and JOB_STATE_ERROR in states
+        assert t.best_trial["result"]["loss"] >= 0
+
+    def test_tpe_through_pool(self):
+        t = PoolTrials(parallelism=4)
+        fmin(lambda d: (d["x"] - 3.0) ** 2, _space(), algo=tpe.suggest,
+             max_evals=40, trials=t, rstate=np.random.default_rng(0),
+             show_progressbar=False)
+        assert t.best_trial["result"]["loss"] < 1.0
+
+
+class TestFMinIterProtocol:
+    def test_step_iteration(self):
+        d = Domain(lambda cfg: cfg["x"] ** 2, _space())
+        t = Trials()
+        it = FMinIter(rand.suggest, d, t, max_evals=5,
+                      rstate=np.random.default_rng(0),
+                      show_progressbar=False)
+        progress = list(it)
+        assert progress == [1, 2, 3, 4, 5]
+
+
+class TestExprToConfig:
+    def test_metadata(self):
+        space = {
+            "x": hp.uniform("x", -5, 5),
+            "c": hp.choice("c", [{"lr": hp.loguniform("lr", -4, 0)}, {}]),
+        }
+        cfg = expr_to_config(space)
+        assert cfg["x"]["dist"] == "uniform"
+        assert cfg["x"]["args"] == {"low": -5.0, "high": 5.0}
+        assert cfg["x"]["conditions"] == ()
+        assert cfg["c"]["dist"] == "categorical"
+        assert cfg["c"]["args"]["upper"] == 2
+        assert cfg["lr"]["conditions"] == (("c", 0),)
+
+
+class TestShowCli:
+    def test_summarize_filestore(self, tmp_path, capsys):
+        from hyperopt_tpu.parallel import FileTrials, FileWorker
+        from hyperopt_tpu.show import main
+
+        root = str(tmp_path)
+        dom = Domain(lambda c: (c["x"] - 1) ** 2, _space())
+        ft = FileTrials(root, exp_key="e1")
+        docs = rand.suggest(ft.new_trial_ids(5), dom, ft, 0)
+        ft.insert_trial_docs(docs)
+        w = FileWorker(root, exp_key="e1", domain=dom, reserve_timeout=0.2,
+                       poll_interval=0.01)
+        w.run()
+        assert main(["--root", root, "--exp-key", "e1"]) == 0
+        out = capsys.readouterr().out
+        assert "trials: 5" in out and "best loss:" in out
+        assert w.owner in out

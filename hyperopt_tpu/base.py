@@ -498,6 +498,14 @@ class Ctrl:
             self.current_trial["result"] = result
             self.current_trial["refresh_time"] = coarse_utcnow()
 
+    def should_stop(self) -> bool:
+        """Cooperative-cancellation hook: long-running objectives should poll
+        this and bail out when it returns True.  Executors that can cancel
+        (``parallel.PoolTrials``) rebind it per trial; the default is never.
+        (Reference analog: Spark task cancellation, spark.py::_SparkFMinState
+        — there the *executor* is killed; a thread pool must cooperate.)"""
+        return False
+
 
 # ---------------------------------------------------------------------------
 # Domain

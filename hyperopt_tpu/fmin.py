@@ -147,7 +147,14 @@ class FMinIter:
     def block_until_done(self):
         if self.asynchronous:
             unfinished = (JOB_STATE_NEW, JOB_STATE_RUNNING)
+            cancelled = False
             while self.trials.count_by_state_unsynced(unfinished) > 0:
+                if not cancelled and self.timeout is not None and \
+                        time.time() - self.start_time >= self.timeout:
+                    # Global fmin timeout: don't wait out stragglers — stop
+                    # them (reference: SparkTrials cancellation on timeout).
+                    self._cancel_inflight("fmin timeout")
+                    cancelled = True
                 time.sleep(self.poll_interval_secs)
                 self.trials.refresh()
         else:
@@ -211,8 +218,19 @@ class FMinIter:
             self.early_stop_args = kwargs
             if stop:
                 logger.info("early stop triggered")
+                self._cancel_inflight("early stop")
                 stopped = True
         return stopped
+
+    def _cancel_inflight(self, reason):
+        """Stop in-flight work on backends that support cancellation
+        (reference: SparkTrials cancels its job group on timeout/early stop,
+        SURVEY.md §3.5)."""
+        cancel = getattr(self.trials, "cancel_inflight", None)
+        if callable(cancel):
+            n = cancel(reason)
+            if n:
+                logger.info("cancelled %d in-flight trial(s): %s", n, reason)
 
     def n_done(self):
         return self.trials.count_by_state_unsynced(

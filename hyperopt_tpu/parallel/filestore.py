@@ -326,8 +326,14 @@ class FileWorker:
             while not stop_hb.wait(self.heartbeat_interval):
                 try:
                     self.trials.heartbeat(doc, owner=self.owner)
-                except OSError:
-                    pass
+                except Exception:
+                    # Never let one failed beat kill the thread: the main
+                    # thread mutates ``doc`` concurrently, so serialization
+                    # can raise RuntimeError mid-iteration (not just OSError);
+                    # a silently-dead heartbeat would get a live trial
+                    # requeued as stale and evaluated twice.
+                    logger.debug("heartbeat skipped (tid %s)", doc["tid"],
+                                 exc_info=True)
 
         hb = threading.Thread(target=_beat, daemon=True)
         hb.start()

@@ -41,9 +41,17 @@ logger = logging.getLogger(__name__)
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None):
-    """Initialize jax.distributed (no-op on single-process) and return the
-    global ``(dp, sp)`` mesh over ALL hosts' devices."""
-    if num_processes is not None and num_processes > 1:
+    """Initialize jax.distributed (no-op when no coordinator is given) and
+    return the global ``(dp, sp)`` mesh over ALL hosts' devices.
+
+    The distributed runtime comes up whenever the caller supplies any
+    multi-process signal: ``num_processes > 1`` (coordinator auto-detected by
+    jax on TPU pods), an explicit ``coordinator_address`` (``num_processes``
+    may be inferred from the environment), or the single-controller
+    degenerate case ``num_processes=1`` with an address — useful for
+    exercising the DCN-tier init path without a pod.  With no arguments this
+    is a no-op (single host)."""
+    if coordinator_address is not None or (num_processes or 0) > 1:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)

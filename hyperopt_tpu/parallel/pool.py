@@ -3,27 +3,39 @@
 Reference: ``hyperopt/spark.py::SparkTrials`` (~650 LoC, SURVEY.md §2/§3.5):
 an asynchronous ``Trials`` whose ``_SparkFMinState`` launches one thread per
 in-flight trial, each running the objective on a Spark executor, with a
-``parallelism`` cap, per-trial ``timeout`` cancellation and graceful
-degradation **to plain threads when no Spark is available** — which is
-exactly the degradation mode this environment dictates (no pyspark,
-SURVEY.md §7).
+``parallelism`` cap, per-trial timeout **cancellation** (``sc.cancelJobGroup``
+actually stops overrunning work) and graceful degradation to plain threads
+when no Spark is available — which is the degradation mode this environment
+dictates (no pyspark, SURVEY.md §7).
 
 ``PoolTrials`` keeps that contract: ``asynchronous = True``; ``fmin``
-enqueues documents; a ThreadPoolExecutor evaluates them concurrently
-(``parallelism`` workers); per-trial ``trial_timeout`` marks overruns as
-errors.  The intended use is objectives that release the GIL (JAX device
-computations — one host thread per in-flight step is the standard JAX
-async-dispatch pattern) or do IO; combine with
-``parallel.multi_start_suggest`` + ``fmin(max_queue_len=K)`` so K proposals
-are generated in one device program and evaluated concurrently.
+enqueues documents; up to ``parallelism`` trials evaluate concurrently; and
+``trial_timeout`` / ``fmin(timeout=)`` / early-stop genuinely stop in-flight
+work (the reference's ``cancelJobGroup`` semantics), via two execution modes:
 
-For multi-process / multi-host parallelism use
+* ``execution="process"`` — each trial runs in a forked child process; on
+  timeout or cancellation the child is SIGTERM/SIGKILLed.  Hard guarantee,
+  like Spark task cancellation.  Requires a fork-safe objective (pure
+  host-side Python; don't touch JAX device state in the objective).
+* ``execution="thread"`` (default) — trials run on a thread pool (the
+  standard JAX pattern: objectives that dispatch device work release the
+  GIL).  Threads cannot be killed, so cancellation is **cooperative**: at
+  the deadline the trial is immediately marked ERROR (the optimization loop
+  moves on) and the trial's ``Ctrl.should_stop()`` flips so a cooperating
+  objective can bail out; a non-cooperating objective keeps burning its pool
+  slot until it returns, but no longer blocks ``fmin``.
+
+Combine with ``parallel.multi_start_suggest`` + ``fmin(max_queue_len=K)`` so
+K proposals are generated in one device program and evaluated concurrently.
+For multi-process / multi-host parallelism over a shared store use
 :class:`~hyperopt_tpu.parallel.filestore.FileTrials` instead.
 """
 
 from __future__ import annotations
 
 import logging
+import multiprocessing
+import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -42,33 +54,76 @@ from ..base import (
 logger = logging.getLogger(__name__)
 
 
+class _ChildCtrl:
+    """Minimal Ctrl stand-in inside a forked evaluation child: collects
+    attachments locally; they travel back through the result pipe."""
+
+    def __init__(self):
+        self.attachments = {}
+        self.current_trial = None
+        self.workdir = None
+
+    def checkpoint(self, result=None):
+        pass
+
+    def should_stop(self):
+        return False
+
+
+def _child_eval(domain, spec, conn):
+    """Forked-child entry: evaluate, ship the result, exit WITHOUT running
+    inherited teardown (the parent's JAX client threads don't survive fork;
+    ``os._exit`` sidesteps their atexit hooks)."""
+    try:
+        ctrl = _ChildCtrl()
+        try:
+            result = domain.evaluate(spec, ctrl)
+            conn.send(("ok", result, ctrl.attachments))
+        except Exception as e:  # noqa: BLE001 — marshalled to the parent
+            conn.send(("err", type(e).__name__, str(e)))
+        conn.close()
+    finally:
+        os._exit(0)
+
+
 class PoolTrials(Trials):
-    """Thread-pool-evaluated Trials (SparkTrials' local-degradation mode).
+    """Thread/process-pool-evaluated Trials (SparkTrials' capability slot).
 
     Parameters mirror the reference: ``parallelism`` (max in-flight
     objectives; Spark capped it at the executor count), ``trial_timeout``
-    (seconds; overrun trials are marked ERROR like Spark's cancellation
-    path).
+    (seconds; overrunning trials are cancelled and marked ERROR like Spark's
+    cancellation path), plus ``execution`` ("thread" or "process", see module
+    docstring).
     """
 
     asynchronous = True
 
     def __init__(self, parallelism: int = 4, trial_timeout=None,
-                 exp_key=None, refresh=True):
+                 execution: str = "thread", exp_key=None, refresh=True):
         if parallelism < 1:
             raise ValueError("parallelism must be >= 1")
+        if execution not in ("thread", "process"):
+            raise ValueError(
+                f"execution must be 'thread' or 'process', got {execution!r}")
         self.parallelism = parallelism
         self.trial_timeout = trial_timeout
+        self.execution = execution
         self._pool = None
         self._inflight: set = set()
+        self._cancel_events: dict = {}   # tid -> threading.Event
+        self._procs: dict = {}           # tid -> multiprocessing.Process
         self._domain = None
+        self._draining = False
         super().__init__(exp_key=exp_key, refresh=refresh)
 
     def __getstate__(self):
         state = super().__getstate__()
         state["_pool"] = None
         state["_inflight"] = set()
+        state["_cancel_events"] = {}
+        state["_procs"] = {}
         state["_domain"] = None
+        state["_draining"] = False
         return state
 
     # -- hook: fmin gives us the domain, then our refresh() dispatches -------
@@ -77,6 +132,7 @@ class PoolTrials(Trials):
         from ..base import Domain
         self._domain = Domain(fn, space, pass_expr_memo_ctrl=kwargs.get(
             "pass_expr_memo_ctrl"))
+        self._draining = False
         # Keep the queue as wide as the pool (the reference's SparkTrials
         # derives max_queue_len from parallelism the same way).
         kwargs.setdefault("max_queue_len", self.parallelism)
@@ -93,48 +149,168 @@ class PoolTrials(Trials):
         return self._pool
 
     def shutdown(self):
+        self.cancel_inflight("shutdown")
         if self._pool is not None:
-            self._pool.shutdown(wait=True)
+            self._pool.shutdown(wait=self.execution == "process")
             self._pool = None
+
+    # -- cancellation --------------------------------------------------------
+
+    def cancel_inflight(self, reason: str = "cancelled") -> int:
+        """Stop every in-flight trial and drain the queue (reference:
+        ``_SparkFMinState``'s ``sc.cancelJobGroup`` on fmin timeout / early
+        stop).  Process-mode children are killed; thread-mode trials are
+        marked ERROR and their ``Ctrl.should_stop()`` flips; enqueued
+        not-yet-started trials are cancelled too and no new dispatch happens
+        until the next ``fmin``.  Returns the number cancelled."""
+        with self._lock:
+            self._draining = True
+            tids = list(self._inflight)
+            n = 0
+            for doc in self._dynamic_trials:
+                if doc["state"] == JOB_STATE_NEW:
+                    doc["state"] = JOB_STATE_ERROR
+                    doc["misc"]["error"] = ("Cancelled",
+                                            f"{reason} (never started)")
+                    doc["refresh_time"] = coarse_utcnow()
+                    n += 1
+        for tid in tids:
+            if self._cancel_trial(tid, reason):
+                n += 1
+        return n
+
+    def _cancel_trial(self, tid, reason) -> bool:
+        with self._lock:
+            if tid not in self._inflight:
+                return False
+            doc = next((d for d in self._dynamic_trials if d["tid"] == tid),
+                       None)
+            ev = self._cancel_events.get(tid)
+            if ev is not None:
+                ev.set()
+            if doc is not None and doc["state"] == JOB_STATE_RUNNING:
+                doc["state"] = JOB_STATE_ERROR
+                doc["misc"]["error"] = ("Cancelled", reason)
+                doc["refresh_time"] = coarse_utcnow()
+            self._inflight.discard(tid)
+            self._cancel_events.pop(tid, None)
+            proc = self._procs.pop(tid, None)
+        if proc is not None and proc.is_alive():
+            proc.terminate()
+            proc.join(timeout=5.0)
+            if proc.is_alive():  # pragma: no cover — SIGTERM ignored
+                proc.kill()
+                proc.join(timeout=5.0)
+        return True
+
+    def _on_deadline(self, doc):
+        tid = doc["tid"]
+        with self._lock:
+            still_running = (tid in self._inflight
+                             and doc["state"] == JOB_STATE_RUNNING)
+        if still_running:
+            logger.warning("trial %s exceeded trial_timeout=%ss — cancelling",
+                           tid, self.trial_timeout)
+            self._cancel_trial(
+                tid, f"exceeded trial_timeout={self.trial_timeout}s")
 
     # -- evaluation ----------------------------------------------------------
 
-    def _run_trial(self, doc):
+    def _run_guarded(self, run, doc, ev):
+        """Pool-thread entry: the ``trial_timeout`` clock starts HERE — when
+        execution actually begins — not at enqueue, so trials queued behind a
+        zombie (cancelled-but-still-running) thread-mode objective are not
+        spuriously timed out while waiting for a worker."""
+        if ev.is_set():  # cancelled while still queued
+            return
+        timer = None
+        if self.trial_timeout is not None:
+            timer = threading.Timer(self.trial_timeout,
+                                    self._on_deadline, (doc,))
+            timer.daemon = True
+            timer.start()
+        run(doc, ev, timer)
+
+    def _finish(self, doc, ev, timer, state, result=None, error=None,
+                attachments=None):
+        if timer is not None:
+            timer.cancel()
+        with self._lock:
+            cancelled = ev.is_set() or doc["tid"] not in self._inflight
+            if not cancelled:
+                doc["state"] = state
+                if result is not None:
+                    doc["result"] = result
+                if error is not None:
+                    doc["misc"]["error"] = error
+                doc["refresh_time"] = coarse_utcnow()
+            self._inflight.discard(doc["tid"])
+            self._cancel_events.pop(doc["tid"], None)
+            self._procs.pop(doc["tid"], None)
+        if not cancelled and attachments:
+            ta = self.trial_attachments(doc)
+            for k, v in attachments.items():
+                ta[k] = v
+
+    def _run_trial_thread(self, doc, ev, timer):
         ctrl = Ctrl(self, current_trial=doc)
-        deadline_err = None
-        t0 = time.time()
+        ctrl.should_stop = ev.is_set  # cooperative-cancellation hook
         try:
             spec = base.spec_from_misc(doc["misc"])
             result = self._domain.evaluate(spec, ctrl)
-            if self.trial_timeout is not None \
-                    and time.time() - t0 > self.trial_timeout:
-                deadline_err = (f"trial {doc['tid']} exceeded "
-                                f"trial_timeout={self.trial_timeout}s")
         except Exception as e:
             logger.error("pool job exception (tid %s): %s", doc["tid"], e)
-            with self._lock:
-                doc["state"] = JOB_STATE_ERROR
-                doc["misc"]["error"] = (type(e).__name__, str(e))
-                doc["refresh_time"] = coarse_utcnow()
+            self._finish(doc, ev, timer, JOB_STATE_ERROR,
+                         error=(type(e).__name__, str(e)))
         else:
-            with self._lock:
-                if deadline_err is None:
-                    doc["state"] = JOB_STATE_DONE
-                    doc["result"] = result
-                else:
-                    doc["state"] = JOB_STATE_ERROR
-                    doc["misc"]["error"] = ("Timeout", deadline_err)
-                doc["refresh_time"] = coarse_utcnow()
+            self._finish(doc, ev, timer, JOB_STATE_DONE, result=result)
+
+    def _run_trial_process(self, doc, ev, timer):
+        """Babysit one forked evaluation child (thread-per-trial, like the
+        reference's ``_SparkFMinState`` threads watching Spark jobs)."""
+        ctx = multiprocessing.get_context("fork")
+        parent_conn, child_conn = ctx.Pipe(duplex=False)
+        spec = base.spec_from_misc(doc["misc"])
+        proc = ctx.Process(target=_child_eval,
+                           args=(self._domain, spec, child_conn), daemon=True)
+        with self._lock:
+            if ev.is_set():  # cancelled before launch
+                return
+            self._procs[doc["tid"]] = proc
+        proc.start()
+        child_conn.close()
+        try:
+            msg = None
+            while msg is None:
+                if parent_conn.poll(0.1):
+                    msg = parent_conn.recv()
+                    break
+                if ev.is_set():
+                    return  # _cancel_trial reaps the child + marks the doc
+                if not proc.is_alive() and not parent_conn.poll(0.0):
+                    self._finish(doc, ev, timer, JOB_STATE_ERROR,
+                                 error=("ChildDied",
+                                        f"exitcode={proc.exitcode}"))
+                    return
+            if msg[0] == "ok":
+                self._finish(doc, ev, timer, JOB_STATE_DONE, result=msg[1],
+                             attachments=msg[2])
+            else:
+                self._finish(doc, ev, timer, JOB_STATE_ERROR,
+                             error=(msg[1], msg[2]))
+        except (EOFError, OSError) as e:  # pragma: no cover
+            self._finish(doc, ev, timer, JOB_STATE_ERROR,
+                         error=("PipeError", str(e)))
         finally:
-            with self._lock:
-                self._inflight.discard(doc["tid"])
+            parent_conn.close()
+            proc.join(timeout=5.0)
 
     def refresh(self):
         # FMinIter polls refresh() in its async loop; dispatch NEW docs to
         # the pool here (the reference's _SparkFMinState does the same from
         # its polling thread).
         with self._lock:
-            if self._domain is not None:
+            if self._domain is not None and not self._draining:
                 for doc in self._dynamic_trials:
                     if doc["state"] == JOB_STATE_NEW \
                             and doc["tid"] not in self._inflight \
@@ -142,5 +318,11 @@ class PoolTrials(Trials):
                         doc["state"] = JOB_STATE_RUNNING
                         doc["book_time"] = coarse_utcnow()
                         self._inflight.add(doc["tid"])
-                        self._ensure_pool().submit(self._run_trial, doc)
+                        ev = threading.Event()
+                        self._cancel_events[doc["tid"]] = ev
+                        run = (self._run_trial_process
+                               if self.execution == "process"
+                               else self._run_trial_thread)
+                        self._ensure_pool().submit(self._run_guarded,
+                                                   run, doc, ev)
         super().refresh()

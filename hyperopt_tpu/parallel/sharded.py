@@ -88,11 +88,19 @@ class ShardedTpeKernel(_TpeKernel):
             x, NamedSharding(self.mesh, P(*spec)))
 
 
+def _mesh_key(mesh):
+    """Stable cache key for a mesh — device ids + layout, not ``id(mesh)``
+    (a garbage-collected mesh's id can be recycled by a new mesh, handing
+    back a kernel bound to the dead mesh's sharding)."""
+    return (mesh.axis_names, mesh.devices.shape,
+            tuple(d.id for d in mesh.devices.flat))
+
+
 def _get_sharded_kernel(cs, n_cap, n_cand, lf, mesh, split):
     cache = getattr(cs, "_sharded_tpe_kernels", None)
     if cache is None:
         cache = cs._sharded_tpe_kernels = {}
-    k = (n_cap, n_cand, lf, id(mesh), split)
+    k = (n_cap, n_cand, lf, _mesh_key(mesh), split)
     if k not in cache:
         cache[k] = ShardedTpeKernel(cs, n_cap, n_cand, lf, mesh, split)
     return cache[k]
@@ -142,7 +150,6 @@ def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
 def _multi_start_fn(kern, mesh):
     """Build the shard_mapped K-start suggest step (cached per kernel;
     shape-polymorphic in the number of starts via jit retracing)."""
-    from jax.experimental.shard_map import shard_map
 
     def one_host(keys, vals, active, loss, ok, gamma, prior_weight):
         # keys: [local] — this device's share of the K starts.
@@ -150,11 +157,11 @@ def _multi_start_fn(kern, mesh):
             lambda k: kern._suggest_one(k, vals, active, loss, ok,
                                         gamma, prior_weight))(keys)
 
-    return jax.jit(shard_map(
+    return jax.jit(jax.shard_map(
         one_host, mesh=mesh,
-        in_specs=(P(START_AXIS), None, None, None, None, None, None),
+        in_specs=(P(START_AXIS), P(), P(), P(), P(), P(), P()),
         out_specs=P(START_AXIS),
-        check_rep=False))
+        check_vma=False))
 
 
 def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
@@ -187,7 +194,7 @@ def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
     cache = getattr(cs, "_multi_start_fns", None)
     if cache is None:
         cache = cs._multi_start_fns = {}
-    ck = (id(kern), id(mesh))
+    ck = (id(kern), _mesh_key(mesh))
     if ck not in cache:
         cache[ck] = _multi_start_fn(kern, mesh)
     fn = cache[ck]

@@ -82,20 +82,26 @@ _LOG_KINDS = (LOGUNIFORM, QLOGUNIFORM, LOGNORMAL, QLOGNORMAL)
 def _pallas_mode() -> str:
     """Select the density-EI execution path.
 
-    ``HYPEROPT_TPU_PALLAS``: ``0`` → plain XLA, ``1``/unset → the fused
+    ``HYPEROPT_TPU_PALLAS``: ``0``/unset → plain XLA, ``1`` → the fused
     Pallas kernel natively on TPU (XLA elsewhere), ``interpret`` → Pallas
     interpreter (CPU correctness testing).
+
+    Native is opt-in until proven: the Pallas TPU lowering had never executed
+    natively as of round 1, so the default path is the XLA scorer and
+    ``bench.py``'s ``pallas_ab`` phase A/Bs the native kernel (latency +
+    allclose) on the real chip each round — the default flips only on a
+    recorded win.
     """
-    env = os.environ.get("HYPEROPT_TPU_PALLAS", "auto")
-    if env == "0":
-        return "off"
+    env = os.environ.get("HYPEROPT_TPU_PALLAS", "0")
     if env == "interpret":
         return "interpret"
-    try:
-        on_tpu = jax.default_backend() == "tpu"
-    except Exception:
-        on_tpu = False
-    return "native" if on_tpu else "off"
+    if env == "1":
+        try:
+            on_tpu = jax.default_backend() == "tpu"
+        except Exception:
+            on_tpu = False
+        return "native" if on_tpu else "off"
+    return "off"
 
 
 # A bounded quantized column's support is a lattice of at most this many
@@ -410,9 +416,12 @@ class _TpeKernel:
                   jnp.arange(kmax, dtype=jnp.float32)[None, None, :])
 
         def log_post(set_mask):
-            # Weighted counts + prior pseudocounts (reference:
-            # tpe.py::ap_categorical_sampler — bincount with forgetting
-            # weights, prior-smoothed by prior_weight·p·sqrt(1+N)).
+            # Weighted counts + prior pseudocounts.  Deliberate deviation
+            # from the reference (tpe.py::ap_categorical_sampler uses a
+            # CONSTANT prior strength, counts + n_options·prior_weight·p):
+            # here the pseudocount strength grows as sqrt(1+N), so the prior
+            # decays as 1/sqrt(N) instead of 1/N — a slower, better-behaved
+            # decay for the wide candidate sweeps this framework runs.
             m, w, n_set = self._set_weights(set_mask, act)
             counts = jnp.einsum("nd,ndk->dk", w,
                                 onehot.astype(jnp.float32))

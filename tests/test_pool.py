@@ -98,6 +98,73 @@ class TestPoolTrials:
         assert t.best_trial["result"]["loss"] < 1.0
 
 
+class TestCancellation:
+    """Real in-flight cancellation (reference: spark.py::_SparkFMinState
+    cancels overrunning work via sc.cancelJobGroup, SURVEY.md §3.5)."""
+
+    def test_process_timeout_kills_sleeping_objective(self):
+        # The objective sleeps far beyond the deadline; process execution
+        # must terminate it AT the deadline, not after it returns.
+        def fn(d):
+            time.sleep(60)
+            return d["x"] ** 2
+
+        t = PoolTrials(parallelism=2, trial_timeout=0.5, execution="process")
+        t0 = time.time()
+        with pytest.raises(Exception):
+            fmin(fn, _space(), algo=rand.suggest, max_evals=2, trials=t,
+                 rstate=np.random.default_rng(0), show_progressbar=False)
+        assert time.time() - t0 < 20  # nowhere near the 60s sleep
+        assert all(d["state"] == JOB_STATE_ERROR for d in t)
+        assert all(d["misc"]["error"][0] == "Cancelled" for d in t)
+
+    def test_process_execution_happy_path(self):
+        def fn(d):
+            return {"loss": (d["x"] - 1.0) ** 2, "status": "ok",
+                    "attachments": {"note": b"from-child"}}
+
+        t = PoolTrials(parallelism=2, execution="process")
+        best = fmin(fn, _space(), algo=rand.suggest, max_evals=8, trials=t,
+                    rstate=np.random.default_rng(0), show_progressbar=False)
+        assert all(d["state"] == JOB_STATE_DONE for d in t)
+        assert "x" in best
+        # attachments travel back through the result pipe
+        assert t.trial_attachments(t.trials[0])["note"] == b"from-child"
+
+    def test_fmin_timeout_cancels_running(self):
+        def fn(d):
+            time.sleep(60)
+            return 0.0
+
+        t = PoolTrials(parallelism=2, execution="process")
+        t0 = time.time()
+        with pytest.raises(Exception):
+            fmin(fn, _space(), algo=rand.suggest, max_evals=4, trials=t,
+                 timeout=1, rstate=np.random.default_rng(0),
+                 show_progressbar=False)
+        assert time.time() - t0 < 25
+        assert t.count_by_state_unsynced(JOB_STATE_ERROR) == len(t.trials)
+
+    def test_thread_cooperative_cancel(self):
+        released = threading.Event()
+
+        def fn(expr=None, memo=None, ctrl=None):
+            while not ctrl.should_stop():
+                time.sleep(0.01)
+            released.set()
+            return {"loss": 0.0, "status": "ok"}
+
+        fn.fmin_pass_expr_memo_ctrl = True
+        t = PoolTrials(parallelism=1, trial_timeout=0.3, execution="thread")
+        with pytest.raises(Exception):
+            fmin(fn, _space(), algo=rand.suggest, max_evals=1, trials=t,
+                 rstate=np.random.default_rng(0), show_progressbar=False)
+        # the deadline marked the doc ERROR and flipped should_stop();
+        # the cooperating thread observed it and exited
+        assert released.wait(10)
+        assert t.trials[0]["state"] == JOB_STATE_ERROR
+
+
 class TestFMinIterProtocol:
     def test_step_iteration(self):
         d = Domain(lambda cfg: cfg["x"] ** 2, _space())

@@ -57,7 +57,9 @@ from .fmin import (  # noqa: F401
     partial,
     space_eval,
 )
-from .space import CompiledSpace, compile_space  # noqa: F401
+from .scope import scope  # noqa: F401
+from . import pyll_shim as pyll  # noqa: F401 — reference-compat alias
+from .space import Apply, CompiledSpace, compile_space  # noqa: F401
 from .utils.early_stop import no_progress_loss  # noqa: F401
 
 __version__ = "0.1.0"
@@ -65,9 +67,9 @@ __version__ = "0.1.0"
 __all__ = [
     "fmin", "FMinIter", "space_eval", "generate_trials_to_calculate",
     "partial", "hp", "tpe", "rand", "anneal", "mix", "atpe",
-    "criteria", "rdists", "plotting", "graphviz",
+    "criteria", "rdists", "plotting", "graphviz", "scope", "pyll",
     "Trials", "trials_from_docs", "Domain", "Ctrl",
-    "CompiledSpace", "compile_space", "no_progress_loss",
+    "Apply", "CompiledSpace", "compile_space", "no_progress_loss",
     "STATUS_NEW", "STATUS_RUNNING", "STATUS_SUSPENDED", "STATUS_OK",
     "STATUS_FAIL", "STATUS_STRINGS",
     "JOB_STATE_NEW", "JOB_STATE_RUNNING", "JOB_STATE_DONE",

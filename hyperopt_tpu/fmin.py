@@ -88,7 +88,8 @@ class FMinIter:
                  asynchronous=None, max_queue_len=1,
                  poll_interval_secs=0.1, max_evals=None,
                  timeout=None, loss_threshold=None,
-                 show_progressbar=True, verbose=False, trace_dir=None):
+                 show_progressbar=True, verbose=False, trace_dir=None,
+                 overlap_suggest=False):
         from .utils.tracing import NullTracer, Tracer
         trace_dir = trace_dir or os.environ.get("HYPEROPT_TPU_TRACE_DIR")
         self.tracer = (Tracer(trace_dir, device_trace=True) if trace_dir
@@ -114,6 +115,26 @@ class FMinIter:
         self.start_time = time.time()
         self.show_progressbar = show_progressbar
         self.verbose = verbose
+        # PP-analog overlap (SURVEY.md §2 parallelism table): pre-dispatch
+        # the NEXT suggest on device before evaluating on host, hiding
+        # suggest latency behind the objective.  Needs a dispatch-capable
+        # algo (tpe.suggest / suggest_quantile), a synchronous backend and a
+        # serial queue; the pre-dispatched posterior is one result stale —
+        # the standard async-optimizer tradeoff.
+        self._pending_suggest = None
+        self._dispatch = self._materialize = None
+        if overlap_suggest and not self.asynchronous and max_queue_len == 1:
+            fn, kw = algo, {}
+            if isinstance(algo, partial) and not algo.args:
+                fn = algo.func
+                kw = dict(algo.keywords or {})
+            d = getattr(fn, "dispatch", None)
+            m = getattr(fn, "materialize", None)
+            if d is not None and m is not None:
+                self._dispatch = lambda ids, dom, tr, seed: d(
+                    ids, dom, tr, seed, **kw)
+                self._materialize = m
+        self.overlap_suggest = self._dispatch is not None
 
     # -- evaluation ---------------------------------------------------------
 
@@ -192,16 +213,30 @@ class FMinIter:
                      if self.max_evals is not None else self.max_queue_len)
         n_to_enqueue = min(self.max_queue_len - qlen, remaining)
         if n_to_enqueue > 0:
-            seed = int(self.rstate.integers(2 ** 31 - 1))
-            new_ids = trials.new_trial_ids(n_to_enqueue)
-            trials.refresh()
             with self.tracer.span("suggest"):
-                new_trials = self.algo(new_ids, self.domain, trials, seed)
+                if self._pending_suggest is not None:
+                    # Dispatched during the previous batch's evaluation —
+                    # the device has (usually) already finished.
+                    new_trials = self._materialize(self._pending_suggest)
+                    self._pending_suggest = None
+                else:
+                    seed = int(self.rstate.integers(2 ** 31 - 1))
+                    new_ids = trials.new_trial_ids(n_to_enqueue)
+                    trials.refresh()
+                    new_trials = self.algo(new_ids, self.domain, trials, seed)
             if new_trials is None or len(new_trials) == 0:
                 stopped = True
             else:
                 trials.insert_trial_docs(new_trials)
                 trials.refresh()
+                if self.overlap_suggest and remaining > n_to_enqueue:
+                    # Pre-dispatch the NEXT suggest before evaluating: it
+                    # conditions on history up to the previous batch and
+                    # computes on device while the host runs the objective.
+                    seed = int(self.rstate.integers(2 ** 31 - 1))
+                    ids = trials.new_trial_ids(1)
+                    self._pending_suggest = self._dispatch(
+                        ids, self.domain, trials, seed)
 
         if self.asynchronous:
             time.sleep(self.poll_interval_secs)
@@ -307,7 +342,7 @@ def fmin(fn, space, algo=None, max_evals=None,
          verbose=True, return_argmin=True,
          points_to_evaluate=None, max_queue_len=1,
          show_progressbar=True, early_stop_fn=None,
-         trials_save_file="", trace_dir=None):
+         trials_save_file="", trace_dir=None, overlap_suggest=False):
     """Minimize ``fn`` over ``space`` using ``algo``.
 
     Reference-parity signature: ``hyperopt/fmin.py::fmin`` (SURVEY.md §2 L5).
@@ -320,6 +355,14 @@ def fmin(fn, space, algo=None, max_evals=None,
     ``{label: value}`` dicts run first), ``trials_save_file`` (pickle
     checkpoint, auto-resume), ``early_stop_fn(trials, *args)->(stop, args)``,
     ``return_argmin`` (return best point dict vs None).
+
+    TPU-first addition: ``overlap_suggest=True`` pre-dispatches the next
+    suggest step on device while the host evaluates the current objective
+    (the PP-analog of SURVEY.md §2's parallelism table), hiding suggest
+    latency behind evaluation at the cost of a one-result-stale posterior.
+    Requires a dispatch-capable algo (``tpe.suggest`` /
+    ``tpe.suggest_quantile``, optionally ``functools.partial``-bound);
+    silently degrades to the ordinary loop otherwise.
     """
     if algo is None:
         from . import tpe
@@ -369,7 +412,8 @@ def fmin(fn, space, algo=None, max_evals=None,
                     max_evals=max_evals, timeout=timeout,
                     loss_threshold=loss_threshold,
                     show_progressbar=show_progressbar and verbose,
-                    verbose=verbose, trace_dir=trace_dir)
+                    verbose=verbose, trace_dir=trace_dir,
+                    overlap_suggest=overlap_suggest)
     rval.catch_eval_exceptions = catch_eval_exceptions
     rval.exhaust()
     rval._save_trials()

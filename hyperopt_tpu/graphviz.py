@@ -13,11 +13,13 @@ Pure text generation: no graphviz binary or python-graphviz dependency
 from __future__ import annotations
 
 from .space import (
+    _T_APPLY,
     _T_CHOICE,
     _T_DICT,
     _T_LIST,
     _T_LITERAL,
     _T_PARAM,
+    _T_SWITCH,
     _T_TUPLE,
     compile_space,
 )
@@ -76,6 +78,17 @@ def dot_hyperparameters(space) -> str:
             lines.append(f'  {me} [label="{kind}", color=gray50];')
             for i, v in enumerate(node[1]):
                 emit(v, me, str(i))
+        elif tag == _T_APPLY:
+            lines.append(f'  {me} [label="scope.{_esc(node[1])}", '
+                         f"shape=ellipse, color=mediumpurple];")
+            for i, a in enumerate(node[2]):
+                emit(a, me, str(i))
+        elif tag == _T_SWITCH:
+            lines.append(f'  {me} [label="switch", shape=diamond, '
+                         f"color=darkorange];")
+            emit(node[1], me, "idx")
+            for b, branch in enumerate(node[2]):
+                emit(branch, me, str(b))
         elif tag == _T_LITERAL:
             lines.append(
                 f'  {me} [label="{_esc(repr(node[1]))}", '

@@ -38,12 +38,12 @@ _HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
 
 def _ei_kernel(z_ref, cbb_ref, mub_ref, sgb_ref, cba_ref, mua_ref, sga_ref,
                out_ref):
-    z = z_ref[0, :]                                    # [T]
+    z = z_ref[0, 0, :]                                 # [T]
 
     def lse(cb_ref, mu_ref, sg_ref):
-        cb = cb_ref[0, :]                              # [K]
-        mu = mu_ref[0, :]
-        sg = sg_ref[0, :]
+        cb = cb_ref[0, 0, :]                           # [K]
+        mu = mu_ref[0, 0, :]
+        sg = sg_ref[0, 0, :]
         t = (z[:, None] - mu[None, :]) / sg[None, :]   # [T, K]
         term = cb[None, :] - 0.5 * t * t
         m = jnp.max(term, axis=-1, keepdims=True)      # [T, 1]
@@ -51,7 +51,7 @@ def _ei_kernel(z_ref, cbb_ref, mub_ref, sgb_ref, cba_ref, mua_ref, sga_ref,
         s = jnp.sum(jnp.exp(term - m), axis=-1)        # [T]
         return m[:, 0] + jnp.log(s)
 
-    out_ref[0, :] = lse(cbb_ref, mub_ref, sgb_ref) \
+    out_ref[0, 0, :] = lse(cbb_ref, mub_ref, sgb_ref) \
         - lse(cba_ref, mua_ref, sga_ref)
 
 
@@ -87,23 +87,30 @@ def ei_scores(z, logw_b, mu_b, sg_b, logw_a, mu_a, sg_a,
     z_p = jnp.pad(z, ((0, 0), (0, np_ - n)), mode="edge")
 
     kb, ka = mu_b.shape[1], mu_a.shape[1]
+    # Mosaic tiling rule: the last two block dims must be divisible by
+    # (8, 128) or equal the array dims.  Block rows of 1 column violate it
+    # in 2-D, so arrays go through a [C, 1, ·] layout — the middle block dim
+    # (1) then EQUALS its array dim and only the lane dim must be a
+    # multiple of 128 (tile, kb, ka all are).
+    to3 = lambda x: x[:, None, :]  # noqa: E731
     grid = (c, np_ // tile)
-    col = lambda i, j: (i, 0)  # noqa: E731 — one column's mixtures per step
+    col = lambda i, j: (i, 0, 0)  # noqa: E731 — one column's mixtures/step
     out = pl.pallas_call(
         _ei_kernel,
-        out_shape=jax.ShapeDtypeStruct((c, np_), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((c, 1, np_), jnp.float32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, tile), lambda i, j: (i, j)),
-            pl.BlockSpec((1, kb), col), pl.BlockSpec((1, kb), col),
-            pl.BlockSpec((1, kb), col),
-            pl.BlockSpec((1, ka), col), pl.BlockSpec((1, ka), col),
-            pl.BlockSpec((1, ka), col),
+            pl.BlockSpec((1, 1, tile), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, kb), col), pl.BlockSpec((1, 1, kb), col),
+            pl.BlockSpec((1, 1, kb), col),
+            pl.BlockSpec((1, 1, ka), col), pl.BlockSpec((1, 1, ka), col),
+            pl.BlockSpec((1, 1, ka), col),
         ],
-        out_specs=pl.BlockSpec((1, tile), lambda i, j: (i, j)),
+        out_specs=pl.BlockSpec((1, 1, tile), lambda i, j: (i, 0, j)),
         interpret=interpret,
-    )(z_p, cb_b, mu_b, sg_b, cb_a, mu_a, sg_a)
-    return out[:, :n]
+    )(to3(z_p), to3(cb_b), to3(mu_b), to3(sg_b),
+      to3(cb_a), to3(mu_a), to3(sg_a))
+    return out[:, 0, :n]
 
 
 def pallas_available() -> bool:

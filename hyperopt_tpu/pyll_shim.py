@@ -1,0 +1,46 @@
+"""``pyll``-compat shim for reference-code migration.
+
+Reference surface covered (``hyperopt/pyll/__init__.py`` re-exports,
+SURVEY.md §2 L0): ``scope`` (expression namespace) and
+``stochastic.sample(space, rng)`` (draw one concrete configuration).  The
+graph-interpreter internals (``rec_eval``, ``toposort``, ``clone``) have no
+equivalent by design — spaces compile once to an XLA sampler
+(:mod:`hyperopt_tpu.space`), there is no per-call graph to interpret.
+
+Importable as ``hyperopt_tpu.pyll``::
+
+    from hyperopt_tpu import pyll
+    cfg = pyll.stochastic.sample(space, rng=np.random.default_rng(0))
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .scope import scope  # noqa: F401
+from .space import compile_space
+
+
+class stochastic:
+    """Namespace mirror of ``hyperopt.pyll.stochastic``."""
+
+    @staticmethod
+    def sample(space, rng=None, seed=None):
+        """Draw ONE concrete configuration from ``space``.
+
+        Reference: ``pyll/stochastic.py::sample(expr, rng)`` — there it
+        interprets the graph with numpy RNG; here it is one jitted batched
+        draw (n=1) + host decode.
+        """
+        import jax
+
+        if seed is None:
+            if rng is None:
+                seed = np.random.default_rng().integers(2 ** 31 - 1)
+            elif isinstance(rng, np.random.Generator):
+                seed = rng.integers(2 ** 31 - 1)
+            else:  # legacy RandomState
+                seed = rng.randint(2 ** 31 - 1)
+        cs = compile_space(space)
+        vals, active = cs.sample(jax.random.key(int(seed)), 1)
+        return cs.decode_row(np.asarray(vals)[0], np.asarray(active)[0])

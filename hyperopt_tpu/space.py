@@ -38,6 +38,8 @@ Quantized variants compute ``round(x / q) * q`` like the reference.
 from __future__ import annotations
 
 
+import math
+import operator as _operator
 from dataclasses import dataclass, field
 from typing import Any, Optional, Sequence
 
@@ -82,9 +84,148 @@ _DENSE_CAT_MAX = 1024
 
 
 class Expr:
-    """Base class for search-space leaf expressions built by ``hp.*``."""
+    """Base class for search-space expressions built by ``hp.*`` / ``scope``.
+
+    Supports the reference's pyll arithmetic composition (``hyperopt/pyll/
+    base.py`` operator overloads on ``Apply`` nodes, SURVEY.md §2):
+    ``hp.uniform("x", 0, 1) * 10 + 1`` builds a deterministic expression
+    tree over the stochastic leaves.
+    """
 
     __slots__ = ()
+
+    # -- pyll-parity operator overloads (each builds an Apply node) ---------
+
+    def __add__(self, other):
+        return Apply("add", (self, other))
+
+    def __radd__(self, other):
+        return Apply("add", (other, self))
+
+    def __sub__(self, other):
+        return Apply("sub", (self, other))
+
+    def __rsub__(self, other):
+        return Apply("sub", (other, self))
+
+    def __mul__(self, other):
+        return Apply("mul", (self, other))
+
+    def __rmul__(self, other):
+        return Apply("mul", (other, self))
+
+    def __truediv__(self, other):
+        return Apply("truediv", (self, other))
+
+    def __rtruediv__(self, other):
+        return Apply("truediv", (other, self))
+
+    def __floordiv__(self, other):
+        return Apply("floordiv", (self, other))
+
+    def __rfloordiv__(self, other):
+        return Apply("floordiv", (other, self))
+
+    def __mod__(self, other):
+        return Apply("mod", (self, other))
+
+    def __pow__(self, other):
+        return Apply("pow", (self, other))
+
+    def __rpow__(self, other):
+        return Apply("pow", (other, self))
+
+    def __neg__(self):
+        return Apply("neg", (self,))
+
+    def __abs__(self):
+        return Apply("abs", (self,))
+
+    def __getitem__(self, item):
+        return Apply("getitem", (self, item))
+
+    def __iter__(self):
+        # Without this, Python's legacy iteration protocol would fall back
+        # to __getitem__(0), __getitem__(1), ... — each returning a fresh
+        # Apply node — so list(expr)/unpacking/np coercion would hang
+        # building an infinite sequence instead of failing fast.
+        raise TypeError(
+            f"{type(self).__name__} expressions are not iterable")
+
+    # Make numpy defer to the operator overloads above instead of trying to
+    # coerce/iterate the expression into an array.
+    __array_ufunc__ = None
+
+
+class Apply(Expr):
+    """A deterministic operation over sub-expressions (pyll ``Apply`` analog).
+
+    Reference: ``hyperopt/pyll/base.py`` builtin ops via ``@scope.define``
+    (``getitem``, ``switch``, arithmetic, ``len`` — ~L900+) and the
+    ubiquitous ``scope.int(hp.quniform(...))`` idiom.
+
+    TPU-first placement: expressions are **decode-time host transforms**.
+    The stochastic leaves stay dense device columns (sampled and modeled by
+    TPE exactly as before — the reference likewise stores raw
+    ``hyperopt_param`` draws in ``misc.vals`` and applies expressions during
+    ``rec_eval`` config reconstruction, SURVEY.md §3.3), so expression
+    nodes cost nothing on the suggest hot path.
+    """
+
+    __slots__ = ("op", "args")
+
+    def __init__(self, op: str, args: tuple):
+        if op not in _SCOPE_IMPLS:
+            raise InvalidAnnotatedParameter(
+                f"unknown scope op {op!r}; register it with "
+                f"hyperopt_tpu.scope.define")
+        self.op = op
+        self.args = tuple(args)
+
+    def __repr__(self):
+        return f"scope.{self.op}({', '.join(map(repr, self.args))})"
+
+
+# Host-side implementations of scope ops (callable at decode time).
+# Extended by @scope.define (hyperopt_tpu/scope.py).
+_SCOPE_IMPLS = {
+    "add": _operator.add,
+    "sub": _operator.sub,
+    "mul": _operator.mul,
+    "truediv": _operator.truediv,
+    "div": _operator.truediv,
+    "floordiv": _operator.floordiv,
+    "mod": _operator.mod,
+    "pow": _operator.pow,
+    "neg": _operator.neg,
+    "abs": abs,
+    "int": int,
+    "float": float,
+    "round": round,
+    "log": math.log,
+    "log2": math.log2,
+    "log10": math.log10,
+    "exp": math.exp,
+    "sqrt": math.sqrt,
+    "ceil": math.ceil,
+    "floor": math.floor,
+    "min": min,
+    "max": max,
+    "len": len,
+    "getitem": _operator.getitem,
+    "pos_args": lambda *a: tuple(a),
+    # "switch" is structural (lazy branch selection) — handled by the
+    # compiler/decoder directly, never called as a plain function.
+    "switch": None,
+}
+
+
+def define_op(name: str, fn) -> None:
+    """Register a host-side implementation for a scope op (the extension
+    point behind ``@scope.define``, reference: ``pyll.scope.define``)."""
+    if name in _SCOPE_IMPLS:
+        raise ValueError(f"scope op {name!r} already defined")
+    _SCOPE_IMPLS[name] = fn
 
 
 class Param(Expr):
@@ -205,6 +346,8 @@ _T_CHOICE = 2
 _T_DICT = 3
 _T_LIST = 4
 _T_TUPLE = 5
+_T_APPLY = 6   # (tag, op_name, (arg_templates...))
+_T_SWITCH = 7  # (tag, idx_template, (branch_templates...)) — general index
 
 
 class CompiledSpace:
@@ -296,6 +439,11 @@ class CompiledSpace:
                 branches.append(
                     self._build(opt, conditions + ((pid, b),)))
             return (_T_CHOICE, pid, tuple(branches))
+        if isinstance(node, Apply):
+            if node.op == "switch":
+                return self._build_switch(node, conditions)
+            return (_T_APPLY, node.op,
+                    tuple(self._build(a, conditions) for a in node.args))
         if isinstance(node, Param):
             pid = self._add_param(node, conditions)
             return (_T_PARAM, pid)
@@ -311,6 +459,35 @@ class CompiledSpace:
             raise InvalidAnnotatedParameter(f"unknown expression node {node!r}")
         # Plain literal (int, float, str, None, np scalar, ...).
         return (_T_LITERAL, node)
+
+    def _build_switch(self, node: Apply, conditions):
+        """``scope.switch(idx, *options)`` (reference: pyll builtin behind
+        every conditional).  When the index is a bare 0-based integer-family
+        ``Param``, branches compile with proper activity conditions —
+        identical to ``hp.choice``; a general index expression falls back to
+        unconditioned branches (all live — a safe superset for the
+        suggest-side activity masks) selected at decode time."""
+        if len(node.args) < 2:
+            raise InvalidAnnotatedParameter(
+                "scope.switch needs an index and at least one option")
+        idx, *options = node.args
+        if isinstance(idx, Param) and (
+                idx.kind == CATEGORICAL
+                or (idx.kind in (RANDINT, UNIFORMINT) and int(idx.low) == 0)):
+            pid = self._add_param(idx, conditions)
+            n_opt = self._mutable_specs[pid].n_options or (
+                int(idx.high) + (1 if idx.kind == UNIFORMINT else 0))
+            if n_opt != len(options):
+                raise InvalidAnnotatedParameter(
+                    f"scope.switch({idx.label!r}): index has {n_opt} values "
+                    f"but {len(options)} options were given")
+            branches = tuple(
+                self._build(opt, conditions + ((pid, b),))
+                for b, opt in enumerate(options))
+            return (_T_CHOICE, pid, branches)
+        idx_t = self._build(idx, conditions)
+        branches = tuple(self._build(opt, conditions) for opt in options)
+        return (_T_SWITCH, idx_t, branches)
 
     # -- sampler compilation ------------------------------------------------
 
@@ -468,6 +645,15 @@ class CompiledSpace:
                 return [rec(v) for v in t[1]]
             if tag == _T_TUPLE:
                 return tuple(rec(v) for v in t[1])
+            if tag == _T_APPLY:
+                return _SCOPE_IMPLS[t[1]](*(rec(a) for a in t[2]))
+            if tag == _T_SWITCH:
+                idx = int(rec(t[1]))
+                if not 0 <= idx < len(t[2]):
+                    raise IndexError(
+                        f"scope.switch index {idx} out of range for "
+                        f"{len(t[2])} options")
+                return rec(t[2][idx])
             raise AssertionError(tag)
 
         return rec(self.template)

@@ -244,6 +244,7 @@ class _TpeKernel:
         self.cat_offsets = offsets
 
         self._fn = jax.jit(self._suggest_one)
+        self._batch_fns = {}  # n -> jitted vmapped suggest (K proposals)
 
     # -- sharding hook -------------------------------------------------------
 
@@ -462,6 +463,23 @@ class _TpeKernel:
         return self._fn(key, vals, active, loss, ok,
                         jnp.float32(gamma), jnp.float32(prior_weight))
 
+    def suggest_many(self, key, n, vals, active, loss, ok, gamma,
+                     prior_weight):
+        """K independent proposals (distinct RNG streams) in ONE device
+        program — K sequential host round-trips collapsed into a single
+        vmapped dispatch (the single-device analog of
+        ``parallel.multi_start_suggest``).  Returns (rows[K, P], act[K, P]).
+        """
+        fn = self._batch_fns.get(n)
+        if fn is None:
+            fn = jax.jit(jax.vmap(
+                self._suggest_one,
+                in_axes=(0, None, None, None, None, None, None)))
+            self._batch_fns[n] = fn
+        keys = jax.random.split(key, n)
+        return fn(keys, vals, active, loss, ok,
+                  jnp.float32(gamma), jnp.float32(prior_weight))
+
 
 # ---------------------------------------------------------------------------
 # kernel cache & history padding
@@ -533,26 +551,78 @@ def suggest_batch(new_ids, domain, trials, seed,
                   linear_forgetting=_default_linear_forgetting,
                   split="sqrt"):
     """Raw (vals[n, P], active[n, P]) suggestions without doc packaging."""
+    handle = suggest_dispatch(
+        new_ids, domain, trials, seed, prior_weight=prior_weight,
+        n_startup_jobs=n_startup_jobs, n_EI_candidates=n_EI_candidates,
+        gamma=gamma, linear_forgetting=linear_forgetting, split=split)
+    rows, acts = handle[3]
+    return np.asarray(rows), np.asarray(acts)
+
+
+# -- async dispatch/materialize (the PP-analog plugin surface) --------------
+#
+# SURVEY.md §2's parallelism table names pipeline-parallel overlap as the
+# framework's PP analog: the *device* computes the next suggest while the
+# *host* evaluates the current objective.  JAX dispatch is asynchronous by
+# construction, so splitting suggest into dispatch (enqueue the XLA program,
+# return device arrays unforced) + materialize (block + package docs) is all
+# FMinIter needs to hide suggest latency behind evaluation
+# (fmin(overlap_suggest=True)).
+
+
+def suggest_dispatch(new_ids, domain, trials, seed,
+                     prior_weight=_default_prior_weight,
+                     n_startup_jobs=_default_n_startup_jobs,
+                     n_EI_candidates=_default_n_EI_candidates,
+                     gamma=_default_gamma,
+                     linear_forgetting=_default_linear_forgetting,
+                     split="sqrt",
+                     verbose=True):
+    """Enqueue the suggest computation on device; returns an opaque handle
+    for :func:`suggest_materialize`.  History is snapshotted NOW — a handle
+    materialized later proposes from the history as of dispatch time (the
+    one-step-stale posterior every async optimizer accepts).
+
+    This is THE suggest implementation: :func:`suggest_batch` (and through
+    it :func:`suggest`) is dispatch + immediate force, so the overlapped and
+    ordinary paths cannot drift apart.  Handle layout:
+    ``(tag, cs, new_ids, (rows, acts), exp_key)`` with rows/acts either
+    host arrays ("ready": empty-space or random-startup draws) or unforced
+    device arrays ("pending")."""
     cs = domain.cs
     n = len(new_ids)
+    exp_key = getattr(trials, "exp_key", None)
     if n == 0 or cs.n_params == 0:
-        return (np.zeros((n, cs.n_params), np.float32),
-                np.ones((n, cs.n_params), bool))
+        return ("ready", cs, list(new_ids),
+                (np.zeros((n, cs.n_params), np.float32),
+                 np.ones((n, cs.n_params), bool)), exp_key)
     h = trials.history(cs)
     if int(h["ok"].sum()) < n_startup_jobs:
         v, a = rand.suggest_batch(new_ids, domain, trials, seed)
-        return np.asarray(v), np.asarray(a)
+        return ("ready", cs, list(new_ids),
+                (np.asarray(v), np.asarray(a)), exp_key)
     kern = get_kernel(cs, _bucket(h["vals"].shape[0]),
                       int(n_EI_candidates), int(linear_forgetting), split)
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
     key = jax.random.key(int(seed) % (2 ** 32))
-    rows, acts = [], []
-    for i in range(n):
-        r, a = kern(jax.random.fold_in(key, i), hv, ha, hl, hok,
-                    gamma, prior_weight)
-        rows.append(np.asarray(r))
-        acts.append(np.asarray(a))
-    return np.stack(rows), np.stack(acts)
+    if n == 1:
+        arrs = kern(key, hv, ha, hl, hok, gamma, prior_weight)
+        arrs = (arrs[0][None, :], arrs[1][None, :])
+    else:
+        arrs = kern.suggest_many(key, n, hv, ha, hl, hok,
+                                 gamma, prior_weight)
+    return ("pending", cs, list(new_ids), arrs, exp_key)
+
+
+def suggest_materialize(handle):
+    """Block on a :func:`suggest_dispatch` handle and package trial docs."""
+    _, cs, new_ids, (rows, acts), exp_key = handle
+    return base.docs_from_samples(cs, new_ids, np.asarray(rows),
+                                  np.asarray(acts), exp_key=exp_key)
+
+
+suggest.dispatch = suggest_dispatch
+suggest.materialize = suggest_materialize
 
 
 def suggest_quantile(new_ids, domain, trials, seed, **kwargs):
@@ -563,3 +633,12 @@ def suggest_quantile(new_ids, domain, trials, seed, **kwargs):
     """
     kwargs.setdefault("split", "quantile")
     return suggest(new_ids, domain, trials, seed, **kwargs)
+
+
+def _quantile_dispatch(new_ids, domain, trials, seed, **kwargs):
+    kwargs.setdefault("split", "quantile")
+    return suggest_dispatch(new_ids, domain, trials, seed, **kwargs)
+
+
+suggest_quantile.dispatch = _quantile_dispatch
+suggest_quantile.materialize = suggest_materialize

@@ -196,3 +196,39 @@ def test_max_queue_len_batched_suggest():
     ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=12, max_queue_len=4,
             trials=trials, rstate=0, show_progressbar=False)
     assert len(trials) == 12
+
+
+class TestOverlapSuggest:
+    """PP-analog overlap: the next suggest is pre-dispatched on device while
+    the host evaluates (fmin(overlap_suggest=True))."""
+
+    def test_overlap_converges_and_counts(self):
+        t = ht.Trials()
+        ht.fmin(lambda d: (d["x"] - 3.0) ** 2,
+                {"x": hp.uniform("x", -5, 5)},
+                algo=ht.tpe.suggest, max_evals=50, trials=t,
+                rstate=np.random.default_rng(0),
+                show_progressbar=False, overlap_suggest=True)
+        assert len(t) == 50
+        assert all(d["state"] == ht.JOB_STATE_DONE for d in t)
+        assert t.best_trial["result"]["loss"] < 0.5
+        assert sorted(d["tid"] for d in t) == list(range(50))
+
+    def test_overlap_with_partial_bound_algo(self):
+        t = ht.Trials()
+        algo = ht.partial(ht.tpe.suggest, n_EI_candidates=64, gamma=0.3)
+        ht.fmin(lambda d: d["x"] ** 2, {"x": hp.uniform("x", -2, 2)},
+                algo=algo, max_evals=40, trials=t,
+                rstate=np.random.default_rng(1),
+                show_progressbar=False, overlap_suggest=True)
+        assert len(t) == 40
+        assert t.best_trial["result"]["loss"] < 0.5
+
+    def test_overlap_ignored_for_non_dispatch_algo(self):
+        # rand.suggest has no dispatch surface: overlap degrades silently
+        t = ht.Trials()
+        ht.fmin(lambda d: d["x"] ** 2, {"x": hp.uniform("x", -2, 2)},
+                algo=rand.suggest, max_evals=10, trials=t,
+                rstate=np.random.default_rng(0),
+                show_progressbar=False, overlap_suggest=True)
+        assert len(t) == 10

@@ -1,0 +1,140 @@
+"""scope expression-node tests (reference: pyll builtin ops + the
+scope.int(hp.quniform(...)) idiom — hyperopt/pyll/base.py ~L900+,
+test_pyll.py; SURVEY.md §2 L0)."""
+
+import numpy as np
+import pytest
+
+import hyperopt_tpu as ho
+from hyperopt_tpu import hp, scope, space_eval
+from hyperopt_tpu.exceptions import InvalidAnnotatedParameter
+from hyperopt_tpu.space import compile_space
+
+
+class TestScopeBasics:
+    def test_int_cast(self):
+        space = {"n": scope.int(hp.quniform("n", 1, 64, 1))}
+        cs = compile_space(space)
+        vals, active = cs.sample(__import__("jax").random.key(0), 50)
+        for row in np.asarray(vals):
+            cfg = cs.decode_row(row)
+            assert isinstance(cfg["n"], int)
+            assert 1 <= cfg["n"] <= 64
+
+    def test_arithmetic_overloads(self):
+        space = {"lr": hp.uniform("x", 0.0, 1.0) * 10.0 + 1.0}
+        cs = compile_space(space)
+        cfg = space_eval(space, {"x": 0.5})
+        assert cfg["lr"] == pytest.approx(6.0)
+        # negative / division / power
+        cfg = space_eval({"y": -hp.uniform("x", 0, 1) ** 2 / 4}, {"x": 0.5})
+        assert cfg["y"] == pytest.approx(-0.0625)
+
+    def test_named_ops(self):
+        space = {
+            "e": scope.exp(hp.uniform("a", -1, 1)),
+            "m": scope.max(hp.uniform("b", 0, 1), 0.25),
+            "g": scope.getitem([10, 20, 30], hp.randint("i", 3)),
+        }
+        cfg = space_eval(space, {"a": 0.0, "b": 0.1, "i": 2})
+        assert cfg["e"] == pytest.approx(1.0)
+        assert cfg["m"] == 0.25
+        assert cfg["g"] == 30
+
+    def test_unknown_op_raises(self):
+        with pytest.raises(AttributeError):
+            scope.not_a_real_op
+
+    def test_define_custom_op(self):
+        @scope.define
+        def _test_double_it(x):
+            return 2 * x
+
+        cfg = space_eval({"d": _test_double_it(hp.uniform("x", 0, 1))},
+                         {"x": 0.3})
+        assert cfg["d"] == pytest.approx(0.6)
+        # also reachable via attribute access afterwards
+        cfg = space_eval({"d": scope._test_double_it(4)}, {})
+        assert cfg["d"] == 8
+
+
+class TestSwitch:
+    def test_switch_on_randint_has_conditions(self):
+        space = scope.switch(hp.randint("which", 3),
+                             {"kind": "a", "lr": hp.loguniform("lr", -5, 0)},
+                             {"kind": "b"},
+                             {"kind": "c", "n": hp.uniformint("n", 1, 8)})
+        cs = compile_space(space)
+        # conditional branches carry activity conditions like hp.choice
+        assert cs.by_label["lr"].conditions == ((cs.by_label["which"].pid, 0),)
+        assert cs.by_label["n"].conditions == ((cs.by_label["which"].pid, 2),)
+        cfg = space_eval(space, {"which": 2, "n": 4})
+        assert cfg == {"kind": "c", "n": 4}
+
+    def test_switch_on_expression_index(self):
+        # general expression index: no conditions, decode-time selection
+        space = scope.switch(scope.int(hp.quniform("s", 0, 1, 1)),
+                             "off", "on")
+        assert space_eval(space, {"s": 0.0}) == "off"
+        assert space_eval(space, {"s": 1.0}) == "on"
+
+    def test_switch_arity_mismatch(self):
+        with pytest.raises(InvalidAnnotatedParameter):
+            compile_space(scope.switch(hp.randint("i", 3), "a", "b"))
+
+
+class TestEndToEnd:
+    def test_tpe_through_scoped_space(self):
+        # the VERDICT's acceptance case: scope.int(hp.quniform) end-to-end
+        # under tpe.suggest — integer config reaching the objective, TPE
+        # modeling the underlying quniform column.
+        space = {"n": scope.int(hp.quniform("n", 1, 64, 1)),
+                 "lr": scope.exp(hp.uniform("loglr", -6, 0))}
+        seen_types = set()
+
+        def objective(cfg):
+            seen_types.add(type(cfg["n"]))
+            return (cfg["n"] - 17) ** 2 + cfg["lr"]
+
+        t = ho.Trials()
+        ho.fmin(objective, space, algo=ho.tpe.suggest, max_evals=40,
+                trials=t, rstate=np.random.default_rng(0),
+                show_progressbar=False)
+        assert seen_types == {int}
+        assert t.best_trial["result"]["loss"] < 100.0
+        # raw (pre-transform) draws are what trials store — reference
+        # semantics (misc.vals holds hyperopt_param values)
+        assert 1.0 <= t.trials[0]["misc"]["vals"]["n"][0] <= 64.0
+
+    def test_switch_under_fmin(self):
+        space = {"branch": scope.switch(
+            hp.randint("b", 2),
+            {"act": "relu", "w": hp.uniform("w1", 0, 1)},
+            {"act": "tanh", "w": hp.uniform("w2", 1, 2)})}
+
+        def objective(cfg):
+            return cfg["branch"]["w"]
+
+        t = ho.Trials()
+        best = ho.fmin(objective, space, algo=ho.rand.suggest, max_evals=30,
+                       trials=t, rstate=np.random.default_rng(0),
+                       show_progressbar=False)
+        assert t.best_trial["result"]["loss"] < 0.2
+        assert best["b"] == 0  # branch 0's w range is strictly lower
+
+    def test_pyll_shim_sample(self):
+        from hyperopt_tpu import pyll
+
+        space = {"n": scope.int(hp.quniform("n", 1, 8, 1)),
+                 "c": hp.choice("c", ["x", "y"])}
+        cfg = pyll.stochastic.sample(space, rng=np.random.default_rng(0))
+        assert isinstance(cfg["n"], int) and cfg["c"] in ("x", "y")
+
+    def test_graphviz_renders_apply(self):
+        from hyperopt_tpu.graphviz import dot_hyperparameters
+
+        dot = dot_hyperparameters(
+            {"n": scope.int(hp.quniform("n", 1, 64, 1)),
+             "s": scope.switch(scope.int(hp.quniform("i", 0, 1, 1)),
+                               "a", "b")})
+        assert "scope.int" in dot and "switch" in dot

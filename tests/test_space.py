@@ -231,3 +231,99 @@ def test_sample_determinism():
     v1, a1 = cs.sample(jax.random.key(42), 16)
     v2, a2 = cs.sample(jax.random.key(42), 16)
     assert np.array_equal(np.asarray(v1), np.asarray(v2))
+
+
+# -- vectorize equivalence (reference: test_vectorize.py — batched N-draw
+# must match N independent draws per distribution; SURVEY.md §4) -------------
+
+
+_VEC_KINDS = [
+    ("uniform", lambda: hp.uniform("v", -2, 5)),
+    ("loguniform", lambda: hp.loguniform("v", -3, 2)),
+    ("quniform", lambda: hp.quniform("v", 0, 10, 2)),
+    ("qloguniform", lambda: hp.qloguniform("v", 0, 3, 1)),
+    ("normal", lambda: hp.normal("v", 1, 2)),
+    ("lognormal", lambda: hp.lognormal("v", 0, 1)),
+    ("qnormal", lambda: hp.qnormal("v", 0, 5, 1)),
+    ("qlognormal", lambda: hp.qlognormal("v", 0, 2, 1)),
+    ("randint", lambda: hp.randint("v", 7)),
+    ("uniformint", lambda: hp.uniformint("v", 1, 9)),
+    ("pchoice", lambda: hp.pchoice("v", [(0.2, 0), (0.5, 1), (0.3, 2)])),
+]
+
+
+@pytest.mark.parametrize("kind,mk", _VEC_KINDS, ids=[k for k, _ in _VEC_KINDS])
+def test_vectorize_equivalence(kind, mk):
+    """One batched draw of N ≍ N independent single draws (distinct keys)."""
+    n = 2000
+    cs = ht.compile_space({"v": mk()})
+    batched = np.asarray(cs.sample(jax.random.key(0), n)[0])[:, 0]
+    key = jax.random.key(1)
+    singles = np.asarray(
+        [np.asarray(cs.sample(k, 1)[0])[0, 0]
+         for k in jax.random.split(key, 400)])
+    if kind in ("randint", "uniformint", "pchoice", "quniform",
+                "qloguniform"):
+        # Discrete/lattice: chi² of the singles' raw counts against the
+        # batched draw's empirical distribution (expected counts scaled to
+        # the singles' total); cells with expected < 5 pooled into one
+        # bucket to keep the chi² approximation valid.
+        support = np.unique(np.concatenate([batched, singles]))
+        f_big = np.array([(batched == s).sum() for s in support], float)
+        f_obs = np.array([(singles == s).sum() for s in support], float)
+        f_exp = f_big * (f_obs.sum() / f_big.sum())
+        main = f_exp >= 5
+        obs = np.append(f_obs[main], f_obs[~main].sum())
+        exp = np.append(f_exp[main], f_exp[~main].sum())
+        keep = exp > 0
+        p = st.chisquare(obs[keep], exp[keep]).pvalue
+        assert p > 1e-4, (kind, p)
+    else:
+        p = st.ks_2samp(batched, singles).pvalue
+        assert p > 1e-4, (kind, p)
+
+
+# -- quantized boundary masses (SURVEY.md §7 hard part 6: q-rounding at
+# bounds is where the reference's tests are picky) ---------------------------
+
+
+def test_quniform_endpoint_masses():
+    # quniform(0, 10, 3): lattice {0, 3, 6, 9} with analytic masses
+    # P(0)=0.15 (half-bin at the low edge), P(1)=P(2)=0.3, P(3)=0.25.
+    _, v, _ = _sample({"v": hp.quniform("v", 0, 10, 3)}, n=40000, seed=3)
+    counts = np.array([(v[:, 0] == k * 3.0).sum() for k in range(4)])
+    assert counts.sum() == 40000  # nothing outside the lattice
+    expect = np.array([0.15, 0.30, 0.30, 0.25]) * 40000
+    p = st.chisquare(counts, expect).pvalue
+    assert p > 1e-4, (counts, p)
+
+
+def test_quniform_clipped_low_edge():
+    # quniform(1, 10, 2): x>=1 ⇒ round(x/2)>=1 (the 0 bin has zero mass);
+    # masses 2/9 for {2,4,6,8}, 1/9 for 10.
+    _, v, _ = _sample({"v": hp.quniform("v", 1, 10, 2)}, n=40000, seed=4)
+    vals = v[:, 0]
+    assert vals.min() >= 2.0 - 1e-6, vals.min()
+    counts = np.array([(vals == k * 2.0).sum() for k in range(1, 6)])
+    expect = np.array([2, 2, 2, 2, 1]) / 9.0 * 40000
+    p = st.chisquare(counts, expect).pvalue
+    assert p > 1e-4, (counts, p)
+
+
+def test_qlognormal_zero_bin_mass():
+    # qlognormal(0, 1, 1): P(v=0) = P(exp(z) < 0.5) = Φ(log 0.5).
+    _, v, _ = _sample({"v": hp.qlognormal("v", 0, 1, 1)}, n=40000, seed=5)
+    frac0 = float((v[:, 0] == 0.0).mean())
+    expect = st.norm.cdf(np.log(0.5))
+    se = np.sqrt(expect * (1 - expect) / 40000)
+    assert abs(frac0 - expect) < 5 * se, (frac0, expect)
+
+
+def test_uniformint_endpoint_masses_equal():
+    # uniformint(1, 4): all four values incl. both endpoints equal mass
+    # (draws quniform over [0.5, 4.5] then clips — no half-mass edges).
+    _, v, _ = _sample({"v": hp.uniformint("v", 1, 4)}, n=40000, seed=6)
+    counts = np.array([(v[:, 0] == k).sum() for k in (1, 2, 3, 4)])
+    assert counts.sum() == 40000
+    p = st.chisquare(counts).pvalue
+    assert p > 1e-4, (counts, p)

@@ -45,6 +45,7 @@ PHASE_DEADLINES = {
     "init": 420.0,
     "warmup_small": 420.0,
     "xla_full": 600.0,
+    "sort_ab": 600.0,
     "pallas_ab": 600.0,
     "trials_sec": 420.0,
     "result": 60.0,
@@ -99,8 +100,9 @@ def child():
     hv, ha = jax.device_put(hv), jax.device_put(ha)
     hl, hok = jax.device_put(hl), jax.device_put(hok)
 
-    def kernel(mode, n_cand):
+    def kernel(mode, n_cand, sort="sort"):
         os.environ["HYPEROPT_TPU_PALLAS"] = mode
+        os.environ["HYPEROPT_TPU_SORT"] = sort
         return get_kernel(cs, n_cap=n_cap, n_cand=n_cand, lf=25)
 
     # Small-shape smoke first: a tiny compile validates the whole path before
@@ -117,6 +119,26 @@ def child():
                    vs_baseline=round(TARGET_MS / ms_xla, 3),
                    mode="xla", xla_ms=round(ms_xla, 3))
     _say("partial", partial)
+
+    # Sort-mode A/B: the sort-free pairwise rank/fit path
+    # (HYPEROPT_TPU_SORT=pairwise) vs the XLA-sort path.  Motivated by the
+    # measured ~65 ms floor of any sort-containing program on the axon
+    # tunnel; headline takes the faster mode.
+    _say("phase", {"name": "sort_ab"})
+    try:
+        ms_pw = _measure(kernel("0", N_CAND, sort="pairwise"),
+                         hv, ha, hl, hok)
+        partial["pairwise_ms"] = round(ms_pw, 3)
+        if ms_pw < partial["value"]:
+            partial.update(value=round(ms_pw, 3),
+                           vs_baseline=round(TARGET_MS / ms_pw, 3),
+                           mode="xla-pairwise")
+        _say("partial", partial)
+    except Exception as e:
+        partial["sort_ab_error"] = f"{type(e).__name__}: {e}"
+        _say("partial", partial)
+    finally:
+        os.environ["HYPEROPT_TPU_SORT"] = "sort"
 
     # Pallas-native A/B (TPU only, unless explicitly disabled): correctness
     # vs the XLA scorer, then latency; headline takes the faster valid mode.

@@ -2,23 +2,32 @@
 
 Reference: ``hyperopt/atpe.py`` (~1400 LoC, SURVEY.md §2) — "Adaptive TPE"
 (contributed by ElectricBrain) uses **pretrained LightGBM models** + JSON
-scaling parameters shipped with the package to predict good TPE
-hyperparameters (``gamma``, ``n_EI_candidates``, lockout masks, …) per
-problem.
+scaling parameters shipped with the package to predict, per problem, good
+TPE hyperparameters (``gamma``, ``nEICandidates``, ``priorWeight``), a
+**result-filtering mode** (fit the posterior on a subset of the history) and
+**per-parameter lockout masks** (freeze "secondary" parameters at the
+incumbent's values while the primary ones are searched).
 
 Documented deviation: this environment has no lightgbm and no network to
 fetch the reference's model files (SURVEY.md §7 environment facts), and
 shipping opaque pretrained artifacts would be contrary to a from-scratch
-build anyway.  The same *capability* — per-problem adaptation of the TPE
-hyperparameters — is provided by an online **portfolio bandit**:
+build anyway.  The same *capabilities* are provided self-contained:
 
-* a small portfolio of TPE configurations spanning the knobs the reference's
-  models predict (γ value and schedule, ``n_EI_candidates``,
-  ``prior_weight``), seeded by problem features (dimensionality, categorical
-  fraction — the reference's model inputs);
-* each suggest call picks a configuration by Thompson sampling over its
-  observed improvement record (Beta posterior per arm), so configurations
-  that keep finding better losses get chosen more;
+* **portfolio bandit** — a set of TPE configurations spanning the knobs the
+  reference's models predict (γ value and schedule, ``n_EI_candidates``,
+  ``prior_weight``, ``linear_forgetting`` as the age-filtering analog),
+  seeded by problem features (dimensionality, categorical fraction — the
+  reference's model inputs).  Each suggest call picks a configuration by
+  Thompson sampling over its observed improvement record (Beta posterior
+  per arm), so configurations that keep finding better losses get chosen
+  more.
+* **per-parameter lockout** (reference: secondaryLockingMode) — arms with a
+  ``lockout`` fraction freeze the least *important* parameters at the
+  incumbent's values and let TPE search the rest.  Importance is estimated
+  online from the trial history: |Spearman correlation| with loss for
+  numeric columns, between-group variance ratio (η²) for categorical ones —
+  the inspectable stand-in for the reference's learned
+  secondary-correlation models.
 * the arm's reward is "the suggested trial improved the best-so-far loss".
 
 This keeps ATPE's plugin signature (``atpe.suggest`` drop-in, same as the
@@ -29,13 +38,17 @@ from __future__ import annotations
 
 import numpy as np
 
-from . import tpe
+from . import base, tpe
 from .base import JOB_STATE_DONE, JOB_STATE_ERROR, STATUS_OK
-from .space import CATEGORICAL
+from .space import CATEGORICAL, RANDINT, UNIFORMINT
 
 
 def _portfolio(cs):
-    """TPE-configuration arms, scaled by problem features."""
+    """TPE-configuration arms, scaled by problem features.
+
+    Spans the reference models' output space: γ (value + schedule),
+    n_EI_candidates, prior_weight, age filtering (linear_forgetting) and
+    secondary-parameter lockout."""
     n_params = max(cs.n_params, 1)
     cat_frac = (sum(1 for p in cs.params if p.kind == CATEGORICAL)
                 / n_params)
@@ -43,7 +56,7 @@ def _portfolio(cs):
     # spaces from stronger priors (smoothing).
     base_cand = int(np.clip(24 * np.sqrt(n_params), 24, 512))
     pw = 1.0 + cat_frac
-    return [
+    arms = [
         dict(gamma=0.25, split="sqrt", n_EI_candidates=base_cand,
              prior_weight=pw),
         dict(gamma=0.25, split="quantile", n_EI_candidates=base_cand,
@@ -52,7 +65,98 @@ def _portfolio(cs):
              prior_weight=pw),
         dict(gamma=0.5, split="sqrt", n_EI_candidates=base_cand,
              prior_weight=2.0 * pw),   # exploratory arm
+        # Age-filtering analog (reference resultFilteringMode='age'): a
+        # short forgetting horizon fits the posterior on recent trials only.
+        dict(gamma=0.25, split="quantile", n_EI_candidates=base_cand,
+             prior_weight=pw, linear_forgetting=10),
     ]
+    if n_params >= 3:  # lockout is meaningless on tiny spaces
+        arms += [
+            # Secondary lockout (reference secondaryLockingMode): freeze the
+            # low-importance half / three-quarters at the incumbent.
+            dict(gamma=0.25, split="quantile", n_EI_candidates=base_cand,
+                 prior_weight=pw, lockout=0.5),
+            dict(gamma=0.15, split="quantile", n_EI_candidates=base_cand * 2,
+                 prior_weight=pw, lockout=0.75),
+        ]
+    return arms
+
+
+def parameter_importance(h, cs):
+    """Online per-parameter importance from the trial history.
+
+    Returns ``imp[P]`` in [0, 1]: a bias-adjusted between-group variance
+    ratio (η², adjusted like R²) of the loss across value groups — discrete
+    columns group by value, numeric columns by quantile bin.  Unlike a rank
+    correlation this captures non-monotone (e.g. U-shaped) relations, which
+    are the norm for loss-vs-hyperparameter curves.  Columns with too few
+    active observations get 1.0 (unknown → never lock).
+
+    Reference analog: atpe.py's pretrained secondary-correlation models —
+    here replaced by a transparent statistic over the same signal.
+    """
+    ok = h["ok"]
+    loss = h["loss"]
+    P = cs.n_params
+    imp = np.ones(P, np.float64)
+
+    def eta2_adj(y, gid, k, n):
+        tot = y.var()
+        if tot <= 0 or n <= k:
+            return 0.0
+        within = sum(float(y[gid == g].var()) * int((gid == g).sum())
+                     for g in np.unique(gid)) / n
+        # adjusted for the k-groups-from-n-samples positive bias
+        val = 1.0 - (within / max(n - k, 1)) / (tot / (n - 1))
+        return float(np.clip(val, 0.0, 1.0))
+
+    for spec in cs.params:
+        m = h["active"][:, spec.pid] & ok
+        n = int(m.sum())
+        if n < 8:
+            continue
+        x = h["vals"][m, spec.pid].astype(np.float64)
+        y = loss[m].astype(np.float64)
+        uniq = np.unique(x)
+        if spec.kind in (CATEGORICAL, RANDINT, UNIFORMINT) and \
+                len(uniq) <= 32:
+            gid = np.searchsorted(uniq, x)
+            imp[spec.pid] = eta2_adj(y, gid, len(uniq), n)
+        else:
+            k = int(np.clip(n // 8, 2, 8))
+            edges = np.quantile(x, np.linspace(0, 1, k + 1)[1:-1])
+            gid = np.searchsorted(edges, x)
+            imp[spec.pid] = eta2_adj(y, gid, k, n)
+    return imp
+
+
+def _apply_lockout(cs, rows, acts, trials, h, frac, rng):
+    """Freeze the lowest-importance ``frac`` of parameters at the
+    incumbent's values (reference: secondary lockout masks).  Gate
+    (choice) columns may flip branches, so the activity mask is recomputed
+    after substitution."""
+    try:
+        best_misc = trials.best_trial["misc"]
+    except Exception:
+        return rows, acts
+    imp = parameter_importance(h, cs)
+    # Only parameters the incumbent actually has values for can be locked.
+    lockable = []
+    for spec in cs.params:
+        v = best_misc["vals"].get(spec.label, [])
+        if len(v):
+            lockable.append((imp[spec.pid], spec.pid, float(v[0])))
+    if len(lockable) < 2:
+        return rows, acts
+    lockable.sort()
+    n_lock = int(round(frac * len(lockable)))
+    if n_lock == 0:
+        return rows, acts
+    rows = np.array(rows, copy=True)
+    for _, pid, v in lockable[:n_lock]:
+        rows[:, pid] = v
+    acts = np.asarray(cs.active_mask(rows))
+    return rows, acts
 
 
 class _BanditState:
@@ -95,19 +199,29 @@ def suggest(new_ids, domain, trials, seed,
             n_startup_jobs=tpe._default_n_startup_jobs,
             linear_forgetting=tpe._default_linear_forgetting):
     """Adaptive-TPE suggest (drop-in for ``hyperopt/atpe.py::suggest``)."""
-    arms = _portfolio(domain.cs)
+    cs = domain.cs
+    arms = _portfolio(cs)
     st = _state(trials, len(arms))
     st.settle(trials)
     rng = np.random.default_rng(int(seed) % (2 ** 32))
     arm = st.pick(rng)
-    cfg = arms[arm]
+    cfg = dict(arms[arm])
+    lockout = cfg.pop("lockout", None)
+    cfg.setdefault("linear_forgetting", linear_forgetting)
     try:
         best = trials.best_trial["result"]["loss"]
     except Exception:
         best = None
-    docs = tpe.suggest(new_ids, domain, trials, seed,
-                       n_startup_jobs=n_startup_jobs,
-                       linear_forgetting=linear_forgetting, **cfg)
+    rows, acts = tpe.suggest_batch(new_ids, domain, trials, seed,
+                                   n_startup_jobs=n_startup_jobs, **cfg)
+    if lockout is not None and best is not None:
+        h = trials.history(cs)
+        if int(h["ok"].sum()) >= n_startup_jobs:
+            rows, acts = _apply_lockout(cs, rows, acts, trials, h,
+                                        lockout, rng)
+    docs = base.docs_from_samples(cs, new_ids, np.asarray(rows),
+                                  np.asarray(acts),
+                                  exp_key=getattr(trials, "exp_key", None))
     for d in docs:
         st.pending[d["tid"]] = (arm, best)
     return docs

@@ -50,6 +50,7 @@ import numpy as np
 from . import base, rand
 from .ops import (
     fit_parzen,
+    fit_parzen_pairwise,
     forgetting_weights,
     gmm_log_qmass,
     gmm_logpdf,
@@ -102,6 +103,23 @@ def _pallas_mode() -> str:
             on_tpu = False
         return "native" if on_tpu else "off"
     return "off"
+
+
+def _sort_mode() -> str:
+    """Rank/fit implementation for the suggest step.
+
+    ``HYPEROPT_TPU_SORT``: ``sort`` → XLA sort-based γ-split ranks +
+    compacted Parzen fits; ``pairwise`` → sort-free O(N²) masked-comparison
+    ranks and nearest-neighbor bandwidths (``ops.fit_parzen_pairwise``).
+    Motivation: on the axon TPU tunnel any program containing an XLA sort
+    measured a ~65 ms floor regardless of shape, so ``bench.py`` A/Bs both
+    modes on the real chip each round; ``auto`` currently resolves to
+    ``sort`` pending a recorded pairwise win.
+    """
+    env = os.environ.get("HYPEROPT_TPU_SORT", "auto")
+    if env in ("sort", "pairwise"):
+        return env
+    return "sort"
 
 
 # A bounded quantized column's support is a lattice of at most this many
@@ -203,6 +221,7 @@ class _TpeKernel:
             raise ValueError(f"split must be 'sqrt' or 'quantile', got {split!r}")
         self.split = split
         self.pallas = _pallas_mode()
+        self.sort_mode = _sort_mode()
 
         cont_q, cont_n, cat = [], [], []
         for s in cs.params:
@@ -294,8 +313,17 @@ class _TpeKernel:
             n_below = jnp.ceil(gamma * n_f)
         n_below = jnp.minimum(n_below.astype(jnp.int32),
                               jnp.minimum(self.lf, n_ok))
-        # Stable double-argsort rank: ok trials occupy ranks [0, n_ok).
-        rank = jnp.argsort(jnp.argsort(loss))
+        # Stable rank by (loss, index): ok trials occupy ranks [0, n_ok).
+        if self.sort_mode == "pairwise":
+            # Sort-free: rank_i = #{j : (loss_j, j) < (loss_i, i)} — an
+            # O(N²) masked compare+reduce XLA fuses on the VPU, identical
+            # to the stable double-argsort rank.
+            idx = jnp.arange(loss.shape[0])
+            lt = (loss[None, :] < loss[:, None]) | (
+                (loss[None, :] == loss[:, None]) & (idx[None, :] < idx[:, None]))
+            rank = jnp.sum(lt, axis=1)
+        else:
+            rank = jnp.argsort(jnp.argsort(loss))
         below = ok & (rank < n_below)
         above = ok & (rank >= n_below)
         return below, above
@@ -324,8 +352,12 @@ class _TpeKernel:
         def models(set_mask, cap):
             m, w, n_set = self._set_weights(set_mask, act)
             x = jnp.where(m, z, jnp.inf)
-            fit = jax.vmap(partial(fit_parzen, out_cap=cap),
-                           in_axes=(1, 1, 0, 0, 0, None))
+            if self.sort_mode == "pairwise":
+                fit = jax.vmap(fit_parzen_pairwise,
+                               in_axes=(1, 1, 0, 0, 0, None))
+            else:
+                fit = jax.vmap(partial(fit_parzen, out_cap=cap),
+                               in_axes=(1, 1, 0, 0, 0, None))
             return fit(x, w, n_set, jnp.asarray(g.prior_mu),
                        jnp.asarray(g.prior_sigma), prior_weight)
 
@@ -496,7 +528,7 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
     cache = getattr(cs, "_tpe_kernels", None)
     if cache is None:
         cache = cs._tpe_kernels = {}
-    k = (n_cap, n_cand, lf, split, _pallas_mode())
+    k = (n_cap, n_cand, lf, split, _pallas_mode(), _sort_mode())
     if k not in cache:
         cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split)
     return cache[k]

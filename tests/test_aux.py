@@ -188,3 +188,73 @@ class TestUtils:
                 {"tid": 1, "version": 0}]
         inds = get_most_recent_inds(docs)
         assert sorted(inds) == [1, 2]
+
+
+class TestAtpeAdaptation:
+    """The reference-parity adaptation surface: online parameter importance
+    + per-parameter lockout (atpe.py's secondary-correlation models and
+    secondaryLockingMode, SURVEY.md §2)."""
+
+    def _history(self, n=60, seed=0):
+        # x drives the loss strongly; "noise" does not; categorical c has
+        # group structure worth ~half the variance.
+        from hyperopt_tpu.space import compile_space
+        rng = np.random.default_rng(seed)
+        space = {"x": hp.uniform("x", -5, 5),
+                 "noise": hp.uniform("noise", -5, 5),
+                 "c": hp.choice("c", [0, 1])}
+        cs = compile_space(space)
+        vals = np.zeros((n, cs.n_params), np.float32)
+        vals[:, cs.by_label["x"].pid] = rng.uniform(-5, 5, n)
+        vals[:, cs.by_label["noise"].pid] = rng.uniform(-5, 5, n)
+        vals[:, cs.by_label["c"].pid] = rng.integers(0, 2, n)
+        loss = (vals[:, cs.by_label["x"].pid] ** 2
+                + 8.0 * vals[:, cs.by_label["c"].pid]
+                + rng.normal(0, 0.5, n)).astype(np.float32)
+        h = dict(vals=vals, active=np.ones((n, cs.n_params), bool),
+                 loss=loss, ok=np.ones(n, bool),
+                 tids=np.arange(n, dtype=np.int64))
+        return cs, h
+
+    def test_parameter_importance_ranks_signal_over_noise(self):
+        cs, h = self._history()
+        imp = atpe.parameter_importance(h, cs)
+        assert imp[cs.by_label["x"].pid] > imp[cs.by_label["noise"].pid]
+        assert imp[cs.by_label["c"].pid] > imp[cs.by_label["noise"].pid]
+        assert imp[cs.by_label["x"].pid] > 0.3
+        assert imp[cs.by_label["noise"].pid] < 0.3
+
+    def test_lockout_freezes_low_importance_params(self):
+        from hyperopt_tpu import base as hbase
+        cs, h = self._history()
+        # build a Trials holding the same history so best_trial exists
+        docs = hbase.docs_from_samples(
+            cs, list(range(len(h["loss"]))), h["vals"], h["active"])
+        for d, loss in zip(docs, h["loss"]):
+            d["state"] = hbase.JOB_STATE_DONE
+            d["result"] = {"loss": float(loss), "status": "ok"}
+        t = Trials()
+        t.insert_trial_docs(docs)
+        t.refresh()
+        best_noise = t.best_trial["misc"]["vals"]["noise"][0]
+        rng = np.random.default_rng(0)
+        rows = np.asarray(h["vals"][:8], np.float32) + 0.123
+        acts = np.ones_like(h["active"][:8])
+        out_rows, out_acts = atpe._apply_lockout(
+            cs, rows, acts, t, h, frac=0.34, rng=rng)
+        # exactly the least-important ~third (the noise column) was frozen
+        pid = cs.by_label["noise"].pid
+        assert np.allclose(out_rows[:, pid], best_noise)
+        for label in ("x", "c"):
+            p = cs.by_label[label].pid
+            assert np.allclose(out_rows[:, p], rows[:, p])
+
+    def test_lockout_arm_runs_end_to_end(self):
+        # 5+-dim space activates the lockout arms; whole loop stays green.
+        space = {f"x{i}": hp.uniform(f"x{i}", -3, 3) for i in range(5)}
+        t = Trials()
+        fmin(lambda d: sum(d[f"x{i}"] ** 2 * (i + 1) for i in range(5)),
+             space, algo=atpe.suggest, max_evals=50, trials=t,
+             rstate=np.random.default_rng(2), show_progressbar=False)
+        assert len(t) == 50
+        assert t.best_trial["result"]["loss"] < 10.0

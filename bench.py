@@ -48,6 +48,7 @@ PHASE_DEADLINES = {
     "sort_ab": 600.0,
     "pallas_ab": 600.0,
     "trials_sec": 420.0,
+    "cpu_ref": 300.0,
     "result": 60.0,
 }
 
@@ -120,25 +121,28 @@ def child():
                    mode="xla", xla_ms=round(ms_xla, 3))
     _say("partial", partial)
 
+    fast = os.environ.get("HYPEROPT_TPU_BENCH_FAST") == "1"
+
     # Sort-mode A/B: the sort-free pairwise rank/fit path
     # (HYPEROPT_TPU_SORT=pairwise) vs the XLA-sort path.  Motivated by the
     # measured ~65 ms floor of any sort-containing program on the axon
     # tunnel; headline takes the faster mode.
-    _say("phase", {"name": "sort_ab"})
-    try:
-        ms_pw = _measure(kernel("0", N_CAND, sort="pairwise"),
-                         hv, ha, hl, hok)
-        partial["pairwise_ms"] = round(ms_pw, 3)
-        if ms_pw < partial["value"]:
-            partial.update(value=round(ms_pw, 3),
-                           vs_baseline=round(TARGET_MS / ms_pw, 3),
-                           mode="xla-pairwise")
-        _say("partial", partial)
-    except Exception as e:
-        partial["sort_ab_error"] = f"{type(e).__name__}: {e}"
-        _say("partial", partial)
-    finally:
-        os.environ["HYPEROPT_TPU_SORT"] = "sort"
+    if not fast:
+        _say("phase", {"name": "sort_ab"})
+        try:
+            ms_pw = _measure(kernel("0", N_CAND, sort="pairwise"),
+                             hv, ha, hl, hok)
+            partial["pairwise_ms"] = round(ms_pw, 3)
+            if ms_pw < partial["value"]:
+                partial.update(value=round(ms_pw, 3),
+                               vs_baseline=round(TARGET_MS / ms_pw, 3),
+                               mode="xla-pairwise")
+            _say("partial", partial)
+        except Exception as e:
+            partial["sort_ab_error"] = f"{type(e).__name__}: {e}"
+            _say("partial", partial)
+        finally:
+            os.environ["HYPEROPT_TPU_SORT"] = "sort"
 
     # Pallas-native A/B (TPU only, unless explicitly disabled): correctness
     # vs the XLA scorer, then latency; headline takes the faster valid mode.
@@ -169,6 +173,8 @@ def child():
     # steady state.
     _say("phase", {"name": "trials_sec"})
     try:
+        if fast:
+            raise RuntimeError("skipped (HYPEROPT_TPU_BENCH_FAST)")
         import hyperopt_tpu as ho
 
         cs10 = compile_space(_flagship_space(10))
@@ -202,6 +208,28 @@ def child():
         _say("partial", partial)
     except Exception as e:
         partial["trials_sec_error"] = f"{type(e).__name__}: {e}"
+        _say("partial", partial)
+
+    # CPU reference (the >=100x denominator): the reference-architecture
+    # interpreted-numpy suggest step at the same shape, on the host CPU
+    # (benchmarks/cpu_reference.py; measured ~58 s — one run only).
+    _say("phase", {"name": "cpu_ref"})
+    try:
+        from benchmarks.cpu_reference import suggest_step
+
+        rng = np.random.default_rng(0)
+        rv = rng.uniform(-5, 5, (N_HISTORY, N_DIMS))
+        t0 = time.perf_counter()
+        suggest_step(rv, np.ones((N_HISTORY, N_DIMS), bool),
+                     (rv ** 2).sum(axis=1), np.ones(N_HISTORY, bool),
+                     [(-5.0, 5.0)] * N_DIMS, n_cand=N_CAND)
+        cpu_ms = (time.perf_counter() - t0) * 1e3
+        partial["cpu_ref_ms"] = round(cpu_ms, 1)
+        if partial.get("value"):
+            partial["speedup_vs_cpu_ref"] = round(cpu_ms / partial["value"], 1)
+        _say("partial", partial)
+    except Exception as e:
+        partial["cpu_ref_error"] = f"{type(e).__name__}: {e}"
         _say("partial", partial)
 
     _say("phase", {"name": "result"})
@@ -311,6 +339,19 @@ def main():
         if result is None and (partial2.get("value") is not None
                                or partial.get("value") is None):
             partial = partial2 or partial
+    if result is None and partial.get("value") is None:
+        # Last resort: the TPU tunnel never came up (its chip claim can
+        # wedge for hours).  A CPU-labeled number beats a null round —
+        # the JSON carries backend="cpu" so it cannot be mistaken for a
+        # TPU measurement.
+        log("TPU unreachable; falling back to a CPU-labeled measurement")
+        result, partial3 = _run_child(
+            {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+             "HYPEROPT_TPU_PALLAS": "0", "HYPEROPT_TPU_BENCH_PALLAS": "0",
+             "HYPEROPT_TPU_BENCH_FAST": "1"},
+            log)
+        if result is None and partial3.get("value") is not None:
+            partial = partial3
 
     out = result or partial or {}
     out.setdefault("metric", "tpe_suggest_latency_10k_cand_50dim")

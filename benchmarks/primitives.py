@@ -1,0 +1,93 @@
+"""Per-primitive latency sweep — backend pathology detector.
+
+Motivation (round 2): on the tunneled TPU backend, every suggest-step
+sub-program measured a flat ~65 ms while a 500-op elementwise chain measured
+0.026 ms.  The one op class common to all slow programs was XLA ``sort``.
+This script times each primitive the TPE hot path uses, in isolation, so a
+backend regression like that is attributable in one run.
+
+Usage (real TPU)::
+
+    python benchmarks/primitives.py            # all primitives
+    python benchmarks/primitives.py sort gather  # substring filter
+
+Prints one JSON line per primitive: {"primitive", "ms"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main(argv=None):
+    which = [a for a in (argv or sys.argv[1:])]
+
+    import jax
+    import jax.numpy as jnp
+    from jax.scipy.special import log_ndtr, ndtri
+
+    key = jax.random.key(0)
+    x1k = jax.device_put(jnp.asarray(
+        np.random.default_rng(0).normal(0, 1, 1024).astype(np.float32)))
+    m1k = jax.device_put(jnp.asarray(
+        np.random.default_rng(1).random((1024, 32)) > 0.5))
+    idx = jax.device_put(jnp.asarray(
+        np.random.default_rng(2).integers(0, 1024, 1024), jnp.int32))
+    big = jax.device_put(jnp.ones((1024, 1024), jnp.float32))
+    u = jax.device_put(jnp.linspace(0.01, 0.99, 1024).astype(jnp.float32))
+    logits = jax.device_put(jnp.zeros((32, 128), jnp.float32))
+
+    cases = {
+        "sort": lambda: jnp.sort(x1k),
+        "argsort": lambda: jnp.argsort(x1k),
+        "top_k": lambda: jax.lax.top_k(x1k, 25)[0],
+        "cumsum": lambda: jnp.cumsum(m1k.astype(jnp.float32), axis=0),
+        "searchsorted": lambda: jnp.searchsorted(jnp.sort(x1k), x1k),
+        "scatter_set": lambda: jnp.zeros(2048).at[idx].set(x1k),
+        "gather_take": lambda: jnp.take(x1k, idx),
+        "take_along_axis": lambda: jnp.take_along_axis(
+            big, idx[:, None].astype(jnp.int32) % 1024, axis=1),
+        "argmax": lambda: jnp.argmax(big, axis=1),
+        "where_inf": lambda: jnp.where(m1k[:, 0], x1k, jnp.inf).sum(),
+        "ndtri": lambda: ndtri(u),
+        "log_ndtr": lambda: log_ndtr(x1k),
+        "erf": lambda: jax.scipy.special.erf(x1k),
+        "rng_uniform": lambda: jax.random.uniform(key, (1024,)),
+        "rng_normal": lambda: jax.random.normal(key, (1024,)),
+        "rng_gumbel": lambda: jax.random.gumbel(key, (32, 128)),
+        "rng_categorical": lambda: jax.random.categorical(
+            key, logits, shape=(32,)),
+        "matmul_1k": lambda: big @ big,
+        "logsumexp": lambda: jax.scipy.special.logsumexp(big, axis=1),
+        "pairwise_rank": lambda: jnp.sum(
+            (x1k[None, :] < x1k[:, None]), axis=1),
+        "reduce_sum": lambda: big.sum(),
+    }
+
+    for name, fn in cases.items():
+        if which and not any(w in name for w in which):
+            continue
+        g = jax.jit(fn)
+        try:
+            out = g()
+            jax.block_until_ready(out)
+            ts = []
+            for _ in range(10):
+                t0 = time.perf_counter()
+                jax.block_until_ready(g())
+                ts.append((time.perf_counter() - t0) * 1e3)
+            print(json.dumps({"primitive": name,
+                              "ms": round(float(np.median(ts)), 4)}),
+                  flush=True)
+        except Exception as e:
+            print(json.dumps({"primitive": name,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -44,15 +44,53 @@ class _NullHandle(_ProgressHandle):
         pass
 
 
+class _TqdmRedirectFile:
+    """File-like that routes writes through ``tqdm.write`` so objective
+    prints land above the bar instead of mangling it (reference:
+    ``std_out_err_redirect_tqdm.py``)."""
+
+    def __init__(self, file):
+        self._file = file
+
+    def write(self, x):
+        if x.rstrip():
+            _tqdm.write(x.rstrip(), file=self._file)
+
+    def flush(self):
+        getattr(self._file, "flush", lambda: None)()
+
+    def isatty(self):
+        return getattr(self._file, "isatty", lambda: False)()
+
+
+@contextlib.contextmanager
+def std_out_err_redirect_tqdm():
+    """Redirect stdout/stderr through ``tqdm.write`` for the duration
+    (reference: ``hyperopt/std_out_err_redirect_tqdm.py``)."""
+    orig_out, orig_err = sys.stdout, sys.stderr
+    try:
+        sys.stdout = _TqdmRedirectFile(orig_out)
+        sys.stderr = _TqdmRedirectFile(orig_err)
+        yield orig_err
+    finally:
+        sys.stdout, sys.stderr = orig_out, orig_err
+
+
 @contextlib.contextmanager
 def default_callback(initial=0, total=None):
-    """tqdm progress context (reference: progress.py::default_callback)."""
+    """tqdm progress context (reference: progress.py::default_callback).
+
+    While the bar is live, stdout/stderr route through ``tqdm.write`` so
+    prints from the user's objective don't tear the bar line.
+    """
     if _tqdm is None:
         yield _NullHandle()
         return
-    with _tqdm(initial=initial, total=total, file=sys.stderr,
-               dynamic_ncols=True, disable=not sys.stderr.isatty()) as bar:
-        yield _TqdmHandle(bar)
+    with std_out_err_redirect_tqdm() as real_err:
+        with _tqdm(initial=initial, total=total, file=real_err,
+                   dynamic_ncols=True,
+                   disable=not real_err.isatty()) as bar:
+            yield _TqdmHandle(bar)
 
 
 @contextlib.contextmanager

@@ -258,3 +258,30 @@ class TestAtpeAdaptation:
              rstate=np.random.default_rng(2), show_progressbar=False)
         assert len(t) == 50
         assert t.best_trial["result"]["loss"] < 10.0
+
+
+class TestProgressRedirect:
+    def test_objective_prints_survive_progress_bar(self, capsys):
+        # reference: std_out_err_redirect_tqdm.py — prints from the
+        # objective route through tqdm.write while the bar is live.
+        from hyperopt_tpu.utils.progress import (
+            default_callback,
+            std_out_err_redirect_tqdm,
+        )
+
+        with std_out_err_redirect_tqdm():
+            print("hello-from-objective")
+        out = capsys.readouterr()
+        assert "hello-from-objective" in out.out + out.err
+
+    def test_fmin_with_progressbar_and_prints(self):
+        z = ZOO["quadratic1"]
+
+        def noisy(d):
+            print("eval!", d["x"])
+            return z.fn(d)
+
+        t = Trials()
+        fmin(noisy, z.space, algo=tpe.suggest, max_evals=5, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=True)
+        assert len(t) == 5

@@ -21,6 +21,18 @@ from .scope import scope  # noqa: F401
 from .space import compile_space
 
 
+def as_apply(obj):
+    """Identity shim for the reference's ``pyll.as_apply``.
+
+    Reference code wraps spaces with ``as_apply`` before handing them to
+    hyperopt (``pyll/base.py::as_apply`` builds Apply/Literal nodes); here
+    nested dict/list/``hp.*`` structures ARE the space representation and
+    every entry point accepts them directly, so migration code calling
+    ``pyll.as_apply(space)`` gets its input back unchanged.
+    """
+    return obj
+
+
 class stochastic:
     """Namespace mirror of ``hyperopt.pyll.stochastic``."""
 

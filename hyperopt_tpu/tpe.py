@@ -221,7 +221,10 @@ class _TpeKernel:
             raise ValueError(f"split must be 'sqrt' or 'quantile', got {split!r}")
         self.split = split
         self.pallas = _pallas_mode()
-        self.sort_mode = _sort_mode()
+        # Pairwise rank/fit is O(N²) in history capacity — a fine trade at
+        # the few-thousand-trial scale it exists for (dodging the backend
+        # sort floor), quadratic nonsense at 100k; fall back to sort there.
+        self.sort_mode = _sort_mode() if n_cap <= 8192 else "sort"
 
         cont_q, cont_n, cat = [], [], []
         for s in cs.params:

@@ -59,6 +59,13 @@ from .fmin import (  # noqa: F401
 )
 from .scope import scope  # noqa: F401
 from . import pyll_shim as pyll  # noqa: F401 — reference-compat alias
+
+# Make `import hyperopt_tpu.pyll` / `from hyperopt_tpu.pyll import scope`
+# resolve like a real submodule (reference import idiom: hyperopt.pyll).
+import sys as _sys
+
+_sys.modules[__name__ + ".pyll"] = pyll
+del _sys
 from .space import Apply, CompiledSpace, compile_space  # noqa: F401
 from .utils.early_stop import no_progress_loss  # noqa: F401
 

@@ -24,11 +24,15 @@ import time
 import numpy as np
 
 
+_RECORDS: list = []
+
+
 def _emit(name, value, unit, extra=None):
     rec = {"metric": name, "value": round(float(value), 4), "unit": unit}
     if extra:
         rec.update(extra)
     print(json.dumps(rec), flush=True)
+    _RECORDS.append(rec)
     return rec
 
 
@@ -191,6 +195,21 @@ def main(argv=None):
         bench_4_multistart()
     if want("5"):
         bench_5_100k_sweep()
+
+    # Persist for the judge: one file per run, next to this script.
+    import os
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "results_latest.json")
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover
+        backend = "unknown"
+    with open(out, "w") as f:
+        json.dump({"backend": backend, "records": _RECORDS}, f, indent=1)
+    print(f"# wrote {out}", flush=True)
 
 
 if __name__ == "__main__":

@@ -138,3 +138,15 @@ class TestEndToEnd:
              "s": scope.switch(scope.int(hp.quniform("i", 0, 1, 1)),
                                "a", "b")})
         assert "scope.int" in dot and "switch" in dot
+
+
+class TestPyllImportIdioms:
+    def test_reference_import_paths(self):
+        # the reference idioms must resolve: hyperopt.pyll -> hyperopt_tpu.pyll
+        from hyperopt_tpu.pyll import as_apply, scope as s2, stochastic
+
+        space = {"x": hp.uniform("px", 0, 1)}
+        assert as_apply(space) is space
+        assert s2 is scope
+        cfg = stochastic.sample(space, seed=0)
+        assert 0.0 <= cfg["x"] <= 1.0

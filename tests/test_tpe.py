@@ -490,3 +490,26 @@ class TestPairwiseSortMode:
         monkeypatch.setenv("HYPEROPT_TPU_SORT", "pairwise")
         t = _run("quadratic1", tpe.suggest, 0)
         assert t.best_trial["result"]["loss"] < 0.1
+
+
+class TestChunkedScoring:
+    def test_chunked_matches_direct(self, rng):
+        # The 100k-candidate sweep path: lax.map chunking must be
+        # numerically identical to one-block scoring (argmax invariance).
+        from hyperopt_tpu.space import compile_space
+        from hyperopt_tpu import hp as hp_
+        from hyperopt_tpu.tpe import _TpeKernel
+
+        cs = compile_space({"x": hp_.uniform("x", -1, 1)})
+        kern = _TpeKernel(cs, 32, 16, 25)
+
+        def score_fn(a, b):
+            return a * 2.0 + jnp.sin(b)
+
+        arrs = tuple(jnp.asarray(rng.normal(0, 1, (3, 200)), jnp.float32)
+                     for _ in range(2))
+        direct = score_fn(*arrs)
+        kern.score_chunk = 64  # force chunking (200 > 64, non-divisible)
+        chunked = kern._chunked_score(score_fn, arrs)
+        np.testing.assert_allclose(np.asarray(chunked), np.asarray(direct),
+                                   rtol=1e-6)

@@ -259,11 +259,14 @@ def _run(domain_name, algo, seed, max_evals=None):
 class TestSuggestApi:
     def test_startup_uses_random(self):
         # With fewer than n_startup_jobs done trials, docs come from rand
-        # (kernel cache never populated).
-        z = ZOO["quadratic1"]
-        t = _run("quadratic1", tpe.suggest, 0, max_evals=10)
+        # (kernel cache never populated).  Fresh space (not the shared zoo
+        # CompiledSpace, whose caches other tests legitimately populate).
+        cs = compile_space({"x0": hp.uniform("x0", -5, 5)})
+        t = Trials()
+        fmin(lambda d: (d["x0"] - 3.0) ** 2, cs, algo=tpe.suggest,
+             max_evals=10, trials=t, rstate=np.random.default_rng(0),
+             show_progressbar=False)
         assert len(t) == 10
-        cs = compile_space(z.space)
         assert not getattr(cs, "_tpe_kernels", None)
 
     def test_docs_valid_conditional(self):

@@ -27,6 +27,15 @@ class ZooDomain:
     tpe_thresh: float         # model-based search should get below this
     budget: int = 100         # max_evals for convergence tests
 
+    def __post_init__(self):
+        # Compile once and share: compile_space() passes a CompiledSpace
+        # through, so every test using z.space reuses one jitted sampler +
+        # TPE-kernel cache instead of recompiling per fmin call — on this
+        # single-core machine, compiles dominate suite wall time.
+        from hyperopt_tpu import compile_space
+
+        self.space = compile_space(self.space)
+
 
 def _quadratic1():
     return ZooDomain(

@@ -1,0 +1,28 @@
+"""Minimize a 2-D function with TPE — the 60-second tour.
+
+Run: python examples/01_basics.py
+"""
+
+import math
+
+import numpy as np
+
+import hyperopt_tpu as ho
+from hyperopt_tpu import hp
+
+
+def branin(p):
+    x, y = p["x"], p["y"]
+    return ((y - 5.1 / (4 * math.pi ** 2) * x ** 2 + 5 / math.pi * x - 6) ** 2
+            + 10 * (1 - 1 / (8 * math.pi)) * math.cos(x) + 10)
+
+
+space = {"x": hp.uniform("x", -5, 10), "y": hp.uniform("y", 0, 15)}
+
+trials = ho.Trials()
+best = ho.fmin(branin, space, algo=ho.tpe.suggest, max_evals=150,
+               trials=trials, rstate=np.random.default_rng(0))
+
+print("best point:", best)
+print("best loss :", trials.best_trial["result"]["loss"])
+print("importance:", ho.parameter_importance(trials, space))

@@ -1,0 +1,35 @@
+"""Parallel trial evaluation with real timeouts (the SparkTrials slot).
+
+PoolTrials evaluates up to `parallelism` objectives concurrently; process
+execution means an overrunning objective is actually killed at
+trial_timeout, and fmin(timeout=...) cancels all in-flight work.
+
+Run: python examples/03_parallel_evaluation.py
+"""
+
+import time
+
+import numpy as np
+
+import hyperopt_tpu as ho
+from hyperopt_tpu import hp
+from hyperopt_tpu.parallel import PoolTrials
+
+
+def objective(cfg):
+    time.sleep(0.1 + 0.2 * np.random.default_rng().random())  # "training"
+    if cfg["x"] > 4.5:
+        time.sleep(60)  # pathological region: would hang a naive runner
+    return (cfg["x"] - 2.0) ** 2
+
+
+space = {"x": hp.uniform("x", -5, 5)}
+
+trials = PoolTrials(parallelism=4, trial_timeout=2.0, execution="process")
+best = ho.fmin(objective, space, algo=ho.tpe.suggest, max_evals=32,
+               trials=trials, rstate=np.random.default_rng(0))
+
+states = [t["state"] for t in trials]
+print("best:", best)
+print(f"done: {states.count(ho.JOB_STATE_DONE)}, "
+      f"cancelled/error: {states.count(ho.JOB_STATE_ERROR)}")

@@ -1,0 +1,43 @@
+"""Distributed, elastic trial evaluation over a shared directory (the
+MongoTrials/worker topology on a filesystem store).
+
+This script plays BOTH roles for demo purposes — driver (suggests +
+enqueues) and a worker subprocess (evaluates).  In production, run the
+driver once anywhere and `hyperopt-tpu-worker --root ... --exp-key ...` on
+as many machines as you like (they may join/leave freely; crashed workers'
+jobs are requeued automatically).
+
+Run: python examples/05_distributed_workers.py
+"""
+
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+import hyperopt_tpu as ho
+from hyperopt_tpu import hp
+from hyperopt_tpu.parallel import FileTrials
+
+
+def objective(cfg):
+    return (cfg["x"] - 1.0) ** 2 + cfg["c"] * 0.1
+
+
+space = {"x": hp.uniform("x", -5, 5), "c": hp.choice("c", [0, 1, 2])}
+
+root = tempfile.mkdtemp(prefix="hyperopt-tpu-exp-")
+worker = subprocess.Popen([
+    sys.executable, "-m", "hyperopt_tpu.parallel.filestore",
+    "--root", root, "--exp-key", "demo", "--reserve-timeout", "30",
+])
+
+trials = FileTrials(root, exp_key="demo")
+best = ho.fmin(objective, space, algo=ho.tpe.suggest, max_evals=40,
+               trials=trials, rstate=np.random.default_rng(0))
+worker.wait(timeout=60)
+
+print("best:", best, "loss:", trials.best_trial["result"]["loss"])
+print("evaluated by:", {t["owner"] for t in trials if t["owner"]})
+print(f"resume later with: FileTrials({root!r}, exp_key='demo')")

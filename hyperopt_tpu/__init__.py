@@ -67,6 +67,7 @@ import sys as _sys
 _sys.modules[__name__ + ".pyll"] = pyll
 del _sys
 from .space import Apply, CompiledSpace, compile_space  # noqa: F401
+from .utils import parameter_importance  # noqa: F401
 from .utils.early_stop import no_progress_loss  # noqa: F401
 
 __version__ = "0.1.0"
@@ -77,6 +78,7 @@ __all__ = [
     "criteria", "rdists", "plotting", "graphviz", "scope", "pyll",
     "Trials", "trials_from_docs", "Domain", "Ctrl",
     "Apply", "CompiledSpace", "compile_space", "no_progress_loss",
+    "parameter_importance",
     "STATUS_NEW", "STATUS_RUNNING", "STATUS_SUSPENDED", "STATUS_OK",
     "STATUS_FAIL", "STATUS_STRINGS",
     "JOB_STATE_NEW", "JOB_STATE_RUNNING", "JOB_STATE_DONE",

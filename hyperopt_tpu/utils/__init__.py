@@ -27,3 +27,23 @@ def get_most_recent_inds(obj):
     keep = np.ones(len(obj), dtype=bool)
     keep[:-1] = sorted_data["tid"][1:] != sorted_data["tid"][:-1]
     return order[keep]
+
+
+def parameter_importance(trials, space):
+    """Per-parameter importance of a finished experiment, ``{label: score}``.
+
+    Scores are the bias-adjusted between-group variance ratio (η²) of the
+    loss across value groups (quantile bins for numeric parameters) — the
+    statistic ATPE's lockout arms use online (see
+    :func:`hyperopt_tpu.atpe.parameter_importance`).  No reference
+    equivalent (hyperopt exposes no importance API); provided because the
+    question "which hyperparameters mattered?" is the first thing asked of
+    a finished sweep.
+    """
+    from ..atpe import parameter_importance as _imp
+    from ..space import compile_space
+
+    cs = compile_space(space)
+    h = trials.history(cs)
+    imp = _imp(h, cs)
+    return {p.label: float(imp[p.pid]) for p in cs.params}

@@ -285,3 +285,18 @@ class TestProgressRedirect:
         fmin(noisy, z.space, algo=tpe.suggest, max_evals=5, trials=t,
              rstate=np.random.default_rng(0), show_progressbar=True)
         assert len(t) == 5
+
+
+class TestImportanceApi:
+    def test_labels_and_ordering(self):
+        from hyperopt_tpu.utils import parameter_importance
+
+        space = {"x": hp.uniform("x", -5, 5),
+                 "noise": hp.uniform("noise", -5, 5)}
+        t = Trials()
+        fmin(lambda d: d["x"] ** 2, space, algo=tpe.suggest, max_evals=40,
+             trials=t, rstate=np.random.default_rng(0),
+             show_progressbar=False)
+        imp = parameter_importance(t, space)
+        assert set(imp) == {"x", "noise"}
+        assert imp["x"] > imp["noise"]

@@ -177,5 +177,5 @@ ZOO = {z.name: z for z in [
     _distractor(), _gauss_wave(), _gauss_wave2(), _many_dists(),
 ]}
 
-CONVERGENCE_DOMAINS = ["quadratic1", "q1_choice", "n_arms", "branin",
-                       "distractor", "gauss_wave", "gauss_wave2"]
+CONVERGENCE_DOMAINS = ["quadratic1", "q1_lognormal", "q1_choice", "n_arms",
+                       "branin", "distractor", "gauss_wave", "gauss_wave2"]

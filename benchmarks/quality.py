@@ -1,0 +1,123 @@
+"""Optimization-quality benchmark: best-loss-at-budget across the zoo.
+
+The reference publishes no throughput numbers (BASELINE.md) — its headline
+is *optimization behavior*.  This harness measures exactly that, seeded and
+backend-independent: median best loss within each domain's budget for every
+suggest algorithm, including the beyond-reference upgrades
+(``split="quantile"``, ``multivariate=True``) so their value is a recorded
+number rather than a claim.
+
+Run (CPU is fine — algorithm quality is backend-independent)::
+
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python benchmarks/quality.py
+    python benchmarks/quality.py quadratic1 branin   # domain filter
+
+Writes ``benchmarks/quality_latest.json`` and prints one markdown table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests"))
+
+SEEDS = [0, 1, 2, 3, 4]
+
+
+def algos():
+    import hyperopt_tpu as ho
+
+    return {
+        "rand": ho.rand.suggest,
+        "anneal": ho.anneal.suggest,
+        "tpe": ho.tpe.suggest,                      # reference-parity
+        "tpe_quantile": ho.tpe.suggest_quantile,    # TPE-paper γ-quantile
+        "tpe_mv": partial(ho.tpe.suggest, split="quantile",
+                          multivariate=True, n_EI_candidates=128),
+        "atpe": ho.atpe.suggest,
+    }
+
+
+def _domain_names(which):
+    from zoo import CONVERGENCE_DOMAINS
+
+    return [n for n in CONVERGENCE_DOMAINS + ["many_dists"]
+            if not which or n in which]
+
+
+def main(argv=None):
+    """Orchestrator: one subprocess per domain.
+
+    A single process accumulating every (domain × algo × bucket) compiled
+    executable ran the LLVM JIT out of memory on the widest space
+    (observed: 'LLVM compilation error: Cannot allocate memory' on
+    many_dists after ~45 fmin runs); per-domain processes keep the
+    executable population bounded."""
+    argv = list(argv or sys.argv[1:])
+    if argv and argv[0] == "--one":
+        return _run_domains(argv[1:])
+    which = set(argv)
+    import subprocess
+
+    rows = []
+    for name in _domain_names(which):
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", name],
+            capture_output=True, text=True, env=dict(os.environ))
+        for line in r.stdout.splitlines():
+            if line.startswith("{"):
+                rec = json.loads(line)
+                rows.append(rec)
+                print(line, flush=True)
+        if r.returncode != 0:
+            print(f"# domain {name} failed rc={r.returncode}: "
+                  f"{r.stderr[-500:]}", flush=True)
+    _finish(rows)
+
+
+def _run_domains(names):
+    import hyperopt_tpu as ho
+    from zoo import ZOO
+
+    for name in names:
+        z = ZOO[name]
+        rec = {"domain": name, "budget": z.budget,
+               "best_known": z.best_loss}
+        for aname, algo in algos().items():
+            t0 = time.perf_counter()
+            finals = []
+            for s in SEEDS:
+                t = ho.Trials()
+                ho.fmin(z.fn, z.space, algo=algo, max_evals=z.budget,
+                        trials=t, rstate=np.random.default_rng(s),
+                        show_progressbar=False)
+                finals.append(t.best_trial["result"]["loss"])
+            rec[aname] = round(float(np.median(finals)), 6)
+            rec[f"{aname}_s"] = round(time.perf_counter() - t0, 1)
+        print(json.dumps(rec), flush=True)
+
+
+def _finish(rows):
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "quality_latest.json")
+    with open(out, "w") as f:
+        json.dump({"seeds": SEEDS, "rows": rows}, f, indent=1)
+
+    names = list(algos())
+    print("\n| domain | budget | " + " | ".join(names) + " |")
+    print("|" + "---|" * (len(names) + 2))
+    for r in rows:
+        print(f"| {r['domain']} | {r['budget']} | "
+              + " | ".join(f"{r[n]:.4g}" for n in names) + " |")
+    print(f"\n# wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -31,7 +31,11 @@ _STATE_NAMES = {JOB_STATE_NEW: "new", JOB_STATE_RUNNING: "running",
                 JOB_STATE_CANCEL: "cancel"}
 
 
-def summarize(trials: Trials, out=sys.stdout) -> None:
+def summarize(trials: Trials, out=None) -> None:
+    # Resolve the stream at CALL time: an import-time `out=sys.stdout`
+    # default would capture whatever stdout object existed when this module
+    # was first imported (possibly a since-closed redirection).
+    out = out if out is not None else sys.stdout
     states = Counter(t["state"] for t in trials)
     print(f"trials: {len(trials)}", file=out)
     for s, name in _STATE_NAMES.items():

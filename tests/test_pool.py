@@ -208,3 +208,22 @@ class TestShowCli:
         out = capsys.readouterr().out
         assert "trials: 5" in out and "best loss:" in out
         assert w.owner in out
+
+
+class TestShowCliPlot:
+    def test_pickle_source_with_plot(self, tmp_path, capsys):
+        import pickle
+
+        from hyperopt_tpu.show import main
+
+        t = Trials()
+        fmin(lambda d: d["x"] ** 2, _space(), algo=rand.suggest,
+             max_evals=8, trials=t, rstate=np.random.default_rng(0),
+             show_progressbar=False)
+        pkl = tmp_path / "trials.pkl"
+        with open(pkl, "wb") as f:
+            pickle.dump(t, f)
+        png = tmp_path / "history.png"
+        assert main(["--pickle", str(pkl), "--plot", str(png)]) == 0
+        out = capsys.readouterr().out
+        assert "trials: 8" in out and png.exists() and png.stat().st_size > 0

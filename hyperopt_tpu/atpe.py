@@ -69,6 +69,10 @@ def _portfolio(cs):
         # short forgetting horizon fits the posterior on recent trials only.
         dict(gamma=0.25, split="quantile", n_EI_candidates=base_cand,
              prior_weight=pw, linear_forgetting=10),
+        # Joint-vector EI (benchmarks/quality.py: wins or ties 8/9 zoo
+        # domains) — the bandit learns per-problem whether it helps.
+        dict(gamma=0.25, split="quantile", n_EI_candidates=max(base_cand, 128),
+             prior_weight=pw, multivariate=True),
     ]
     if n_params >= 3:  # lockout is meaningless on tiny spaces
         arms += [

@@ -327,3 +327,64 @@ def test_uniformint_endpoint_masses_equal():
     assert counts.sum() == 40000
     p = st.chisquare(counts).pvalue
     assert p > 1e-4, (counts, p)
+
+
+# -- structural fuzz: random nested spaces survive the full pipeline ---------
+
+
+def _random_space(rng, depth=0, counter=None):
+    if counter is None:
+        counter = [0]
+
+    def fresh():
+        counter[0] += 1
+        return f"p{counter[0]}"
+
+    roll = rng.random()
+    if depth >= 3 or roll < 0.35:
+        label = fresh()
+        kind = rng.integers(0, 6)
+        if kind == 0:
+            return hp.uniform(label, -5, 5)
+        if kind == 1:
+            return hp.loguniform(label, -3, 2)
+        if kind == 2:
+            return hp.quniform(label, 0, 20, 2)
+        if kind == 3:
+            return hp.normal(label, 0, 2)
+        if kind == 4:
+            return hp.randint(label, 7)
+        return hp.uniformint(label, 1, 9)
+    if roll < 0.5:
+        from hyperopt_tpu import scope
+        return scope.int(hp.quniform(fresh(), 1, 32, 1))
+    if roll < 0.65:
+        n = int(rng.integers(2, 4))
+        return hp.choice(fresh(), [
+            _random_space(rng, depth + 1, counter) for _ in range(n)])
+    if roll < 0.8:
+        return {f"k{i}": _random_space(rng, depth + 1, counter)
+                for i in range(rng.integers(1, 4))}
+    if roll < 0.9:
+        return [_random_space(rng, depth + 1, counter)
+                for _ in range(rng.integers(1, 3))]
+    return (42, _random_space(rng, depth + 1, counter))
+
+
+def test_fuzz_compile_sample_decode_roundtrip():
+    rng = np.random.default_rng(12345)
+    for trial in range(25):
+        space = _random_space(rng)
+        cs = ht.compile_space(space)
+        vals, active = cs.sample(jax.random.key(trial), 8)
+        vals, active = np.asarray(vals), np.asarray(active)
+        for i in range(8):
+            cfg = cs.decode_row(vals[i], active[i])
+            # decode must produce plain-python structure
+            assert not isinstance(cfg, ht.Apply)
+            # point round-trip: active-path values reproduce the config
+            point = {cs.params[p].label: vals[i, p]
+                     for p in cs.active_path_pids(
+                         {cs.params[p].label: vals[i, p]
+                          for p in range(cs.n_params)})}
+            assert str(ht.space_eval(space, point)) == str(cfg)

@@ -20,7 +20,6 @@ import os
 import pickle
 import time
 from functools import partial  # re-exported for reference parity
-from typing import Any, Callable, Optional
 
 import numpy as np
 
@@ -32,10 +31,8 @@ from .base import (
     JOB_STATE_ERROR,
     JOB_STATE_NEW,
     JOB_STATE_RUNNING,
-    STATUS_OK,
     Trials,
     coarse_utcnow,
-    miscs_update_idxs_vals,
 )
 from .exceptions import AllTrialsFailed
 from .space import compile_space

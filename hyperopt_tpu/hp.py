@@ -21,7 +21,6 @@ Sampling semantics (matching ``hyperopt/pyll/stochastic.py``):
 from __future__ import annotations
 
 from .space import (
-    CATEGORICAL,
     Choice,
     Expr,
     LOGNORMAL,

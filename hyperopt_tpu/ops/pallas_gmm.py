@@ -31,7 +31,6 @@ import math
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 _HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
 

@@ -33,7 +33,6 @@ import logging
 from typing import Optional
 
 import jax
-import numpy as np
 
 logger = logging.getLogger(__name__)
 

@@ -37,7 +37,6 @@ import logging
 import multiprocessing
 import os
 import threading
-import time
 from concurrent.futures import ThreadPoolExecutor
 
 from .. import base

@@ -24,8 +24,6 @@ used by tests (``--xla_force_host_platform_device_count``).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np

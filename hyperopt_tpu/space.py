@@ -40,8 +40,8 @@ from __future__ import annotations
 
 import math
 import operator as _operator
-from dataclasses import dataclass, field
-from typing import Any, Optional, Sequence
+from dataclasses import dataclass
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp

@@ -362,8 +362,18 @@ def fmin(fn, space, algo=None, max_evals=None,
     silently degrades to the ordinary loop otherwise.
     """
     if algo is None:
-        from . import tpe
-        algo = tpe.suggest
+        algo = "tpe"
+    if isinstance(algo, str):
+        # Convenience aliases (TPU-first addition; the reference requires
+        # the callable form, which of course still works).
+        from . import anneal, atpe, rand, tpe
+        aliases = {"tpe": tpe.suggest, "tpe_quantile": tpe.suggest_quantile,
+                   "rand": rand.suggest, "random": rand.suggest,
+                   "anneal": anneal.suggest, "atpe": atpe.suggest}
+        if algo not in aliases:
+            raise ValueError(f"unknown algo {algo!r}; one of "
+                             f"{sorted(aliases)} or a suggest callable")
+        algo = aliases[algo]
 
     if rstate is None:
         env_seed = os.environ.get("HYPEROPT_FMIN_SEED", "")

@@ -232,3 +232,18 @@ class TestOverlapSuggest:
                 rstate=np.random.default_rng(0),
                 show_progressbar=False, overlap_suggest=True)
         assert len(t) == 10
+
+
+class TestAlgoAliases:
+    def test_string_algos(self):
+        for name in ("tpe", "rand", "anneal"):
+            t = ht.Trials()
+            ht.fmin(lambda d: d["x"] ** 2, {"x": hp.uniform("x", -2, 2)},
+                    algo=name, max_evals=8, trials=t,
+                    rstate=np.random.default_rng(0), show_progressbar=False)
+            assert len(t) == 8, name
+
+    def test_unknown_alias_raises(self):
+        with pytest.raises(ValueError):
+            ht.fmin(lambda d: 0.0, {"x": hp.uniform("x", 0, 1)},
+                    algo="nope", max_evals=1, show_progressbar=False)

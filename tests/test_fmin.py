@@ -247,3 +247,15 @@ class TestAlgoAliases:
         with pytest.raises(ValueError):
             ht.fmin(lambda d: 0.0, {"x": hp.uniform("x", 0, 1)},
                     algo="nope", max_evals=1, show_progressbar=False)
+
+
+def test_overlap_with_suggest_quantile():
+    # suggest_quantile carries its own dispatch/materialize attributes;
+    # overlap must use them (not silently degrade).
+    t = ht.Trials()
+    ht.fmin(lambda d: (d["x"] + 1.0) ** 2, {"x": hp.uniform("x", -4, 4)},
+            algo=ht.tpe.suggest_quantile, max_evals=40, trials=t,
+            rstate=np.random.default_rng(0), show_progressbar=False,
+            overlap_suggest=True)
+    assert len(t) == 40
+    assert t.best_trial["result"]["loss"] < 0.5

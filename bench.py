@@ -39,13 +39,20 @@ N_CAND = 10_000
 N_HISTORY = 1_000
 TARGET_MS = 50.0
 
-# Per-phase deadlines (seconds).  Generous: first contact with the tunneled
-# TPU chip (exclusive claim) can block for minutes; compiles are 20-40s cold.
+# Per-phase SILENCE deadlines (seconds): the parent kills the child only
+# after this long with NO output in the current phase — any progress line
+# (per-rep heartbeats from _measure) resets the clock.  Generous: first
+# contact with the tunneled TPU chip (exclusive claim) can block for
+# minutes; compiles are 20-40s cold but run silently and on a single-core
+# host (this machine: nproc=1) external load can stretch them severely —
+# a round-2 run lost the chip claim for hours because a concurrent pytest
+# starved the compile past the old fixed deadline and the kill landed
+# mid-execution.  Run bench.py with the machine otherwise idle.
 PHASE_DEADLINES = {
     "init": 420.0,
-    "warmup_small": 420.0,
-    "xla_full": 600.0,
-    "sort_ab": 600.0,
+    "warmup_small": 600.0,
+    "xla_full": 900.0,
+    "sort_ab": 900.0,
     "pallas_ab": 600.0,
     "trials_sec": 420.0,
     "cpu_ref": 300.0,
@@ -67,8 +74,10 @@ def _measure(kern, hv, ha, hl, hok, reps=20):
     import jax
 
     key = jax.random.key(0)
+    t0 = time.perf_counter()
     out = kern(key, hv, ha, hl, hok, 0.25, 1.0)   # compile + warm-up
     jax.block_until_ready(out)
+    _say("compiled", {"s": round(time.perf_counter() - t0, 1)})
     times = []
     for i in range(reps):
         k = jax.random.fold_in(key, i)
@@ -76,10 +85,20 @@ def _measure(kern, hv, ha, hl, hok, reps=20):
         out = kern(k, hv, ha, hl, hok, 0.25, 1.0)
         jax.block_until_ready(out)
         times.append((time.perf_counter() - t0) * 1e3)
+        if i % 5 == 0:
+            _say("rep", {"i": i, "ms": round(times[-1], 3)})
     return float(np.median(times))
 
 
 def child():
+    # SIGTERM → clean SystemExit.  Python runs the handler only between
+    # bytecode ops, so a child blocked inside a C++ compile keeps running
+    # through the parent's grace window (and then gets SIGKILLed), while a
+    # child between device calls exits promptly and releases the TPU claim.
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
     partial = {"metric": "tpe_suggest_latency_10k_cand_50dim",
                "unit": "ms", "value": None, "vs_baseline": None}
 
@@ -291,36 +310,64 @@ def _run_child(extra_env, log):
     t = threading.Thread(target=reader, daemon=True)
     t.start()
 
-    partial = {}
-    result = None
-    phase = "init"
+    state = {"partial": {}, "result": None, "phase": "init"}
+
+    def dispatch(line):
+        if line.startswith("@phase "):
+            state["phase"] = json.loads(line[len("@phase "):])["name"]
+            log(f"phase {state['phase']} started")
+        elif line.startswith("@partial "):
+            state["partial"] = json.loads(line[len("@partial "):])
+        elif line.startswith("@result "):
+            state["result"] = json.loads(line[len("@result "):])
+        elif not (line.startswith("@rep ") or line.startswith("@compiled ")):
+            log(line)
+
+    last_activity = time.time()
     phase_start = time.time()
     seen = 0
     while True:
         while seen < len(lines):
-            line = lines[seen]
+            prev_phase = state["phase"]
+            dispatch(lines[seen])
             seen += 1
-            if line.startswith("@phase "):
-                phase = json.loads(line[len("@phase "):])["name"]
-                phase_start = time.time()
-                log(f"phase {phase} started")
-            elif line.startswith("@partial "):
-                partial = json.loads(line[len("@partial "):])
-            elif line.startswith("@result "):
-                result = json.loads(line[len("@result "):])
-            else:
-                log(line)
+            last_activity = time.time()   # any output proves liveness
+            if state["phase"] != prev_phase:
+                phase_start = last_activity
         if done.is_set():
             break
-        deadline = PHASE_DEADLINES.get(phase, 300.0)
-        if time.time() - phase_start > deadline:
-            log(f"phase {phase} exceeded {deadline:.0f}s deadline — killing")
-            proc.kill()
-            done.wait(timeout=10)
+        deadline = PHASE_DEADLINES.get(state["phase"], 300.0)
+        now = time.time()
+        # Silence deadline (primary) plus a 3x hard cap per phase: a wedged
+        # child that still emits periodic runtime log spam (stderr is merged
+        # into stdout) must not reset its way past the watchdog forever.
+        overrun = (f"{deadline:.0f}s with no output"
+                   if now - last_activity > deadline else
+                   f"hard {3 * deadline:.0f}s phase cap exceeded"
+                   if now - phase_start > 3 * deadline else None)
+        if overrun:
+            # SIGTERM first: if the child is between device calls it exits
+            # cleanly and the TPU claim is released; SIGKILL only as a last
+            # resort (killing mid-compile can wedge the tunnel's chip claim
+            # for hours — round-2 finding, .claude/skills/verify/SKILL.md).
+            log(f"phase {state['phase']}: {overrun} — terminating")
+            proc.terminate()
+            if not done.wait(timeout=20):
+                log("child ignored SIGTERM — killing")
+                proc.kill()
+                done.wait(timeout=10)
             break
         time.sleep(0.5)
     proc.wait()
-    return result, partial
+    done.wait(timeout=5)
+    # Final drain: lines the reader appended after the loop's last pass
+    # (e.g. a @result emitted just as the child exited) must not be lost —
+    # a dropped @result would misread a successful run as a failed attempt
+    # and launch a pointless retry child.
+    while seen < len(lines):
+        dispatch(lines[seen])
+        seen += 1
+    return state["result"], state["partial"]
 
 
 def main():
@@ -330,6 +377,16 @@ def main():
 
     def log(msg):
         print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+    try:
+        load1 = os.getloadavg()[0]
+        ncpu = os.cpu_count() or 1
+        if load1 > 0.5 * ncpu:
+            log(f"WARNING: load {load1:.2f} on {ncpu} cpu(s) — concurrent "
+                "work stretches silent compile phases toward the deadline; "
+                "run bench.py on an idle machine")
+    except OSError:
+        pass
 
     t0 = time.time()
     result, partial = _run_child({}, log)

@@ -66,6 +66,10 @@ import sys as _sys
 
 _sys.modules[__name__ + ".pyll"] = pyll
 del _sys
+from .parallel import FileTrials, PoolTrials  # noqa: F401 — the reference
+# exports its distributed Trials at top level too (hyperopt.SparkTrials;
+# SURVEY.md §2 package/CLI row): PoolTrials ≙ SparkTrials (local parallel
+# evaluation), FileTrials ≙ MongoTrials (durable elastic workers).
 from .space import Apply, CompiledSpace, compile_space  # noqa: F401
 from .utils import parameter_importance  # noqa: F401
 from .utils.early_stop import no_progress_loss  # noqa: F401
@@ -77,6 +81,7 @@ __all__ = [
     "partial", "hp", "tpe", "rand", "anneal", "mix", "atpe",
     "criteria", "rdists", "plotting", "graphviz", "scope", "pyll",
     "Trials", "trials_from_docs", "Domain", "Ctrl",
+    "PoolTrials", "FileTrials",
     "Apply", "CompiledSpace", "compile_space", "no_progress_loss",
     "parameter_importance",
     "STATUS_NEW", "STATUS_RUNNING", "STATUS_SUSPENDED", "STATUS_OK",

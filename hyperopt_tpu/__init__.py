@@ -53,6 +53,7 @@ from .exceptions import (  # noqa: F401
 from .fmin import (  # noqa: F401
     FMinIter,
     fmin,
+    fmin_pass_expr_memo_ctrl,
     generate_trials_to_calculate,
     partial,
     space_eval,
@@ -77,7 +78,8 @@ from .utils.early_stop import no_progress_loss  # noqa: F401
 __version__ = "0.1.0"
 
 __all__ = [
-    "fmin", "FMinIter", "space_eval", "generate_trials_to_calculate",
+    "fmin", "FMinIter", "fmin_pass_expr_memo_ctrl", "space_eval",
+    "generate_trials_to_calculate",
     "partial", "hp", "tpe", "rand", "anneal", "mix", "atpe",
     "criteria", "rdists", "plotting", "graphviz", "scope", "pyll",
     "Trials", "trials_from_docs", "Domain", "Ctrl",

@@ -51,6 +51,15 @@ def space_eval(space, hp_assignment: dict):
     return compile_space(space).eval_point(hp_assignment)
 
 
+def fmin_pass_expr_memo_ctrl(f):
+    """Decorator marking an objective as wanting ``(expr, memo, ctrl)``
+    instead of a realized config (reference:
+    ``hyperopt/fmin.py::fmin_pass_expr_memo_ctrl``); ``Domain`` reads the
+    attribute when ``fmin(..., pass_expr_memo_ctrl=None)``."""
+    f.fmin_pass_expr_memo_ctrl = True
+    return f
+
+
 def generate_trials_to_calculate(points, exp_key=None):
     """Seed a ``Trials`` with predetermined points to evaluate first.
 

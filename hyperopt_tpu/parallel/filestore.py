@@ -34,7 +34,9 @@ import os
 import pickle
 import socket
 import time
+from collections.abc import MutableMapping
 from typing import Optional
+from urllib.parse import quote, unquote
 
 try:  # serialize objectives BY VALUE (lambdas, __main__ closures) — the
     # same mechanism the reference's SparkTrials relies on (cloudpickled
@@ -66,6 +68,67 @@ def _atomic_write_json(path: str, obj) -> None:
     os.replace(tmp, path)
 
 
+class _FileAttachments(MutableMapping):
+    """Durable mapping over a directory: one pickled file per key.
+
+    Plays GridFS's role for attachments (reference: ``MongoTrials``
+    attachments stored via GridFS, ``mongoexp.py`` — SURVEY.md §2): values a
+    worker's ``Ctrl`` writes become visible to the driver (and to every other
+    worker) through the shared store, and survive re-opening the experiment.
+
+    Key files are prefixed ``k_`` + URL-quoted key; writes go through a
+    ``t_``-prefixed temp file + ``os.replace`` so readers never observe a
+    partial value.
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+
+    def _path(self, name) -> str:
+        return os.path.join(self.root, "k_" + quote(str(name), safe=""))
+
+    def __setitem__(self, name, value):
+        # makedirs only on write: reads against an archived/read-only store
+        # must not try to mutate it.
+        os.makedirs(self.root, exist_ok=True)
+        path = self._path(name)
+        tmp = os.path.join(self.root,
+                           f"t_{os.getpid()}.{time.monotonic_ns()}")
+        with open(tmp, "wb") as f:
+            _pickler.dump(value, f)
+        os.replace(tmp, path)
+
+    def __getitem__(self, name):
+        try:
+            with open(self._path(name), "rb") as f:
+                return pickle.load(f)
+        except FileNotFoundError:
+            raise KeyError(name) from None
+
+    def __delitem__(self, name):
+        try:
+            os.unlink(self._path(name))
+        except FileNotFoundError:
+            raise KeyError(name) from None
+
+    def __contains__(self, name):
+        return os.path.exists(self._path(name))
+
+    def __iter__(self):
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return iter(())
+        return (unquote(n[2:]) for n in sorted(names) if n.startswith("k_"))
+
+    def __len__(self):
+        return sum(1 for _ in self)
+
+    def clear(self):
+        for n in list(self):
+            del self[n]
+
+
 class FileTrials(Trials):
     """Durable ``Trials`` over a shared directory (MongoTrials analog).
 
@@ -86,6 +149,12 @@ class FileTrials(Trials):
         # Re-parse only files that changed; idle polls cost one scandir.
         self._doc_cache: dict = {}
         super().__init__(exp_key=exp_key, refresh=refresh)
+        # Durable attachments (GridFS analog): rebind AFTER the base init's
+        # plain-dict default so worker Ctrl writes land in the shared store
+        # and survive re-opening the experiment.  ``trial_attachments``
+        # namespaces per-trial keys into this same mapping (base.py).
+        self.attachments = _FileAttachments(
+            os.path.join(self._exp_dir, "attachments"))
 
     def __getstate__(self):
         state = super().__getstate__()
@@ -137,6 +206,21 @@ class FileTrials(Trials):
             self._ids = {d["tid"] for d in docs}
             self._trials = [d for d in docs
                             if self._exp_key in (None, d.get("exp_key"))]
+
+    def delete_all(self):
+        """Remove every trial document, tid marker, claim and attachment of
+        this experiment from the store (reference: ``MongoTrials.delete_all``
+        removes the experiment's docs server-side)."""
+        import shutil
+
+        with self._lock:
+            shutil.rmtree(self._exp_dir, ignore_errors=True)
+            os.makedirs(self._trials_dir, exist_ok=True)
+            os.makedirs(self._tids_dir, exist_ok=True)
+            self._doc_cache = {}
+            super().delete_all()   # rebinds attachments to a plain dict …
+            self.attachments = _FileAttachments(      # … restore durability
+                os.path.join(self._exp_dir, "attachments"))
 
     def new_trial_ids(self, n):
         out = []

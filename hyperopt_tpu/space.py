@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import math
 import operator as _operator
+from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -605,9 +606,31 @@ class CompiledSpace:
             return jnp.zeros((n, 0), dtype=bool)
         return jnp.stack(masks, axis=1)
 
+    # Volatile attribute names dropped from pickles: jitted callables and the
+    # suggest-kernel caches other modules attach (tpe.get_kernel,
+    # parallel.sharded — the latter holds Mesh/Device objects, which cannot
+    # pickle).  With compile_space memoized, one shared CompiledSpace
+    # accumulates them, and Domain pickling (FileTrials.save_domain,
+    # trials_save_file) must not drag them along.
+    # Register every externally-attached kernel cache here (tpe.get_kernel,
+    # anneal, parallel.sharded).
+    _VOLATILE_ATTRS = ("_sampler_cache", "_tpe_kernels", "_anneal_kernel",
+                       "_sharded_tpe_kernels", "_multi_start_fns")
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        for k in self._VOLATILE_ATTRS:
+            state.pop(k, None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._sampler_cache = {}
+
     def _jitted_sampler(self, n: int):
         fn = self._sampler_cache.get(n)
         if fn is None:
+            ensure_persistent_compilation_cache()
             fn = jax.jit(lambda key: self.sample_traced(key, n))
             self._sampler_cache[n] = fn
         return fn
@@ -699,11 +722,124 @@ class CompiledSpace:
                 f"uf={len(self._uf)}, nf={len(self._nf)}, cat={len(self._cat)})")
 
 
+_persistent_cache_checked = False
+
+
+def ensure_persistent_compilation_cache() -> None:
+    """Point JAX's persistent compilation cache at a default directory.
+
+    Called lazily right before the first jit in this process (sampler or
+    suggest-kernel build), when the backend is initialized anyway.  The TPE
+    bucket ladder costs seconds-to-minutes of XLA compiles per fresh
+    process — on the tunneled TPU each program is a 20-40 s compile — so
+    every later process (repeat experiments, workers, benchmarks) skips
+    compiles it has seen.
+
+    Default-on for the TPU backend only: CPU AOT cache loads in this XLA
+    version log a multi-KB pseudo-feature mismatch error per entry (the
+    compile-side feature list embeds tuning flags like ``+prefer-no-gather``
+    that host redetection lacks), which would spam every user process.
+    ``HYPEROPT_TPU_COMPILE_CACHE=<dir>`` forces it on for any backend,
+    ``=0`` disables, and an existing user configuration is respected.
+    """
+    global _persistent_cache_checked
+    if _persistent_cache_checked:
+        return
+    _persistent_cache_checked = True
+    import os
+
+    val = os.environ.get("HYPEROPT_TPU_COMPILE_CACHE", "")
+    if val == "0":
+        return
+    try:
+        if jax.config.jax_compilation_cache_dir:   # user already set one
+            return
+        if not val and jax.default_backend() != "tpu":
+            return
+        path = val or os.path.join(os.path.expanduser("~"),
+                                   ".cache", "hyperopt_tpu", "xla")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # The bucket ladder is many mid-sized programs; persisting from
+        # 0.1 s (default 1 s) shaved another ~25% off a fresh process's
+        # warm start (measured 4.2 s → 3.2 s for a 150-eval CPU run).
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    except Exception:   # cache plumbing must never break compilation
+        pass
+
+
+class _Uncacheable(Exception):
+    """Space contains a literal the structural cache cannot key safely."""
+
+
+# Literal leaf types whose hash/eq equality implies interchangeability.
+_VALUE_TYPES = (str, int, float, bool, bytes, type(None), np.generic)
+
+
+def _freeze(obj):
+    """Hashable structural fingerprint of a space (for the compile cache).
+
+    Equal fingerprints ⇒ the spaces compile to behaviorally identical
+    ``CompiledSpace`` objects (same columns, same template, value-equal
+    literals).  Raises :class:`_Uncacheable` on literals outside the
+    value-type whitelist (e.g. arrays, callables) — those spaces are simply
+    compiled fresh each time.
+    """
+    if isinstance(obj, Choice):
+        return ("C", obj.label,
+                None if obj.probs is None else tuple(obj.probs),
+                tuple(_freeze(o) for o in obj.options))
+    if isinstance(obj, Param):
+        return ("P", obj.label, obj.kind, obj.low, obj.high, obj.mu,
+                obj.sigma, obj.q,
+                None if obj.probs is None else tuple(obj.probs))
+    if isinstance(obj, Apply):
+        return ("A", obj.op, tuple(_freeze(a) for a in obj.args))
+    if isinstance(obj, dict):
+        # Insertion order preserved: it determines column (pid) order.
+        # Keys get the same type discrimination as value leaves (True vs 1
+        # vs 1.0 hash equal but must not share a compilation).
+        return ("D", tuple(((type(k).__name__, k), _freeze(v))
+                           for k, v in obj.items()))
+    if isinstance(obj, list):
+        return ("L", tuple(_freeze(v) for v in obj))
+    if isinstance(obj, tuple):
+        return ("T", tuple(_freeze(v) for v in obj))
+    if isinstance(obj, _VALUE_TYPES):
+        # Type name disambiguates 1 / True / 1.0 (equal hashes).
+        return ("V", type(obj).__name__, obj)
+    raise _Uncacheable(type(obj).__name__)
+
+
+_compile_cache: "OrderedDict[tuple, CompiledSpace]" = OrderedDict()
+_COMPILE_CACHE_MAX = 64
+
+
 def compile_space(space) -> CompiledSpace:
-    """Compile a nested ``hp.*`` structure into a :class:`CompiledSpace`."""
+    """Compile a nested ``hp.*`` structure into a :class:`CompiledSpace`.
+
+    Memoized on the space's structural fingerprint: repeated ``fmin`` calls
+    (or Domain/bench/sharded constructions) over an equal space share ONE
+    ``CompiledSpace`` — and with it every jitted sampler and TPE kernel
+    already compiled for it.  Without this, each ``fmin`` call re-jits the
+    whole bucket ladder: a profiled 150-eval CPU run spent 21 of 26.5 s in
+    recompiles of programs an earlier identical run had already built.
+    """
     if isinstance(space, CompiledSpace):
         return space
-    return CompiledSpace(space)
+    try:
+        key = _freeze(space)
+    except (_Uncacheable, TypeError):
+        return CompiledSpace(space)
+    cs = _compile_cache.get(key)
+    if cs is None:
+        cs = CompiledSpace(space)
+        _compile_cache[key] = cs
+        if len(_compile_cache) > _COMPILE_CACHE_MAX:
+            _compile_cache.popitem(last=False)
+    else:
+        _compile_cache.move_to_end(key)
+    return cs
 
 
 def expr_to_config(space):

@@ -268,6 +268,9 @@ class _TpeKernel:
         self.cat_priors = priors
         self.cat_offsets = offsets
 
+        from .space import ensure_persistent_compilation_cache
+
+        ensure_persistent_compilation_cache()
         self._fn = jax.jit(self._suggest_one)
         self._batch_fns = {}  # n -> jitted vmapped suggest (K proposals)
 

@@ -181,6 +181,21 @@ def test_pass_expr_memo_ctrl():
     assert "x" in seen["memo"] and isinstance(seen["ctrl"], ht.Ctrl)
 
 
+def test_fmin_pass_expr_memo_ctrl_decorator():
+    # The reference decorator spelling (hyperopt/fmin.py::
+    # fmin_pass_expr_memo_ctrl) sets the attribute Domain inspects.
+    @ht.fmin_pass_expr_memo_ctrl
+    def fn(expr, memo, ctrl):
+        return {"loss": memo["x"] ** 2, "status": ht.STATUS_OK}
+
+    assert fn.fmin_pass_expr_memo_ctrl is True
+    trials = ht.Trials()
+    ht.fmin(fn, SPACE1, algo=rand.suggest, max_evals=3, rstate=0,
+            trials=trials, show_progressbar=False)
+    assert len(trials) == 3
+    assert all(t["result"]["status"] == ht.STATUS_OK for t in trials)
+
+
 def test_fmin_with_exp_key_trials():
     # regression: suggest must stamp the Trials exp_key on new docs or
     # refresh() filters every trial out and fmin returns nothing.

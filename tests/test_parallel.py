@@ -209,6 +209,106 @@ class TestFileStore:
         ft.refresh()
         assert all(d["state"] == JOB_STATE_DONE for d in ft)
 
+    def test_domain_pickles_with_kernel_laden_shared_space(self, tmp_path):
+        # Regression: compile_space memoization shares one CompiledSpace
+        # across callers, so mesh-bound kernel caches (Device objects —
+        # unpicklable) attached by sharded/multi-start suggest must be
+        # stripped by CompiledSpace.__getstate__ or save_domain explodes
+        # with "cannot pickle 'jaxlib._jax.Device'".
+        import pickle
+        from functools import partial
+
+        from hyperopt_tpu import anneal
+
+        mesh = default_mesh(n_starts=1)
+        t = Trials()
+        fmin(_quad, _quad_space(),
+             algo=partial(sharded_suggest, mesh=mesh, n_EI_candidates=512),
+             max_evals=25, trials=t, rstate=np.random.default_rng(0),
+             show_progressbar=False)
+        fmin(_quad, _quad_space(), algo=anneal.suggest, max_evals=3,
+             trials=Trials(), rstate=np.random.default_rng(0),
+             show_progressbar=False)   # populates cs._anneal_kernel too
+        dom = Domain(_quad, _quad_space())
+        ft = FileTrials(str(tmp_path), exp_key="e1")
+        ft.save_domain(dom)                      # must not raise
+        dom2 = ft.load_domain()
+        assert dom2.evaluate({"x": 1.0}, None)["loss"] == 4.0
+        # And the sampler still works after a pickle round-trip.
+        vals, act = pickle.loads(pickle.dumps(dom)).cs.sample(
+            jax.random.key(0), 4)
+        assert vals.shape == (4, 1)
+
+    def test_durable_attachments(self, tmp_path):
+        # GridFS-analog: attachments a worker's Ctrl writes must be visible
+        # to the driver through the shared store and survive re-opening the
+        # experiment (reference: MongoTrials attachments via GridFS).
+        root = str(tmp_path)
+
+        def with_blob(d):
+            return {"loss": d["x"] ** 2, "status": "ok",
+                    "attachments": {"blob": b"weights" + b"!" * 64,
+                                    "meta": {"nested": [1, 2.5, "s"]}}}
+
+        dom = Domain(with_blob, _quad_space())
+        ft = FileTrials(root, exp_key="e1")
+        docs = rand.suggest(ft.new_trial_ids(2), dom, ft, 0)
+        ft.insert_trial_docs(docs)
+        w = FileWorker(root, exp_key="e1", domain=dom, poll_interval=0.01,
+                       reserve_timeout=0.5)
+        assert w.run() == 2
+        ft.refresh()
+        for doc in ft:
+            att = ft.trial_attachments(doc)
+            assert "blob" in att
+            assert att["blob"].startswith(b"weights")
+            assert att["meta"]["nested"] == [1, 2.5, "s"]
+        # Survives a fresh handle on the same store (separate "process").
+        ft2 = FileTrials(root, exp_key="e1")
+        assert ft2.trial_attachments(ft2[0])["blob"].startswith(b"weights")
+        # Experiment-level attachments share the durable mapping.
+        ft.attachments["exp-note"] = "hello"
+        assert ft2.attachments["exp-note"] == "hello"
+
+    def test_attachment_mapping_semantics(self, tmp_path):
+        from hyperopt_tpu.parallel.filestore import _FileAttachments
+
+        att = _FileAttachments(str(tmp_path / "att"))
+        assert len(att) == 0 and list(att) == []
+        att["plain"] = 1
+        att["with/slash and space"] = {"v": 2}     # key needs quoting
+        att["ATTACH::7::unicode-ключ"] = "v3"
+        assert set(att) == {"plain", "with/slash and space",
+                            "ATTACH::7::unicode-ключ"}
+        assert att["with/slash and space"] == {"v": 2}
+        assert "plain" in att and "missing" not in att
+        with pytest.raises(KeyError):
+            att["missing"]
+        del att["plain"]
+        assert "plain" not in att and len(att) == 2
+        with pytest.raises(KeyError):
+            del att["plain"]
+        att.clear()
+        assert len(att) == 0
+
+    def test_delete_all_wipes_store(self, tmp_path):
+        root = str(tmp_path)
+        dom = Domain(_quad, _quad_space())
+        ft = FileTrials(root, exp_key="e1")
+        docs = rand.suggest(ft.new_trial_ids(3), dom, ft, 0)
+        ft.insert_trial_docs(docs)
+        ft.attachments["note"] = 1
+        ft.delete_all()
+        assert len(ft) == 0 and "note" not in ft.attachments
+        # The wipe is durable: a fresh handle sees an empty experiment and
+        # tid allocation restarts.
+        ft2 = FileTrials(root, exp_key="e1")
+        assert len(ft2) == 0
+        assert ft2.new_trial_ids(1) == [0]
+        # Attachments stay durable after the reset.
+        ft.attachments["post"] = 2
+        assert FileTrials(root, exp_key="e1").attachments["post"] == 2
+
     def test_resume_by_exp_key(self, tmp_path):
         root = str(tmp_path)
         dom = Domain(_quad, _quad_space())

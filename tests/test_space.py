@@ -433,3 +433,60 @@ def test_compile_space_uncacheable_literals_compile_fresh():
     assert cs1 is not cs2
     out = cs1.eval_point({"c": 0})
     assert np.array_equal(out["c"], arr)
+
+
+def test_compile_space_memoizes_scope_expressions():
+    # Apply nodes participate in the fingerprint: identical expression
+    # spaces share; different ops don't.
+    from hyperopt_tpu import scope
+    mk = lambda op: {"n": op(hp.quniform("n", 1, 64, 1))}
+    cs1 = ht.compile_space(mk(scope.int))
+    cs2 = ht.compile_space(mk(scope.int))
+    cs3 = ht.compile_space(mk(scope.float))
+    assert cs1 is cs2 and cs1 is not cs3
+    assert isinstance(cs1.eval_point({"n": 4.0})["n"], int)
+
+
+def test_compile_space_dict_key_type_discrimination():
+    # True/1/1.0 hash equal; as DICT KEYS they must not share either.
+    a = ht.compile_space({1: hp.uniform("x", 0, 1)})
+    b = ht.compile_space({True: hp.uniform("x", 0, 1)})
+    assert a is not b
+    assert list(a.eval_point({"x": 0.5}).keys()) == [1]
+    assert list(b.eval_point({"x": 0.5}).keys()) == [True]
+
+
+def test_persistent_cache_knob(tmp_path, monkeypatch):
+    # ensure_persistent_compilation_cache: off by default on CPU, forced on
+    # by HYPEROPT_TPU_COMPILE_CACHE=<dir>, respects =0, never overrides an
+    # existing user configuration.
+    import hyperopt_tpu.space as sp
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        monkeypatch.setattr(sp, "_persistent_cache_checked", False)
+        jax.config.update("jax_compilation_cache_dir", None)
+        monkeypatch.delenv("HYPEROPT_TPU_COMPILE_CACHE", raising=False)
+        sp.ensure_persistent_compilation_cache()
+        assert jax.config.jax_compilation_cache_dir is None  # CPU backend
+
+        monkeypatch.setattr(sp, "_persistent_cache_checked", False)
+        monkeypatch.setenv("HYPEROPT_TPU_COMPILE_CACHE", str(tmp_path / "xc"))
+        sp.ensure_persistent_compilation_cache()
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xc")
+
+        # existing config respected
+        monkeypatch.setattr(sp, "_persistent_cache_checked", False)
+        monkeypatch.setenv("HYPEROPT_TPU_COMPILE_CACHE", str(tmp_path / "other"))
+        sp.ensure_persistent_compilation_cache()
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path / "xc")
+
+        # =0 disables
+        jax.config.update("jax_compilation_cache_dir", None)
+        monkeypatch.setattr(sp, "_persistent_cache_checked", False)
+        monkeypatch.setenv("HYPEROPT_TPU_COMPILE_CACHE", "0")
+        sp.ensure_persistent_compilation_cache()
+        assert jax.config.jax_compilation_cache_dir is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        sp._persistent_cache_checked = True

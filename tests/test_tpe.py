@@ -310,6 +310,70 @@ class TestSuggestApi:
                 assert abs(vals["f"][0] - round(vals["f"][0])) < 1e-5
 
 
+    def test_gamma_zero_empty_below_set(self):
+        # gamma=0 → n_below=0: the below model is the bare prior; the step
+        # must still produce finite proposals (reference tolerates tiny
+        # below sets the same way — the prior component is always present).
+        t = _run("quadratic1", tpe.suggest, 0, max_evals=25)
+        from hyperopt_tpu.base import Domain
+        z = ZOO["quadratic1"]
+        d = Domain(z.fn, z.space)
+        docs = tpe.suggest([200], d, t, 3, gamma=0.0)
+        x = docs[0]["misc"]["vals"]["x"][0]
+        assert np.isfinite(x) and -5 <= x <= 5
+
+    def test_extreme_prior_weight(self):
+        # prior_weight extremes must not NaN the posterior: ~0 (history
+        # only) and huge (prior only) both stay finite and in-bounds.
+        from hyperopt_tpu.base import Domain
+        z = ZOO["quadratic1"]
+        d = Domain(z.fn, z.space)
+        t = _run("quadratic1", tpe.suggest, 0, max_evals=25)
+        for pw in (1e-6, 1e6):
+            docs = tpe.suggest([300], d, t, 5, prior_weight=pw)
+            x = docs[0]["misc"]["vals"]["x"][0]
+            assert np.isfinite(x) and -5 <= x <= 5, pw
+
+    def test_all_failed_history_falls_back_to_random(self):
+        # A history with zero ok trials (every objective raised) must keep
+        # suggesting (startup/random path), not crash on an empty γ-split.
+        from hyperopt_tpu.base import Domain
+
+        def boom(d):
+            raise RuntimeError("boom")
+
+        space = {"x": hp.uniform("x", -5, 5)}
+        d = Domain(boom, space)
+        t = Trials()
+        from hyperopt_tpu.exceptions import AllTrialsFailed
+        with pytest.raises(AllTrialsFailed):
+            fmin(boom, space, algo=tpe.suggest, max_evals=25, trials=t,
+                 rstate=np.random.default_rng(0), show_progressbar=False,
+                 catch_eval_exceptions=True)
+        assert len(t) == 25            # kept proposing through 25 failures
+        docs = tpe.suggest([500], d, t, 9)   # and still proposes after
+        assert np.isfinite(docs[0]["misc"]["vals"]["x"][0])
+
+    def test_pchoice_posterior_concentrates_on_good_option(self):
+        # A loss gradient favoring the LOWEST-prior option must dominate
+        # the pchoice prior once history accumulates: TPE's below-model
+        # counts beat the 0.1 prior mass on option "c".
+        from hyperopt_tpu.base import Domain
+        space = {"c": hp.pchoice("c", [(0.7, "a"), (0.2, "b"), (0.1, "c")])}
+
+        def fn(cfg):
+            return {"a": 2.0, "b": 1.0, "c": 0.0}[cfg["c"]]
+
+        d = Domain(fn, space)
+        t = Trials()
+        fmin(fn, space, algo=tpe.suggest, max_evals=40, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        docs = tpe.suggest(list(range(1000, 1032)), d, t, 11)
+        picks = [doc["misc"]["vals"]["c"][0] for doc in docs]
+        counts = np.bincount(picks, minlength=3)
+        assert counts[2] > counts[0], counts
+
+
 # ---------------------------------------------------------------------------
 # end-to-end statistical assertions
 # ---------------------------------------------------------------------------

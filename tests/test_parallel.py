@@ -141,6 +141,40 @@ class TestFileStore:
         assert sorted(counts) == list(range(10))
         assert all(c == 1 for c in counts.values()), counts
 
+    def test_atomic_claim_across_processes(self, tmp_path):
+        # The exclusive-create claim must hold across real OS processes
+        # (threads share the interpreter; this is the MongoDB-grade
+        # guarantee the reference gets from find_and_modify).  Each worker
+        # subprocess stamps every trial it wins; the union must be exactly
+        # the job set with no double-claims.
+        root = str(tmp_path)
+        dom = Domain(_quad, _quad_space())
+        ft = FileTrials(root, exp_key="e1")
+        ft.save_domain(dom)
+        docs = rand.suggest(ft.new_trial_ids(30), dom, ft, 0)
+        ft.insert_trial_docs(docs)
+        repo = os.path.dirname(os.path.dirname(__file__))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PALLAS_AXON_POOL_IPS="",
+                   PYTHONPATH=f"{repo}:{os.path.dirname(__file__)}")
+        procs = [subprocess.Popen(
+            [sys.executable, "-m", "hyperopt_tpu.parallel.filestore",
+             "--root", root, "--exp-key", "e1", "--reserve-timeout", "3",
+             "--poll-interval", "0.01"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+            for _ in range(3)]
+        for p in procs:
+            p.wait(timeout=240)
+        ft.refresh()
+        assert len(ft) == 30
+        assert all(d["state"] == JOB_STATE_DONE for d in ft)
+        owners = {d["owner"] for d in ft}
+        assert len(owners) >= 2, "expected work spread across processes"
+        # one claim file per trial, each matching the doc's owner
+        for d in ft:
+            with open(ft._claim_path(d["tid"])) as f:
+                assert f.read() == d["owner"]
+
     def test_requeue_stale_and_ownership_fencing(self, tmp_path):
         root = str(tmp_path)
         dom = Domain(_quad, _quad_space())

@@ -55,6 +55,12 @@ def summarize(trials: Trials, out=None) -> None:
         print("workers:", file=out)
         for owner, n in owners.most_common():
             print(f"  {owner}: {n}", file=out)
+    try:
+        n_att = len(trials.attachments)
+    except Exception:
+        n_att = 0
+    if n_att:
+        print(f"attachments: {n_att}", file=out)
 
 
 def main(argv=None):

@@ -41,6 +41,7 @@ def algos():
         "tpe_quantile": ho.tpe.suggest_quantile,    # TPE-paper γ-quantile
         "tpe_mv": partial(ho.tpe.suggest, split="quantile",
                           multivariate=True, n_EI_candidates=128),
+        "tpe_sobol": partial(ho.tpe.suggest, startup="qmc"),  # Sobol warm-start
         "atpe": ho.atpe.suggest,
     }
 

@@ -22,6 +22,7 @@ from . import (  # noqa: F401
     hp,
     mix,
     plotting,
+    qmc,
     rand,
     rdists,
     tpe,
@@ -80,7 +81,7 @@ __version__ = "0.1.0"
 __all__ = [
     "fmin", "FMinIter", "fmin_pass_expr_memo_ctrl", "space_eval",
     "generate_trials_to_calculate",
-    "partial", "hp", "tpe", "rand", "anneal", "mix", "atpe",
+    "partial", "hp", "tpe", "rand", "anneal", "mix", "atpe", "qmc",
     "criteria", "rdists", "plotting", "graphviz", "scope", "pyll",
     "Trials", "trials_from_docs", "Domain", "Ctrl",
     "PoolTrials", "FileTrials",

@@ -1,0 +1,141 @@
+"""Quasi-Monte-Carlo suggest: scrambled-Sobol / Halton low-discrepancy search.
+
+Beyond-reference addition (upstream hyperopt has only pseudo-random
+``rand.suggest`` — SURVEY.md §2 rand.py): a low-discrepancy sequence covers
+the search space far more evenly at small budgets, which matters exactly
+where the reference's defaults live — the ``n_startup_jobs=20`` warm-start
+trials that seed TPE's first posterior.  Use standalone::
+
+    fmin(fn, space, algo=hyperopt_tpu.qmc.suggest, ...)
+
+or as TPE's startup phase (string alias or the module itself)::
+
+    fmin(fn, space, algo=partial(tpe.suggest, startup="qmc"), ...)
+
+Design: startup-scale work (tens of points, P columns) is host-side numpy —
+one inverse-CDF transform per distribution family over the unit hypercube,
+then the compiled space's activity mask.  No device round-trip; the jitted
+path stays reserved for the EI sweeps where the FLOPs are.
+
+Successive calls CONTINUE the sequence: one engine is cached per (trials
+object, engine name, dimension) — scrambled with the FIRST call's seed,
+fast-forwarded past any pre-existing trials (resume), then advanced
+naturally — so 20 trials enqueued one-at-a-time cover the hypercube
+exactly like 20 enqueued at once.  Later calls' seeds are deliberately
+ignored: re-scrambling mid-experiment would destroy the joint
+low-discrepancy property.  A resumed experiment (fresh Trials handle)
+starts a new scramble at the right sequence position.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy import special
+from scipy.stats import qmc as _qmc
+
+from . import base
+from .space import (
+    CATEGORICAL,
+    LOGNORMAL,
+    LOGUNIFORM,
+    NORMAL,
+    QLOGNORMAL,
+    QLOGUNIFORM,
+    QNORMAL,
+    QUNIFORM,
+    RANDINT,
+    UNIFORMINT,
+    UNIFORM,
+)
+
+_LOG_KINDS = (LOGUNIFORM, QLOGUNIFORM, LOGNORMAL, QLOGNORMAL)
+
+
+def _transform_column(spec, u):
+    """Inverse-CDF map of uniform[0,1) draws ``u`` onto one parameter."""
+    kind = spec.kind
+    if kind == CATEGORICAL or (kind == RANDINT and spec.probs is not None):
+        probs = np.asarray(spec.probs, dtype=np.float64)
+        edges = np.cumsum(probs)
+        edges[-1] = 1.0                      # guard fp round-down
+        v = np.searchsorted(edges, u, side="right").astype(np.float64)
+        if kind == RANDINT and spec.low:
+            v += spec.low
+        return v
+    if kind in (UNIFORM, LOGUNIFORM, QUNIFORM, QLOGUNIFORM):
+        z = spec.low + u * (spec.high - spec.low)
+    elif kind == UNIFORMINT:
+        return np.floor(spec.low + u * (spec.high - spec.low + 1)).clip(
+            spec.low, spec.high)
+    elif kind == RANDINT:
+        return np.floor(spec.low + u * (spec.high - spec.low)).clip(
+            spec.low, spec.high - 1)
+    else:   # normal family: mu + sigma * Phi^-1(u)
+        z = spec.mu + spec.sigma * special.ndtri(np.clip(u, 1e-12, 1 - 1e-12))
+    if kind in _LOG_KINDS:
+        z = np.exp(z)
+    if spec.q:
+        z = np.round(z / spec.q) * spec.q
+        if kind in (QUNIFORM, QLOGUNIFORM):
+            lo = np.exp(spec.low) if kind == QLOGUNIFORM else spec.low
+            hi = np.exp(spec.high) if kind == QLOGUNIFORM else spec.high
+            z = np.clip(z, np.round(lo / spec.q) * spec.q,
+                        np.round(hi / spec.q) * spec.q)
+    return z
+
+
+# One engine per (trials object, engine name, dim), held weakly so it dies
+# with the experiment.  The scramble seed must stay FIXED while the
+# sequence position advances — re-scrambling per fmin iteration (each call
+# gets a fresh `seed` from the rstate stream) would destroy the joint
+# low-discrepancy property the module exists for.
+_engines = None
+
+
+def _engine_for(trials, name, dim, seed):
+    global _engines
+    if _engines is None:
+        import weakref
+
+        _engines = weakref.WeakKeyDictionary()
+    per_trials = _engines.setdefault(trials, {})
+    key = (name, dim)
+    eng = per_trials.get(key)
+    if eng is None:
+        cls = {"sobol": _qmc.Sobol, "halton": _qmc.Halton}[name]
+        eng = cls(d=dim, scramble=True, seed=int(seed) % (2 ** 32))
+        # Resume case (pre-existing trials, e.g. exp_key/pickle resume):
+        # skip the points the experiment already consumed.  The re-scramble
+        # only affects joint uniformity across the resume boundary.
+        if len(trials):
+            eng.fast_forward(len(trials))
+        per_trials[key] = eng
+    return eng
+
+
+def suggest_batch(new_ids, domain, trials, seed, engine="sobol"):
+    """Raw (vals[n, P], active[n, P]) low-discrepancy samples."""
+    cs = domain.cs
+    n = len(new_ids)
+    if n == 0 or cs.n_params == 0:
+        return (np.zeros((n, cs.n_params), np.float32),
+                np.ones((n, cs.n_params), bool))
+    eng = _engine_for(trials, engine, cs.n_params, seed)
+    u = eng.random(n)                                    # [n, P] in [0, 1)
+    vals = np.zeros((n, cs.n_params), np.float32)
+    for j, spec in enumerate(cs.params):
+        vals[:, j] = _transform_column(spec, u[:, j])
+    active = np.asarray(cs.active_mask(vals))
+    return vals, active
+
+
+def suggest(new_ids, domain, trials, seed, engine="sobol"):
+    """QMC suggest (plugin contract: ``suggest(new_ids, domain, trials,
+    seed)``).  ``engine`` is ``"sobol"`` (default) or ``"halton"``."""
+    vals, active = suggest_batch(new_ids, domain, trials, seed, engine=engine)
+    return base.docs_from_samples(domain.cs, new_ids, vals, active,
+                                  exp_key=getattr(trials, "exp_key", None))
+
+
+def suggest_halton(new_ids, domain, trials, seed):
+    return suggest(new_ids, domain, trials, seed, engine="halton")

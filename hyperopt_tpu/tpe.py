@@ -608,13 +608,36 @@ def _padded_history(h, n_cap):
 # ---------------------------------------------------------------------------
 
 
+def _startup_batch(startup, new_ids, domain, trials, seed):
+    """Resolve the warm-start sampler: None/'rand' → pseudo-random
+    (reference behavior), 'qmc'/'sobol'/'halton' → low-discrepancy
+    (:mod:`hyperopt_tpu.qmc`), else a suggest_batch-style callable."""
+    if startup in (None, "rand"):
+        return rand.suggest_batch(new_ids, domain, trials, seed)
+    if startup in ("qmc", "sobol", "halton"):
+        from . import qmc
+
+        eng = "halton" if startup == "halton" else "sobol"
+        return qmc.suggest_batch(new_ids, domain, trials, seed, engine=eng)
+    if hasattr(startup, "suggest_batch"):
+        return startup.suggest_batch(new_ids, domain, trials, seed)
+    out = startup(new_ids, domain, trials, seed)
+    if not (isinstance(out, tuple) and len(out) == 2):
+        raise TypeError(
+            "startup callable must return (vals[n,P], active[n,P]) — got "
+            f"{type(out).__name__}. Pass a module with .suggest_batch "
+            "(e.g. startup=qmc) or the string 'qmc', not a doc-returning "
+            "suggest function.")
+    return out
+
+
 def suggest(new_ids, domain, trials, seed,
             prior_weight=_default_prior_weight,
             n_startup_jobs=_default_n_startup_jobs,
             n_EI_candidates=_default_n_EI_candidates,
             gamma=_default_gamma,
             linear_forgetting=_default_linear_forgetting,
-            split="sqrt", multivariate=False,
+            split="sqrt", multivariate=False, startup=None,
             verbose=True):
     """TPE suggest (reference signature: ``hyperopt/tpe.py::suggest`` ~L800).
 
@@ -622,12 +645,14 @@ def suggest(new_ids, domain, trials, seed,
     exactly like the reference.  ``split='quantile'`` opts into the
     TPE-paper γ-quantile below-set (faster concentration than the
     reference's ``gamma·sqrt(N)``); see :func:`suggest_quantile`.
+    ``startup='qmc'`` replaces the random warm-start phase with scrambled
+    Sobol (better first-posterior coverage; beyond-reference upgrade).
     """
     vals, active = suggest_batch(
         new_ids, domain, trials, seed, prior_weight=prior_weight,
         n_startup_jobs=n_startup_jobs, n_EI_candidates=n_EI_candidates,
         gamma=gamma, linear_forgetting=linear_forgetting, split=split,
-        multivariate=multivariate)
+        multivariate=multivariate, startup=startup)
     return base.docs_from_samples(domain.cs, new_ids, vals, active,
                                   exp_key=getattr(trials, "exp_key", None))
 
@@ -638,13 +663,13 @@ def suggest_batch(new_ids, domain, trials, seed,
                   n_EI_candidates=_default_n_EI_candidates,
                   gamma=_default_gamma,
                   linear_forgetting=_default_linear_forgetting,
-                  split="sqrt", multivariate=False):
+                  split="sqrt", multivariate=False, startup=None):
     """Raw (vals[n, P], active[n, P]) suggestions without doc packaging."""
     handle = suggest_dispatch(
         new_ids, domain, trials, seed, prior_weight=prior_weight,
         n_startup_jobs=n_startup_jobs, n_EI_candidates=n_EI_candidates,
         gamma=gamma, linear_forgetting=linear_forgetting, split=split,
-        multivariate=multivariate)
+        multivariate=multivariate, startup=startup)
     rows, acts = handle[3]
     return np.asarray(rows), np.asarray(acts)
 
@@ -666,7 +691,7 @@ def suggest_dispatch(new_ids, domain, trials, seed,
                      n_EI_candidates=_default_n_EI_candidates,
                      gamma=_default_gamma,
                      linear_forgetting=_default_linear_forgetting,
-                     split="sqrt", multivariate=False,
+                     split="sqrt", multivariate=False, startup=None,
                      verbose=True):
     """Enqueue the suggest computation on device; returns an opaque handle
     for :func:`suggest_materialize`.  History is snapshotted NOW — a handle
@@ -688,7 +713,7 @@ def suggest_dispatch(new_ids, domain, trials, seed,
                  np.ones((n, cs.n_params), bool)), exp_key)
     h = trials.history(cs)
     if int(h["ok"].sum()) < n_startup_jobs:
-        v, a = rand.suggest_batch(new_ids, domain, trials, seed)
+        v, a = _startup_batch(startup, new_ids, domain, trials, seed)
         return ("ready", cs, list(new_ids),
                 (np.asarray(v), np.asarray(a)), exp_key)
     kern = get_kernel(cs, _bucket(h["vals"].shape[0]),

@@ -1,0 +1,132 @@
+"""Quasi-Monte-Carlo suggest tests: stratification, per-kind support
+membership, sequence continuation, conditional masks, TPE startup hook."""
+
+import numpy as np
+import pytest
+
+import hyperopt_tpu as ht
+from hyperopt_tpu import Trials, fmin, hp, qmc, tpe
+from hyperopt_tpu.base import Domain
+
+from zoo import ZOO
+
+
+def _docs(space, n, seed=0, engine="sobol", trials=None):
+    d = Domain(lambda cfg: 0.0, space)
+    t = trials if trials is not None else Trials()
+    return qmc.suggest(list(range(len(t), len(t) + n)), d, t, seed,
+                       engine=engine), d, t
+
+
+class TestStratification:
+    def test_sobol_16_points_hit_all_16_bins(self):
+        # Scrambled Sobol at n=2^m is a (0,m,1)-net: each of 16 equal bins
+        # of a 1-D uniform gets exactly one point.  Random search puts ~63%
+        # probability on missing at least one bin — this is the property
+        # the module exists for.
+        docs, _, _ = _docs({"x": hp.uniform("x", 0.0, 16.0)}, 16)
+        xs = [doc["misc"]["vals"]["x"][0] for doc in docs]
+        bins = np.floor(np.asarray(xs)).astype(int)
+        assert sorted(bins.tolist()) == list(range(16))
+
+    def test_sequence_continues_across_calls(self):
+        # 8 + 8 points from TWO suggest calls must form the same net as 16
+        # from one call — the engine is cached per experiment and advances.
+        space = {"x": hp.uniform("x", 0.0, 16.0)}
+        d = Domain(lambda cfg: 0.0, space)
+        t = Trials()
+        docs1 = qmc.suggest(list(range(8)), d, t, 0)
+        t.insert_trial_docs(docs1)
+        t.refresh()
+        docs2 = qmc.suggest(list(range(8, 16)), d, t, 999)  # seed ignored
+        xs = [doc["misc"]["vals"]["x"][0] for doc in docs1 + docs2]
+        bins = np.floor(np.asarray(xs)).astype(int)
+        assert sorted(bins.tolist()) == list(range(16))
+
+    def test_halton_covers_bins(self):
+        docs, _, _ = _docs({"x": hp.uniform("x", 0.0, 8.0)}, 32,
+                           engine="halton")
+        xs = [doc["misc"]["vals"]["x"][0] for doc in docs]
+        assert len(set(np.floor(xs).astype(int))) == 8
+
+
+class TestKinds:
+    def test_many_dists_support_membership(self):
+        # Every distribution family: draws land on the right support
+        # (ints are ints, quantized on lattice, bounds respected).
+        z = ZOO["many_dists"]
+        t = Trials()
+        best = fmin(z.fn, z.space, algo=qmc.suggest, max_evals=40, trials=t,
+                    rstate=np.random.default_rng(0), show_progressbar=False)
+        assert len(t) == 40
+        for doc in t:
+            vals = doc["misc"]["vals"]
+            for label in ("a", "b", "bb", "k", "l"):
+                if vals.get(label):
+                    assert isinstance(vals[label][0], int), (label, vals)
+            if vals.get("e"):
+                assert vals["e"][0] % 2 == 0          # quniform(1, 10, 2)
+        assert np.isfinite(z.fn(ht.space_eval(z.space, best)))
+
+    def test_normal_family_inverse_cdf(self):
+        # 256 Sobol points through Phi^-1 reproduce N(mu, sigma) closely:
+        # sample mean/std tighter than pseudo-random at the same n.
+        docs, _, _ = _docs({"g": hp.normal("g", 3.0, 2.0)}, 256)
+        g = np.asarray([doc["misc"]["vals"]["g"][0] for doc in docs])
+        assert abs(g.mean() - 3.0) < 0.1
+        assert abs(g.std() - 2.0) < 0.15
+
+    def test_conditional_masks_consistent(self):
+        space = {"b": hp.choice("b", [
+            {"k": "a", "lr": hp.loguniform("lr", -5, 0)},
+            {"k": "b", "n": hp.uniformint("n", 1, 8)}])}
+        docs, _, _ = _docs(space, 32)
+        for doc in docs:
+            vals = doc["misc"]["vals"]
+            branch = vals["b"][0]
+            assert (len(vals["lr"]) == 1) == (branch == 0)
+            assert (len(vals["n"]) == 1) == (branch == 1)
+
+    def test_pchoice_frequencies(self):
+        space = {"c": hp.pchoice("c", [(0.5, "x"), (0.25, "y"), (0.25, "z")])}
+        docs, _, _ = _docs(space, 64)
+        picks = np.asarray([doc["misc"]["vals"]["c"][0] for doc in docs])
+        counts = np.bincount(picks, minlength=3)
+        # QMC tracks the target proportions tightly even at n=64.
+        assert abs(counts[0] - 32) <= 6 and abs(counts[1] - 16) <= 5
+
+
+class TestTpeStartup:
+    def test_startup_qmc_runs_and_converges(self):
+        z = ZOO["quadratic1"]
+        t = Trials()
+        algo = ht.partial(tpe.suggest, startup="qmc")
+        fmin(z.fn, z.space, algo=algo, max_evals=40, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert len(t) == 40
+        assert t.best_trial["result"]["loss"] < z.rand_thresh
+
+    def test_startup_phase_is_low_discrepancy(self):
+        # The first n_startup trials are the Sobol net, not random draws.
+        space = {"x": hp.uniform("x", 0.0, 16.0)}
+        t = Trials()
+        algo = ht.partial(tpe.suggest, startup="qmc", n_startup_jobs=16)
+        fmin(lambda cfg: cfg["x"], space, algo=algo, max_evals=16, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        xs = [doc["misc"]["vals"]["x"][0] for doc in t]
+        assert sorted(np.floor(xs).astype(int).tolist()) == list(range(16))
+
+    def test_startup_callable(self):
+        calls = []
+
+        def my_startup(new_ids, domain, trials, seed):
+            calls.append(len(new_ids))
+            from hyperopt_tpu import rand
+            return rand.suggest_batch(new_ids, domain, trials, seed)
+
+        t = Trials()
+        algo = ht.partial(tpe.suggest, startup=my_startup, n_startup_jobs=5)
+        fmin(lambda cfg: cfg["x"] ** 2, {"x": hp.uniform("x", -1, 1)},
+             algo=algo, max_evals=8, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert sum(calls) == 5 and len(t) == 8

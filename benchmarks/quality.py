@@ -25,8 +25,9 @@ from functools import partial
 
 import numpy as np
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "tests"))
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+sys.path.insert(0, _ROOT)   # hyperopt_tpu importable when run as a script
 
 SEEDS = [0, 1, 2, 3, 4]
 

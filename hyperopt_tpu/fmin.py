@@ -375,9 +375,12 @@ def fmin(fn, space, algo=None, max_evals=None,
     if isinstance(algo, str):
         # Convenience aliases (TPU-first addition; the reference requires
         # the callable form, which of course still works).
-        from . import anneal, atpe, rand, tpe
+        from . import anneal, atpe, qmc, rand, tpe
         aliases = {"tpe": tpe.suggest, "tpe_quantile": tpe.suggest_quantile,
+                   "tpe_sobol": partial(tpe.suggest, startup="qmc"),
                    "rand": rand.suggest, "random": rand.suggest,
+                   "qmc": qmc.suggest, "sobol": qmc.suggest,
+                   "halton": qmc.suggest_halton,
                    "anneal": anneal.suggest, "atpe": atpe.suggest}
         if algo not in aliases:
             raise ValueError(f"unknown algo {algo!r}; one of "

@@ -271,6 +271,7 @@ class _TpeKernel:
         from .space import ensure_persistent_compilation_cache
 
         ensure_persistent_compilation_cache()
+        self._pick_score_chunk()
         self._fn = jax.jit(self._suggest_one)
         self._batch_fns = {}  # n -> jitted vmapped suggest (K proposals)
 
@@ -287,7 +288,17 @@ class _TpeKernel:
     # Score chunking: the above-model lpdf broadcast is [C, n_cand, N+1];
     # for 100k-candidate sweeps that is tens of GB if materialized, so the
     # candidate axis is processed in lax.map chunks beyond this threshold.
+    # TPU wants wide chunks (parallelism per dispatch); on CPU the working
+    # set should stay cache-resident — 512 measured 22% faster than 4096 at
+    # the 10k-cand × 50-dim bench shape (3.2 s vs 4.1 s).
     score_chunk = 4096
+
+    def _pick_score_chunk(self):
+        try:
+            if jax.default_backend() != "tpu":
+                self.score_chunk = 512
+        except Exception:
+            pass
 
     def _chunked_score(self, score_fn, arrs):
         n_cand = arrs[0].shape[-1]

@@ -49,6 +49,47 @@ class TestPallasEiKernel:
         # constant shift leaves the winner unchanged
         np.testing.assert_array_equal(np.argmax(got, 1), np.argmax(want, 1))
 
+    @pytest.mark.parametrize("c,n,kb,ka,tile", [
+        (8, 2048, 32, 128, 512),     # bench pallas_allclose shape
+        (10, 4096, 32, 1032, 256),   # flagship-bench-like: big above model
+        (2, 1000, 26, 1026, 256),    # n % tile != 0 AND k % 128 != 0 pads
+        (1, 128, 1, 1, 128),         # single-component mixtures
+    ])
+    def test_bench_shapes_match_xla(self, rng, c, n, kb, ka, tile):
+        # The exact tile/K/N shapes bench.py's pallas_ab phase runs on the
+        # real chip — validated in interpret mode so a native failure at
+        # round end can only come from lowering, not from kernel math.
+        below = _random_mixture(rng, c, kb, kb)
+        above = _random_mixture(rng, c, ka, max(1, ka - 7))
+        z = jnp.asarray(rng.normal(0, 3, (c, n)).astype(np.float32))
+        got = np.asarray(ei_scores(z, *below, *above, tile=tile,
+                                   interpret=True))
+        lo = jnp.full((c,), -jnp.inf)
+        hi = jnp.full((c,), jnp.inf)
+        sb = jax.vmap(gmm_logpdf, in_axes=(0,) * 6)
+        want = np.asarray(sb(z, *below, lo, hi) - sb(z, *above, lo, hi))
+        _, zb = jax.vmap(_log_trunc_mass, in_axes=(0, 0, 0, None, None))(
+            below[0], below[1], below[2], -jnp.inf, jnp.inf)
+        _, za = jax.vmap(_log_trunc_mass, in_axes=(0, 0, 0, None, None))(
+            above[0], above[1], above[2], -jnp.inf, jnp.inf)
+        shift = np.asarray(za - zb)[:, None]
+        np.testing.assert_allclose(got + shift, want, rtol=5e-4, atol=5e-4)
+        np.testing.assert_array_equal(np.argmax(got, 1), np.argmax(want, 1))
+
+    def test_extreme_values_stay_finite(self, rng):
+        # Far-tail candidates against narrow/wide components: the fused
+        # logsumexp must not overflow to nan/inf differences.
+        c, n = 2, 256
+        logw = jnp.log(jnp.asarray([[0.5, 0.5], [0.9, 0.1]], jnp.float32))
+        mu = jnp.asarray([[-50.0, 50.0], [0.0, 1e4]], jnp.float32)
+        sg = jnp.asarray([[1e-3, 1e3], [0.5, 10.0]], jnp.float32)
+        z = jnp.asarray(rng.uniform(-1e4, 1e4, (c, n)).astype(np.float32))
+        out = np.asarray(ei_scores(z, logw, mu, sg, logw, mu, sg,
+                                   tile=128, interpret=True))
+        assert np.isfinite(out).all()
+        # identical below/above mixtures → EI identically ~0
+        np.testing.assert_allclose(out, 0.0, atol=1e-3)
+
     def test_end_to_end_interpret_mode(self, monkeypatch):
         # A whole TPE run through the Pallas (interpret) path converges the
         # same way the XLA path does.

@@ -589,6 +589,37 @@ def _bucket(n: int) -> int:
     return max(32, 1 << max(n - 1, 1).bit_length())
 
 
+def _prewarm_async(kern: _TpeKernel) -> None:
+    """Compile ``kern``'s suggest program in a daemon thread (AOT lower +
+    compile, no execution).  Called for the NEXT history bucket while the
+    current one still has headroom, so the O(log N) mid-run recompile
+    stalls overlap with objective evaluations instead of blocking a
+    suggest call.  Best-effort: any failure leaves the normal lazy-compile
+    path untouched."""
+    if getattr(kern, "_prewarmed", False):
+        return
+    kern._prewarmed = True
+
+    def _go():
+        try:
+            f32 = jnp.float32
+            sd = jax.ShapeDtypeStruct
+            n_cap, p = kern.n_cap, kern.cs.n_params
+            args = (sd((), jax.random.key(0).dtype),
+                    sd((n_cap, p), f32), sd((n_cap, p), jnp.bool_),
+                    sd((n_cap,), f32), sd((n_cap,), jnp.bool_),
+                    sd((), f32), sd((), f32))
+            kern._fn.lower(*args).compile()
+        except Exception:   # pragma: no cover - purely opportunistic
+            logger = __import__("logging").getLogger(__name__)
+            logger.debug("bucket prewarm failed", exc_info=True)
+
+    import threading
+
+    threading.Thread(target=_go, daemon=True,
+                     name=f"tpe-prewarm-{kern.n_cap}").start()
+
+
 def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
                split: str = "sqrt", multivariate: bool = False) -> _TpeKernel:
     cache = getattr(cs, "_tpe_kernels", None)
@@ -727,9 +758,16 @@ def suggest_dispatch(new_ids, domain, trials, seed,
         v, a = _startup_batch(startup, new_ids, domain, trials, seed)
         return ("ready", cs, list(new_ids),
                 (np.asarray(v), np.asarray(a)), exp_key)
-    kern = get_kernel(cs, _bucket(h["vals"].shape[0]),
+    n_rows = h["vals"].shape[0]
+    kern = get_kernel(cs, _bucket(n_rows),
                       int(n_EI_candidates), int(linear_forgetting), split,
                       multivariate)
+    if n_rows >= 0.75 * kern.n_cap:
+        # Approaching the bucket boundary: compile the next bucket's
+        # program in the background so the switchover doesn't stall.
+        _prewarm_async(get_kernel(cs, kern.n_cap * 2, int(n_EI_candidates),
+                                  int(linear_forgetting), split,
+                                  multivariate))
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
     key = jax.random.key(int(seed) % (2 ** 32))
     if n == 1:

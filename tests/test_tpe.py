@@ -310,6 +310,34 @@ class TestSuggestApi:
                 assert abs(vals["f"][0] - round(vals["f"][0])) < 1e-5
 
 
+    def test_bucket_prewarm_matches_call_signature(self):
+        # The background AOT compile must land in the same jit-cache entry
+        # the real call uses — a signature mismatch would silently waste
+        # the prewarm and recompile at the bucket switch.
+        import threading
+        import time
+
+        from hyperopt_tpu.tpe import (_padded_history, _prewarm_async,
+                                      get_kernel)
+        from hyperopt_tpu.space import compile_space
+
+        cs = compile_space({"pw": hp.uniform("pw", -5, 5)})
+        kern = get_kernel(cs, n_cap=64, n_cand=64, lf=25)
+        _prewarm_async(kern)
+        for th in threading.enumerate():
+            if th.name.startswith("tpe-prewarm"):
+                th.join(timeout=120)
+        h = {"vals": np.zeros((50, 1), np.float32),
+             "active": np.ones((50, 1), bool),
+             "loss": np.arange(50, dtype=np.float32),
+             "ok": np.ones(50, bool)}
+        hv, ha, hl, hok = _padded_history(h, 64)
+        t0 = time.perf_counter()
+        out = kern(jax.random.key(0), hv, ha, hl, hok, 0.25, 1.0)
+        jax.block_until_ready(out)
+        assert (time.perf_counter() - t0) * 1e3 < 1500, \
+            "first call recompiled despite prewarm"
+
     def test_gamma_zero_empty_below_set(self):
         # gamma=0 → n_below=0: the below model is the bare prior; the step
         # must still produce finite proposals (reference tolerates tiny

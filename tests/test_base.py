@@ -231,3 +231,31 @@ def test_trials_from_docs():
     docs = [_mk_doc(0, loss=2.0), _mk_doc(1, loss=1.0)]
     t = base.trials_from_docs(docs)
     assert len(t) == 2 and t.best_trial["tid"] == 1
+
+
+def test_average_best_error_variance_weighted():
+    # Reference semantics (hyperopt/base.py::Trials.average_best_error):
+    # trials within sqrt(var_best) of the best loss are averaged with
+    # 1/variance weights.
+    docs = []
+    for tid, (loss, var) in enumerate([(1.0, 0.04), (1.1, 0.01),
+                                       (5.0, 0.01)]):
+        d = _mk_doc(tid, loss=loss)
+        d["result"]["loss_variance"] = var
+        docs.append(d)
+    t = base.trials_from_docs(docs)
+    # cutoff = 1.0 + 0.2 keeps losses 1.0 (w=25) and 1.1 (w=100); 5.0 is out
+    want = (1.0 * 25 + 1.1 * 100) / 125
+    assert abs(t.average_best_error() - want) < 1e-9
+    # Without variances it degenerates to the best trials' mean.
+    t2 = base.trials_from_docs([_mk_doc(0, loss=2.0), _mk_doc(1, loss=3.0)])
+    assert abs(t2.average_best_error() - 2.0) < 1e-9
+
+
+def test_average_best_error_no_ok_trials():
+    t = ht.Trials()
+    t.insert_trial_docs([_mk_doc(0, state=base.JOB_STATE_NEW)])
+    t.refresh()
+    import pytest
+    with pytest.raises(ht.AllTrialsFailed):
+        t.average_best_error()

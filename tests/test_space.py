@@ -490,3 +490,37 @@ def test_persistent_cache_knob(tmp_path, monkeypatch):
     finally:
         jax.config.update("jax_compilation_cache_dir", prev)
         sp._persistent_cache_checked = True
+
+
+def test_concurrent_fmin_share_compiled_space():
+    # Memoization makes concurrent fmin runs over equal spaces share ONE
+    # CompiledSpace (and its kernel caches); jit dispatch is thread-safe
+    # and cache races must stay benign.
+    import threading
+
+    def mk():
+        return {"cx": hp.uniform("cx", -4, 4),
+                "cc": hp.choice("cc", [0, 1, 2])}
+
+    results = {}
+    errs = []
+
+    def run(i):
+        try:
+            t = ht.Trials()
+            ht.fmin(lambda d: (d["cx"] - 1) ** 2 + 0.1 * d["cc"], mk(),
+                    algo=ht.partial(ht.tpe.suggest, n_startup_jobs=5),
+                    max_evals=20, trials=t,
+                    rstate=np.random.default_rng(i), show_progressbar=False)
+            results[i] = t.best_trial["result"]["loss"]
+        except Exception as e:   # pragma: no cover - the failure under test
+            errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errs, errs
+    assert len(results) == 3
+    assert ht.compile_space(mk()) is ht.compile_space(mk())

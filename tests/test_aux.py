@@ -300,3 +300,26 @@ class TestImportanceApi:
         imp = parameter_importance(t, space)
         assert set(imp) == {"x", "noise"}
         assert imp["x"] > imp["noise"]
+
+
+def test_uniformint_oracle_matches_sampler():
+    # rdists.uniformint_gen is the scipy-style oracle for hp.uniformint;
+    # chi2 against the compiled sampler's draws.
+    import jax
+    import numpy as np
+    import scipy.stats as st
+
+    import hyperopt_tpu as ht
+    from hyperopt_tpu import hp, rdists
+
+    cs = ht.compile_space({"u": hp.uniformint("u", 2, 9)})
+    vals, _ = cs.sample(jax.random.key(0), 4000)
+    draws = np.asarray(vals)[:, 0].astype(int)
+    assert draws.min() >= 2 and draws.max() <= 9
+    gen = rdists.uniformint_gen(2, 9)
+    ref = gen.rvs(size=4000, random_state=np.random.default_rng(1)).astype(int)
+    obs = np.bincount(draws - 2, minlength=8)
+    exp = np.bincount(ref - 2, minlength=8)
+    # both uniform over 8 values: chi2 on observed vs expected proportions
+    chi2 = ((obs - exp) ** 2 / np.maximum(exp, 1)).sum()
+    assert chi2 < 40, (obs, exp)

@@ -258,10 +258,28 @@ class TestAlgoAliases:
                     rstate=np.random.default_rng(0), show_progressbar=False)
             assert len(t) == 8, name
 
+    def test_qmc_family_aliases(self):
+        for name in ("qmc", "sobol", "halton", "tpe_sobol"):
+            t = ht.Trials()
+            ht.fmin(lambda d: d["x"] ** 2, {"x": hp.uniform("x", -2, 2)},
+                    algo=name, max_evals=5, trials=t,
+                    rstate=np.random.default_rng(0), show_progressbar=False)
+            assert len(t) == 5, name
+
     def test_unknown_alias_raises(self):
         with pytest.raises(ValueError):
             ht.fmin(lambda d: 0.0, {"x": hp.uniform("x", 0, 1)},
                     algo="nope", max_evals=1, show_progressbar=False)
+
+    def test_timeout_and_threshold_validation(self):
+        with pytest.raises(Exception):
+            ht.fmin(lambda d: 0.0, {"x": hp.uniform("x", 0, 1)},
+                    algo="rand", max_evals=1, timeout=-3,
+                    show_progressbar=False)
+        with pytest.raises(Exception):
+            ht.fmin(lambda d: 0.0, {"x": hp.uniform("x", 0, 1)},
+                    algo="rand", max_evals=1, loss_threshold="low",
+                    show_progressbar=False)
 
 
 def test_overlap_with_suggest_quantile():

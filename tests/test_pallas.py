@@ -99,3 +99,21 @@ class TestPallasEiKernel:
              algo=tpe.suggest, max_evals=40, trials=t,
              rstate=np.random.default_rng(0), show_progressbar=False)
         assert t.best_trial["result"]["loss"] < 0.5
+
+
+def test_auto_dispatch_helpers():
+    # pallas_available is backend-conditional (False on forced CPU);
+    # ei_scores_auto falls back to interpret mode there and must agree
+    # with an explicit interpret call.
+    import numpy as np
+
+    from hyperopt_tpu.ops.pallas_gmm import ei_scores_auto, pallas_available
+
+    assert pallas_available() is False       # conftest forces CPU
+    rng = np.random.default_rng(0)
+    below = _random_mixture(rng, 2, 4, 4)
+    above = _random_mixture(rng, 2, 8, 8)
+    z = jnp.asarray(rng.normal(0, 2, (2, 128)).astype(np.float32))
+    got = np.asarray(ei_scores_auto(z, *below, *above))
+    want = np.asarray(ei_scores(z, *below, *above, tile=128, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)

@@ -283,9 +283,24 @@ class FMinIter:
              JOB_STATE_ERROR))
 
     def _save_trials(self):
-        if self.trials_save_file:
-            with open(self.trials_save_file, "wb") as f:
-                pickle.dump(self.trials, f, protocol=self.pickle_protocol)
+        if not self.trials_save_file:
+            return
+        if self.trials_save_file.endswith(".json"):
+            # Portable checkpoint: plain-JSON trial docs (the same encoding
+            # FileTrials stores on disk), loadable without unpickling
+            # arbitrary code.  Attachments and Trials-subclass state are
+            # NOT captured — use the pickle form (any other extension) or a
+            # durable FileTrials for those.
+            import json
+
+            tmp = f"{self.trials_save_file}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"exp_key": self.trials.exp_key,
+                           "docs": list(self.trials)}, f)
+            os.replace(tmp, self.trials_save_file)
+            return
+        with open(self.trials_save_file, "wb") as f:
+            pickle.dump(self.trials, f, protocol=self.pickle_protocol)
 
     def run(self, N, block_until_done=True):
         """Reference-compat: enqueue+evaluate ~N more trials."""
@@ -400,8 +415,16 @@ def fmin(fn, space, algo=None, max_evals=None,
     validate_loss_threshold(loss_threshold)
 
     if trials_save_file and os.path.exists(trials_save_file) and trials is None:
-        with open(trials_save_file, "rb") as f:
-            trials = pickle.load(f)
+        if trials_save_file.endswith(".json"):
+            import json
+
+            with open(trials_save_file) as f:
+                payload = json.load(f)
+            trials = base.trials_from_docs(payload["docs"],
+                                           exp_key=payload.get("exp_key"))
+        else:
+            with open(trials_save_file, "rb") as f:
+                trials = pickle.load(f)
 
     if trials is None:
         if points_to_evaluate is None:

@@ -74,6 +74,27 @@ def test_trials_save_file_resume(tmp_path):
     assert len(trials) == 25  # resumed the first 10, added 15
 
 
+def test_trials_save_file_json_resume(tmp_path):
+    # A ".json" suffix selects the portable plain-JSON checkpoint (same doc
+    # encoding FileTrials stores) — resumable without unpickling code.
+    import json
+
+    path = str(tmp_path / "trials.json")
+    ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=10, rstate=0,
+            trials_save_file=path, show_progressbar=False)
+    with open(path) as f:
+        payload = json.load(f)
+    assert len(payload["docs"]) == 10
+    ht.fmin(q1, SPACE1, algo=rand.suggest, max_evals=25, rstate=1,
+            trials_save_file=path, show_progressbar=False,
+            return_argmin=False)
+    with open(path) as f:
+        payload = json.load(f)
+    assert len(payload["docs"]) == 25          # resumed 10, added 15
+    losses = [d["result"]["loss"] for d in payload["docs"]]
+    assert all(isinstance(x, float) for x in losses)
+
+
 def test_early_stop_no_progress():
     calls = []
 

@@ -37,3 +37,10 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute statistical sweeps / subprocess fleets — "
+        "`pytest -m 'not slow'` is the quick single-core loop")

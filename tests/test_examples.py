@@ -26,6 +26,7 @@ def _capped_fmin(*args, **kwargs):
     return _real_fmin(*args, **kwargs)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("script", EXAMPLES)
 def test_example_runs(script, monkeypatch, capsys):
     if script == "06_sklearn_hpo.py":

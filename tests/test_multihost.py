@@ -10,6 +10,8 @@ for real in its own subprocess (single-controller degenerate case).
 """
 
 import os
+
+import pytest
 import socket
 import subprocess
 import sys
@@ -79,6 +81,7 @@ def _free_port():
     return port
 
 
+@pytest.mark.slow
 class TestDriverWorkerRoles:
     def test_driver_and_worker_subprocesses(self, tmp_path):
         """One driver subprocess (suggest + enqueue over the shared store)

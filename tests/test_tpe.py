@@ -409,6 +409,7 @@ class TestSuggestApi:
 SEEDS = [0, 1, 2]
 
 
+@pytest.mark.slow
 class TestConvergence:
     @pytest.mark.parametrize("name", ["quadratic1", "branin", "q1_choice"])
     def test_tpe_beats_random(self, name):
@@ -496,6 +497,7 @@ class TestQuantizedScoringEdges:
             assert v >= 0 and abs(v - round(v)) < 1e-6, v
 
 
+@pytest.mark.slow
 class TestConvergenceFull:
     """TPE beats random on the ENTIRE convergence zoo (reference bar:
     test_tpe.py sweeps the test_domains zoo — SURVEY.md §4)."""

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Probe the axon TPU tunnel until it answers, then exit 0.
+#
+# The tunnel's exclusive chip claim can wedge for hours after any
+# TPU-attached process is killed (.claude/skills/verify/SKILL.md); the
+# documented recovery is to probe periodically with a bounded timeout and
+# wait.  One probe = one `jax.devices()` with a 120 s cap; probes that
+# block are still waiting on the claim (they never held it), so timing
+# them out is safe.  Logs every attempt to $LOG.
+LOG=${1:-/tmp/tpu_probe.log}
+INTERVAL=${2:-900}
+MAX_TRIES=${3:-40}
+for i in $(seq 1 "$MAX_TRIES"); do
+  ts=$(date -u +%H:%M:%S)
+  out=$(timeout 120 env JAX_PLATFORMS= python -c \
+    "import time; t=time.time(); import jax; d=jax.devices(); print('OK', d[0], round(time.time()-t,1),'s')" 2>&1 | tail -1)
+  if [[ "$out" == OK* ]]; then
+    echo "$ts try=$i $out" >> "$LOG"
+    echo "TPU HEALTHY: $out"
+    exit 0
+  fi
+  echo "$ts try=$i wedged ($out)" >> "$LOG"
+  sleep "$INTERVAL"
+done
+echo "TPU still wedged after $MAX_TRIES tries"
+exit 1

@@ -293,10 +293,30 @@ class FMinIter:
             # durable FileTrials for those.
             import json
 
+            def _default(o):
+                # User result dicts routinely carry np.float32/np.int64 (loss
+                # is coerced, extra keys are not); persist them as plain
+                # scalars rather than crashing the checkpoint mid-run.
+                if isinstance(o, np.generic):
+                    return o.item()
+                if isinstance(o, np.ndarray):
+                    return o.tolist()
+                raise TypeError(
+                    f"trial doc contains non-JSON-serializable {type(o).__name__}; "
+                    "use a pickle trials_save_file (non-.json extension) for "
+                    "arbitrary result payloads")
+
             tmp = f"{self.trials_save_file}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump({"exp_key": self.trials.exp_key,
-                           "docs": list(self.trials)}, f)
+            try:
+                with open(tmp, "w") as f:
+                    json.dump({"exp_key": self.trials.exp_key,
+                               "docs": list(self.trials)}, f, default=_default)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
             os.replace(tmp, self.trials_save_file)
             return
         with open(self.trials_save_file, "wb") as f:

@@ -29,6 +29,8 @@ starts a new scramble at the right sequence position.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 from scipy import special
 from scipy.stats import qmc as _qmc
@@ -90,27 +92,33 @@ def _transform_column(spec, u):
 # gets a fresh `seed` from the rstate stream) would destroy the joint
 # low-discrepancy property the module exists for.
 _engines = None
+_engines_lock = threading.RLock()   # re-entered by suggest_batch around the draw
 
 
 def _engine_for(trials, name, dim, seed):
+    # Locked: two threads suggesting against the same Trials must not race
+    # setdefault/engine creation and hand out duplicate or restarted Sobol
+    # points.  Cheap — one lookup per suggest call.
     global _engines
-    if _engines is None:
-        import weakref
+    with _engines_lock:
+        if _engines is None:
+            import weakref
 
-        _engines = weakref.WeakKeyDictionary()
-    per_trials = _engines.setdefault(trials, {})
-    key = (name, dim)
-    eng = per_trials.get(key)
-    if eng is None:
-        cls = {"sobol": _qmc.Sobol, "halton": _qmc.Halton}[name]
-        eng = cls(d=dim, scramble=True, seed=int(seed) % (2 ** 32))
-        # Resume case (pre-existing trials, e.g. exp_key/pickle resume):
-        # skip the points the experiment already consumed.  The re-scramble
-        # only affects joint uniformity across the resume boundary.
-        if len(trials):
-            eng.fast_forward(len(trials))
-        per_trials[key] = eng
-    return eng
+            _engines = weakref.WeakKeyDictionary()
+        per_trials = _engines.setdefault(trials, {})
+        key = (name, dim)
+        eng = per_trials.get(key)
+        if eng is None:
+            cls = {"sobol": _qmc.Sobol, "halton": _qmc.Halton}[name]
+            eng = cls(d=dim, scramble=True, seed=int(seed) % (2 ** 32))
+            # Resume case (pre-existing trials, e.g. exp_key/pickle resume):
+            # skip the points the experiment already consumed.  The
+            # re-scramble only affects joint uniformity across the resume
+            # boundary.
+            if len(trials):
+                eng.fast_forward(len(trials))
+            per_trials[key] = eng
+        return eng
 
 
 def suggest_batch(new_ids, domain, trials, seed, engine="sobol"):
@@ -120,8 +128,12 @@ def suggest_batch(new_ids, domain, trials, seed, engine="sobol"):
     if n == 0 or cs.n_params == 0:
         return (np.zeros((n, cs.n_params), np.float32),
                 np.ones((n, cs.n_params), bool))
-    eng = _engine_for(trials, engine, cs.n_params, seed)
-    u = eng.random(n)                                    # [n, P] in [0, 1)
+    # The draw advances the engine's sequence position non-atomically, so
+    # it needs the same lock as lookup/creation — otherwise two threads can
+    # receive identical points from the shared engine.
+    with _engines_lock:
+        eng = _engine_for(trials, engine, cs.n_params, seed)
+        u = eng.random(n)                                # [n, P] in [0, 1)
     vals = np.zeros((n, cs.n_params), np.float32)
     for j, spec in enumerate(cs.params):
         vals[:, j] = _transform_column(spec, u[:, j])

@@ -599,6 +599,17 @@ def _prewarm_async(kern: _TpeKernel) -> None:
     if getattr(kern, "_prewarmed", False):
         return
     kern._prewarmed = True
+    # On a single-core host with a CPU backend the "background" compile
+    # competes with the foreground objective for the one core and can slow
+    # the very run it is meant to hide (ADVICE r2); the lazy path is
+    # cheaper there.  On TPU the compile runs host-side while the chip is
+    # idle between suggests, so the overlap still pays.
+    if (os.cpu_count() or 1) == 1:
+        try:
+            if jax.default_backend() == "cpu":
+                return
+        except Exception:
+            return
 
     def _go():
         try:

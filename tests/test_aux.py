@@ -141,6 +141,7 @@ class TestGraphviz:
 
 
 class TestAtpe:
+    @pytest.mark.slow
     def test_converges_and_adapts(self):
         z = ZOO["quadratic1"]
         t = Trials()
@@ -151,6 +152,7 @@ class TestAtpe:
         # bandit has settled outcomes for the post-startup suggestions
         assert st.wins.sum() + st.losses.sum() > len(st.wins) * 2
 
+    @pytest.mark.slow
     def test_conditional_space(self):
         z = ZOO["q1_choice"]
         t = Trials()
@@ -249,6 +251,7 @@ class TestAtpeAdaptation:
             p = cs.by_label[label].pid
             assert np.allclose(out_rows[:, p], rows[:, p])
 
+    @pytest.mark.slow
     def test_lockout_arm_runs_end_to_end(self):
         # 5+-dim space activates the lockout arms; whole loop stays green.
         space = {f"x{i}": hp.uniform(f"x{i}", -3, 3) for i in range(5)}

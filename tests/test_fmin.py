@@ -95,6 +95,35 @@ def test_trials_save_file_json_resume(tmp_path):
     assert all(isinstance(x, float) for x in losses)
 
 
+def test_trials_save_file_json_numpy_payload(tmp_path):
+    # Result dicts carrying np scalars/arrays in extra keys must checkpoint
+    # (coerced to plain JSON), not TypeError mid-run; a truly un-JSONable
+    # payload must fail with a clear error and no leaked .tmp file.
+    import json
+
+    path = str(tmp_path / "trials.json")
+
+    def fn(d):
+        return {"loss": d["x"] ** 2, "status": "ok",
+                "np_scalar": np.float32(1.5), "np_int": np.int64(7),
+                "np_arr": np.arange(3.0)}
+
+    ht.fmin(fn, SPACE1, algo=rand.suggest, max_evals=4, rstate=0,
+            trials_save_file=path, show_progressbar=False)
+    with open(path) as f:
+        doc = json.load(f)["docs"][0]
+    assert doc["result"]["np_scalar"] == 1.5
+    assert doc["result"]["np_int"] == 7
+    assert doc["result"]["np_arr"] == [0.0, 1.0, 2.0]
+
+    bad = str(tmp_path / "bad.json")
+    with pytest.raises(TypeError, match="non-JSON-serializable"):
+        ht.fmin(lambda d: {"loss": 0.0, "status": "ok", "blob": object()},
+                SPACE1, algo=rand.suggest, max_evals=1, rstate=0,
+                trials_save_file=bad, show_progressbar=False)
+    assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+
+
 def test_early_stop_no_progress():
     calls = []
 

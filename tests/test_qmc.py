@@ -43,6 +43,29 @@ class TestStratification:
         bins = np.floor(np.asarray(xs)).astype(int)
         assert sorted(bins.tolist()) == list(range(16))
 
+    def test_concurrent_suggests_share_one_sequence(self):
+        # Two threads suggesting against the same Trials must jointly
+        # consume the one scrambled-Sobol sequence: 8+8 points from racing
+        # calls still form the 16-bin net (no duplicated/restarted points).
+        import threading
+
+        space = {"x": hp.uniform("x", 0.0, 16.0)}
+        d = Domain(lambda cfg: 0.0, space)
+        t = Trials()
+        out, barrier = {}, threading.Barrier(2)
+
+        def go(tag, ids):
+            barrier.wait()
+            out[tag] = qmc.suggest(ids, d, t, 0)
+
+        th = [threading.Thread(target=go, args=("a", list(range(8)))),
+              threading.Thread(target=go, args=("b", list(range(8, 16))))]
+        [x.start() for x in th]
+        [x.join() for x in th]
+        xs = [doc["misc"]["vals"]["x"][0] for doc in out["a"] + out["b"]]
+        bins = np.floor(np.asarray(xs)).astype(int)
+        assert sorted(bins.tolist()) == list(range(16))
+
     def test_halton_covers_bins(self):
         docs, _, _ = _docs({"x": hp.uniform("x", 0.0, 8.0)}, 32,
                            engine="halton")

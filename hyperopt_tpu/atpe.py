@@ -29,6 +29,14 @@ build anyway.  The same *capabilities* are provided self-contained:
   the inspectable stand-in for the reference's learned
   secondary-correlation models.
 * the arm's reward is "the suggested trial improved the best-so-far loss".
+* **transfer memory** (reference: the pretrained models' cross-problem
+  knowledge) — arm posteriors persist on disk keyed by the space's
+  structural fingerprint, so a new experiment over the same (or an
+  identically-shaped) space starts from everything previous experiments
+  learned about which TPE configurations work there, instead of
+  re-learning from a flat prior.  See :class:`_TransferStore`; disable
+  with ``HYPEROPT_TPU_ATPE_TRANSFER=0``, relocate with
+  ``HYPEROPT_TPU_CACHE_DIR``.
 
 This keeps ATPE's plugin signature (``atpe.suggest`` drop-in, same as the
 reference) with self-contained, inspectable adaptation.
@@ -36,11 +44,19 @@ reference) with self-contained, inspectable adaptation.
 
 from __future__ import annotations
 
+import hashlib
+import json
+import logging
+import os
+import threading
+
 import numpy as np
 
 from . import base, tpe
 from .base import JOB_STATE_DONE, JOB_STATE_ERROR, STATUS_OK
 from .space import CATEGORICAL, RANDINT, UNIFORMINT
+
+logger = logging.getLogger(__name__)
 
 
 def _portfolio(cs):
@@ -163,12 +179,114 @@ def _apply_lockout(cs, rows, acts, trials, h, frac, rng):
     return rows, acts
 
 
-class _BanditState:
-    """Per-experiment Thompson-sampling state, attached to the Trials."""
+def _fingerprint(cs) -> str:
+    """Structural fingerprint of a compiled space (stable across processes).
 
-    def __init__(self, n_arms):
-        self.wins = np.ones(n_arms)    # Beta(1,1) priors
-        self.losses = np.ones(n_arms)
+    Built from the compiled column specs — label, distribution kind, bounds,
+    quantization, categorical probs and gating conditions — i.e. the same
+    identity :func:`hyperopt_tpu.space._freeze` captures for the compile
+    cache, but hashed to a short printable key suitable for a JSON store."""
+    parts = []
+    for p in cs.params:
+        parts.append((p.label, p.kind, p.low, p.high, p.mu, p.sigma, p.q,
+                      None if p.probs is None else tuple(p.probs),
+                      tuple(p.conditions)))
+    return hashlib.sha256(repr(parts).encode()).hexdigest()[:24]
+
+
+class _TransferStore:
+    """Cross-experiment arm-posterior persistence (the reference's
+    pretrained-model analog, SURVEY.md §2 ``atpe.py`` + ``atpe_models/``).
+
+    One JSON file maps space fingerprints to cumulative arm win/loss counts.
+    A new experiment seeds its Thompson posteriors from the stored counts,
+    scaled so borrowed evidence never exceeds ``EVIDENCE_CAP`` pseudo-trials
+    — strong enough to skip the cold-start exploration, weak enough for
+    fresh data to override a stale record.  Flushes are read-modify-write
+    of per-experiment *deltas* with an atomic replace, so concurrent
+    experiments on one machine at worst drop a few increments rather than
+    corrupting the file."""
+
+    EVIDENCE_CAP = 30.0
+
+    def __init__(self, path):
+        self.path = path
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def default():
+        if os.environ.get("HYPEROPT_TPU_ATPE_TRANSFER", "1") == "0":
+            return None
+        d = os.environ.get("HYPEROPT_TPU_CACHE_DIR") or os.path.join(
+            os.path.expanduser("~"), ".cache", "hyperopt_tpu")
+        return _TransferStore(os.path.join(d, "atpe_transfer.json"))
+
+    def _read(self):
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            return data if isinstance(data, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def load(self, fp, n_arms):
+        """Seed posteriors: Beta(1,1) plus capped stored evidence."""
+        rec = self._read().get(fp)
+        wins = np.ones(n_arms)
+        losses = np.ones(n_arms)
+        if rec and len(rec.get("wins", ())) == n_arms:
+            w = np.asarray(rec["wins"], float)
+            l = np.asarray(rec["losses"], float)
+            total = float(w.sum() + l.sum())
+            s = min(1.0, self.EVIDENCE_CAP / total) if total > 0 else 0.0
+            wins += s * w
+            losses += s * l
+        return wins, losses
+
+    def flush(self, fp, d_wins, d_losses, n_new_exp=0):
+        """Accumulate this experiment's new outcome deltas into the store."""
+        if not (d_wins.any() or d_losses.any() or n_new_exp):
+            return
+        with self._lock:
+            try:
+                data = self._read()
+                rec = data.get(fp)
+                n = len(d_wins)
+                if not rec or len(rec.get("wins", ())) != n:
+                    rec = {"wins": [0.0] * n, "losses": [0.0] * n,
+                           "n_experiments": 0}
+                rec["wins"] = (np.asarray(rec["wins"], float)
+                               + d_wins).tolist()
+                rec["losses"] = (np.asarray(rec["losses"], float)
+                                 + d_losses).tolist()
+                rec["n_experiments"] = int(rec.get("n_experiments", 0)
+                                           + n_new_exp)
+                data[fp] = rec
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                tmp = f"{self.path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(data, f)
+                os.replace(tmp, self.path)
+            except OSError:   # cache dir unwritable → adapt in-memory only
+                logger.debug("atpe transfer flush failed", exc_info=True)
+
+
+class _BanditState:
+    """Per-experiment Thompson-sampling state, attached to the Trials.
+
+    ``store``/``fp`` wire the cross-experiment transfer memory: posteriors
+    start from the store's record for this space and every settled outcome
+    is flushed back as a delta."""
+
+    def __init__(self, n_arms, store=None, fp=None):
+        self.store = store
+        self.fp = fp
+        if store is not None and fp is not None:
+            self.wins, self.losses = store.load(fp, n_arms)
+            store.flush(fp, np.zeros(n_arms), np.zeros(n_arms), n_new_exp=1)
+        else:
+            self.wins = np.ones(n_arms)    # Beta(1,1) priors
+            self.losses = np.ones(n_arms)
         self.pending = {}              # tid -> (arm, best_loss_at_suggest)
 
     def pick(self, rng):
@@ -177,6 +295,9 @@ class _BanditState:
     def settle(self, trials):
         """Score resolved suggestions: did the trial beat the best loss
         recorded when it was proposed?"""
+        n = len(self.wins)
+        d_wins = np.zeros(n)
+        d_losses = np.zeros(n)
         by_tid = {t["tid"]: t for t in trials}
         for tid in list(self.pending):
             t = by_tid.get(tid)
@@ -187,15 +308,21 @@ class _BanditState:
             r = t["result"]
             loss = r.get("loss") if r.get("status") == STATUS_OK else None
             if loss is not None and (best_then is None or loss < best_then):
-                self.wins[arm] += 1.0
+                d_wins[arm] += 1.0
             else:
-                self.losses[arm] += 1.0
+                d_losses[arm] += 1.0
+        self.wins += d_wins
+        self.losses += d_losses
+        if self.store is not None and self.fp is not None:
+            self.store.flush(self.fp, d_wins, d_losses)
 
 
-def _state(trials, n_arms) -> _BanditState:
+def _state(trials, cs, n_arms) -> _BanditState:
     st = getattr(trials, "_atpe_state", None)
     if st is None or len(st.wins) != n_arms:
-        st = trials._atpe_state = _BanditState(n_arms)
+        store = _TransferStore.default()
+        fp = _fingerprint(cs) if store is not None else None
+        st = trials._atpe_state = _BanditState(n_arms, store=store, fp=fp)
     return st
 
 
@@ -205,7 +332,7 @@ def suggest(new_ids, domain, trials, seed,
     """Adaptive-TPE suggest (drop-in for ``hyperopt/atpe.py::suggest``)."""
     cs = domain.cs
     arms = _portfolio(cs)
-    st = _state(trials, len(arms))
+    st = _state(trials, cs, len(arms))
     st.settle(trials)
     rng = np.random.default_rng(int(seed) % (2 ** 32))
     arm = st.pick(rng)

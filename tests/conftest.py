@@ -30,6 +30,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import tempfile  # noqa: E402
+
+# Isolate the on-disk cache (ATPE transfer memory): tests must neither read
+# a developer's ~/.cache/hyperopt_tpu nor leak state between test runs, and
+# individual tests monkeypatch this to a tmp_path when they exercise the
+# store deliberately.
+os.environ.setdefault(
+    "HYPEROPT_TPU_CACHE_DIR",
+    tempfile.mkdtemp(prefix="hyperopt_tpu_test_cache_"))
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

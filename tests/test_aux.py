@@ -161,6 +161,104 @@ class TestAtpe:
         assert t.best_trial["result"]["loss"] <= 1.0
 
 
+class TestAtpeTransfer:
+    """Cross-experiment transfer memory (reference: pretrained atpe_models —
+    here arm posteriors persisted per space fingerprint, VERDICT r2 #7)."""
+
+    def test_store_roundtrip_and_evidence_cap(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_CACHE_DIR", str(tmp_path))
+        store = atpe._TransferStore.default()
+        fp = "testfp"
+        store.flush(fp, np.array([10.0, 0, 0]), np.array([0, 5.0, 0]),
+                    n_new_exp=1)
+        store.flush(fp, np.array([30.0, 0, 0]), np.array([0, 15.0, 0]))
+        rec = json.load(open(tmp_path / "atpe_transfer.json"))[fp]
+        assert rec["wins"] == [40.0, 0, 0]
+        assert rec["n_experiments"] == 1
+        # total stored evidence 60 > cap 30 → halved at load, flat +1 prior
+        w, l = store.load(fp, 3)
+        assert np.allclose(w, [21.0, 1, 1]) and np.allclose(l, [1, 11.0, 1])
+        # arm-count change (portfolio evolved) → seeding safely ignored
+        w4, l4 = store.load(fp, 4)
+        assert np.allclose(w4, 1.0) and np.allclose(l4, 1.0)
+        # corrupt file → flat prior, no crash
+        (tmp_path / "atpe_transfer.json").write_text("{broken")
+        w, l = store.load(fp, 3)
+        assert np.allclose(w, 1.0)
+
+    def test_disabled_by_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_CACHE_DIR", str(tmp_path))
+        monkeypatch.setenv("HYPEROPT_TPU_ATPE_TRANSFER", "0")
+        assert atpe._TransferStore.default() is None
+        fmin(lambda d: d["x"] ** 2, {"x": hp.uniform("x", -1, 1)},
+             algo=atpe.suggest, max_evals=3, trials=Trials(),
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert not os.path.exists(tmp_path / "atpe_transfer.json")
+
+    def test_seeded_posterior_biases_arm_choice(self, tmp_path, monkeypatch):
+        # A store that overwhelmingly favors one arm must dominate the next
+        # experiment's Thompson picks from the very first suggest.
+        monkeypatch.setenv("HYPEROPT_TPU_CACHE_DIR", str(tmp_path))
+        space = {"x": hp.uniform("x", -3, 3), "y": hp.normal("y", 0, 1),
+                 "c": hp.choice("c", [0, 1, 2])}
+        cs = compile_space(space)
+        n_arms = len(atpe._portfolio(cs))
+        k = 2
+        dw = np.zeros(n_arms)
+        dl = np.full(n_arms, 40.0)
+        dw[k], dl[k] = 40.0, 0.0
+        store = atpe._TransferStore.default()
+        store.flush(atpe._fingerprint(cs), dw, dl, n_new_exp=1)
+        st = atpe._state(Trials(), cs, n_arms)
+        assert st.wins.sum() > n_arms + 1           # seeded, not flat
+        r = np.random.default_rng(0)
+        picks = [st.pick(r) for _ in range(60)]
+        assert np.mean([p == k for p in picks]) > 0.6
+
+    @pytest.mark.slow
+    def test_experiment2_starts_from_experiment1(self, tmp_path, monkeypatch):
+        # e2e: exp1 learns arm statistics; exp2 on the SAME space is seeded
+        # with them and leans on exp1's best arm at a fixed small budget.
+        monkeypatch.setenv("HYPEROPT_TPU_CACHE_DIR", str(tmp_path))
+        z = ZOO["quadratic1"]
+        algo = lambda *a, **kw: atpe.suggest(*a, n_startup_jobs=8, **kw)
+        t1 = Trials()
+        fmin(z.fn, z.space, algo=algo, max_evals=40, trials=t1,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        cs = compile_space(z.space)
+        fp = atpe._fingerprint(cs)
+        rec = json.load(open(tmp_path / "atpe_transfer.json"))[fp]
+        settled = float(np.sum(rec["wins"]) + np.sum(rec["losses"]))
+        assert settled >= 40 - 8 - 1      # every post-startup outcome stored
+        top_arm = int(np.argmax(np.asarray(rec["wins"])
+                                / np.maximum(np.asarray(rec["wins"])
+                                             + np.asarray(rec["losses"]), 1)))
+
+        store = atpe._TransferStore.default()
+        n_arms = len(rec["wins"])
+        w0, l0 = store.load(fp, n_arms)
+        t2 = Trials()
+        fmin(z.fn, z.space, algo=algo, max_evals=30, trials=t2,
+             rstate=np.random.default_rng(1), show_progressbar=False)
+        st2 = t2._atpe_state
+        # (a) exp2's posterior started from exp1's statistics
+        assert np.allclose(
+            np.minimum(st2.wins, w0) + np.minimum(st2.losses, l0),
+            np.minimum(w0 + l0, st2.wins + st2.losses))
+        assert w0.sum() + l0.sum() > 2 * n_arms    # non-flat seed existed
+        # (b) exp2 used the transferred knowledge: its picks favor exp1's
+        # top arm over a flat 1/n_arms spread, or it converged at least as
+        # well as exp1 did at the same budget.
+        picked = (st2.wins - w0) + (st2.losses - l0)
+        for arm, _ in st2.pending.values():
+            picked[arm] += 1
+        top_share = picked[top_arm] / max(picked.sum(), 1)
+        best2 = t2.best_trial["result"]["loss"]
+        best1_at_30 = min(d["result"]["loss"] for d in list(t1)[:30]
+                          if d["result"].get("loss") is not None)
+        assert top_share > 1.5 / n_arms or best2 <= best1_at_30 * 1.25
+
+
 class TestTracing:
     def test_spans_and_dump(self, tmp_path):
         z = ZOO["quadratic1"]

@@ -164,6 +164,19 @@ def child():
             _say("partial", partial)
         finally:
             os.environ["HYPEROPT_TPU_SORT"] = "sort"
+        # Record what HYPEROPT_TPU_SORT=auto resolves to on this backend
+        # (the measured probe, tpe._probe_sort_floor) so the artifact shows
+        # auto picking the faster measured mode.
+        try:
+            del os.environ["HYPEROPT_TPU_SORT"]
+            from hyperopt_tpu.tpe import _sort_mode
+
+            partial["sort_auto_choice"] = _sort_mode()
+            _say("partial", partial)
+        except Exception as e:
+            partial["sort_auto_error"] = f"{type(e).__name__}: {e}"
+        finally:
+            os.environ["HYPEROPT_TPU_SORT"] = "sort"
 
     # Pallas-native A/B (TPU only, unless explicitly disabled): correctness
     # vs the XLA scorer, then latency; headline takes the faster valid mode.

@@ -33,8 +33,19 @@ SEEDS = [0, 1, 2, 3, 4]
 
 
 def algos():
+    """Algo table; ``HYPEROPT_TPU_QUALITY_ALGOS=tpe,tpe_cat_const`` filters
+    (targeted A/Bs on the 1-core box instead of the full 8-algo sweep)."""
     import hyperopt_tpu as ho
 
+    table = _algo_table(ho)
+    only = os.environ.get("HYPEROPT_TPU_QUALITY_ALGOS")
+    if only:
+        keep = [a.strip() for a in only.split(",") if a.strip()]
+        table = {k: table[k] for k in keep}
+    return table
+
+
+def _algo_table(ho):
     return {
         "rand": ho.rand.suggest,
         "anneal": ho.anneal.suggest,
@@ -43,6 +54,11 @@ def algos():
         "tpe_mv": partial(ho.tpe.suggest, split="quantile",
                           multivariate=True, n_EI_candidates=128),
         "tpe_sobol": partial(ho.tpe.suggest, startup="qmc"),  # Sobol warm-start
+        # Reference-parity categorical prior strength (constant, 1/N decay)
+        # vs the default sqrt schedule — the A/B VERDICT r2 #6 asked for;
+        # informative on the categorical-heavy domains (n_arms, q1_choice,
+        # many_dists).
+        "tpe_cat_const": partial(ho.tpe.suggest, cat_prior="const"),
         "atpe": ho.atpe.suggest,
     }
 
@@ -107,8 +123,11 @@ def _run_domains(names):
 
 
 def _finish(rows):
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "quality_latest.json")
+    # Filtered A/B runs must not clobber the full-table artifact.
+    fname = ("quality_ab_latest.json"
+             if os.environ.get("HYPEROPT_TPU_QUALITY_ALGOS")
+             else "quality_latest.json")
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), fname)
     with open(out, "w") as f:
         json.dump({"seeds": SEEDS, "rows": rows}, f, indent=1)
 

@@ -40,6 +40,7 @@ TPU-first design (NOT a translation — SURVEY.md §7):
 
 from __future__ import annotations
 
+import logging
 import os
 from functools import partial
 
@@ -105,21 +106,85 @@ def _pallas_mode() -> str:
     return "off"
 
 
+def _cat_prior_default() -> str:
+    """Default categorical prior-strength schedule (see ``_cat_scores``).
+
+    ``HYPEROPT_TPU_CAT_PRIOR``: ``sqrt`` (default) → pseudocount strength
+    grows as √(1+N) so the prior decays as 1/√N; ``const`` → the
+    reference's constant strength (``ap_categorical_sampler``:
+    counts + n_options·prior_weight·p), decaying as 1/N.  Both are also
+    selectable per-call via ``suggest(..., cat_prior=...)``; the quality
+    A/B lives in ``benchmarks/quality.py`` (``tpe_cat_const`` row).
+    """
+    env = os.environ.get("HYPEROPT_TPU_CAT_PRIOR", "sqrt")
+    return env if env in ("sqrt", "const") else "sqrt"
+
+
+_sort_probe_cache: dict = {}
+
+
+def _probe_sort_floor(backend: str) -> str:
+    """Measure, once per backend per process, whether jitted XLA sorts pay
+    an anomalous latency floor (a round-2 axon-tunnel pathology: ANY
+    sort-containing program ran ~65 ms while sort-free programs ran
+    ~0.03 ms — transient, so it must be measured, never assumed).
+
+    Returns the faster rank/fit mode: ``"sort"`` when sorts behave (their
+    steady-state latency is small or comparable to a trivial sort-free
+    program), ``"pairwise"`` when the floor pathology is present.  Cost:
+    two tiny compiles + 10 sub-ms executions, paid only on the first
+    ``HYPEROPT_TPU_SORT=auto`` kernel build.
+    """
+    import time as _time
+
+    try:
+        x = jnp.arange(4096, dtype=jnp.float32)[::-1]
+        f_sort = jax.jit(jnp.sort)
+        f_free = jax.jit(lambda v: (v * 2.0 + 1.0).sum())
+        f_sort(x).block_until_ready()
+        f_free(x).block_until_ready()
+
+        def best_of(f, reps=5):
+            ts = []
+            for _ in range(reps):
+                t0 = _time.perf_counter()
+                f(x).block_until_ready()
+                ts.append(_time.perf_counter() - t0)
+            return min(ts)
+
+        t_sort, t_free = best_of(f_sort), best_of(f_free)
+        pathological = t_sort > 0.010 and t_sort > 20.0 * t_free
+        mode = "pairwise" if pathological else "sort"
+        logging.getLogger(__name__).info(
+            "sort-floor probe [%s]: sort=%.3fms free=%.3fms -> %s",
+            backend, t_sort * 1e3, t_free * 1e3, mode)
+        return mode
+    except Exception:   # probe is best-effort; sort is the safe default
+        return "sort"
+
+
 def _sort_mode() -> str:
     """Rank/fit implementation for the suggest step.
 
     ``HYPEROPT_TPU_SORT``: ``sort`` → XLA sort-based γ-split ranks +
     compacted Parzen fits; ``pairwise`` → sort-free O(N²) masked-comparison
     ranks and nearest-neighbor bandwidths (``ops.fit_parzen_pairwise``).
-    Motivation: on the axon TPU tunnel any program containing an XLA sort
-    measured a ~65 ms floor regardless of shape, so ``bench.py`` A/Bs both
-    modes on the real chip each round; ``auto`` currently resolves to
-    ``sort`` pending a recorded pairwise win.
+    ``auto`` (default) resolves from a one-time measured probe per backend
+    (:func:`_probe_sort_floor`): the round-2 tunnel showed a transient
+    ~65 ms floor on any sort-containing program, so the choice is data,
+    not a hardcode.
     """
     env = os.environ.get("HYPEROPT_TPU_SORT", "auto")
     if env in ("sort", "pairwise"):
         return env
-    return "sort"
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return "sort"
+    mode = _sort_probe_cache.get(backend)
+    if mode is None:
+        mode = _sort_probe_cache[backend] = _probe_sort_floor(backend)
+    return mode
 
 
 # A bounded quantized column's support is a lattice of at most this many
@@ -212,7 +277,8 @@ class _TpeKernel:
     """
 
     def __init__(self, cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
-                 split: str = "sqrt", multivariate: bool = False):
+                 split: str = "sqrt", multivariate: bool = False,
+                 cat_prior: str | None = None):
         self.cs = cs
         self.n_cap = n_cap
         self.n_cand = n_cand
@@ -220,6 +286,11 @@ class _TpeKernel:
         if split not in ("sqrt", "quantile"):
             raise ValueError(f"split must be 'sqrt' or 'quantile', got {split!r}")
         self.split = split
+        cat_prior = cat_prior or _cat_prior_default()
+        if cat_prior not in ("sqrt", "const"):
+            raise ValueError(
+                f"cat_prior must be 'sqrt' or 'const', got {cat_prior!r}")
+        self.cat_prior = cat_prior
         # Joint-vector EI (see _suggest_one); False = reference-parity
         # factorized per-parameter argmax (broadcast_best).
         self.multivariate = multivariate
@@ -266,6 +337,7 @@ class _TpeKernel:
             if s.kind == RANDINT:
                 offsets[i] = s.low
         self.cat_priors = priors
+        self.cat_nopts = np.asarray([s.n_options for s in cat], np.float32)
         self.cat_offsets = offsets
 
         from .space import ensure_persistent_compilation_cache
@@ -483,16 +555,20 @@ class _TpeKernel:
                   jnp.arange(kmax, dtype=jnp.float32)[None, None, :])
 
         def log_post(set_mask):
-            # Weighted counts + prior pseudocounts.  Deliberate deviation
-            # from the reference (tpe.py::ap_categorical_sampler uses a
-            # CONSTANT prior strength, counts + n_options·prior_weight·p):
-            # here the pseudocount strength grows as sqrt(1+N), so the prior
-            # decays as 1/sqrt(N) instead of 1/N — a slower, better-behaved
-            # decay for the wide candidate sweeps this framework runs.
+            # Weighted counts + prior pseudocounts.  Two schedules for the
+            # prior strength (``cat_prior``, A/B'd in benchmarks/quality.py):
+            #   const — reference parity (tpe.py::ap_categorical_sampler):
+            #           counts + n_options·prior_weight·p, decays as 1/N;
+            #   sqrt  — strength grows as sqrt(1+N) so the prior decays as
+            #           1/sqrt(N), a slower decay for wide candidate sweeps.
             m, w, n_set = self._set_weights(set_mask, act)
             counts = jnp.einsum("nd,ndk->dk", w,
                                 onehot.astype(jnp.float32))
-            strength = prior_weight * jnp.sqrt(1.0 + n_set.astype(jnp.float32))
+            if self.cat_prior == "const":
+                strength = prior_weight * jnp.asarray(self.cat_nopts)
+            else:
+                strength = prior_weight * jnp.sqrt(
+                    1.0 + n_set.astype(jnp.float32))
             pseudo = counts + jnp.asarray(self.cat_priors) * strength[:, None]
             return jnp.log(pseudo / jnp.sum(pseudo, axis=1, keepdims=True))
 
@@ -632,14 +708,17 @@ def _prewarm_async(kern: _TpeKernel) -> None:
 
 
 def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
-               split: str = "sqrt", multivariate: bool = False) -> _TpeKernel:
+               split: str = "sqrt", multivariate: bool = False,
+               cat_prior: str | None = None) -> _TpeKernel:
     cache = getattr(cs, "_tpe_kernels", None)
     if cache is None:
         cache = cs._tpe_kernels = {}
-    k = (n_cap, n_cand, lf, split, multivariate,
+    cat_prior = cat_prior or _cat_prior_default()
+    k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
          _pallas_mode(), _sort_mode())
     if k not in cache:
-        cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split, multivariate)
+        cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split, multivariate,
+                              cat_prior)
     return cache[k]
 
 
@@ -691,7 +770,7 @@ def suggest(new_ids, domain, trials, seed,
             gamma=_default_gamma,
             linear_forgetting=_default_linear_forgetting,
             split="sqrt", multivariate=False, startup=None,
-            verbose=True):
+            cat_prior=None, verbose=True):
     """TPE suggest (reference signature: ``hyperopt/tpe.py::suggest`` ~L800).
 
     Bind hyperparameters with ``functools.partial(tpe.suggest, gamma=...)``
@@ -700,12 +779,14 @@ def suggest(new_ids, domain, trials, seed,
     reference's ``gamma·sqrt(N)``); see :func:`suggest_quantile`.
     ``startup='qmc'`` replaces the random warm-start phase with scrambled
     Sobol (better first-posterior coverage; beyond-reference upgrade).
+    ``cat_prior`` selects the categorical prior-strength schedule
+    (:func:`_cat_prior_default`).
     """
     vals, active = suggest_batch(
         new_ids, domain, trials, seed, prior_weight=prior_weight,
         n_startup_jobs=n_startup_jobs, n_EI_candidates=n_EI_candidates,
         gamma=gamma, linear_forgetting=linear_forgetting, split=split,
-        multivariate=multivariate, startup=startup)
+        multivariate=multivariate, startup=startup, cat_prior=cat_prior)
     return base.docs_from_samples(domain.cs, new_ids, vals, active,
                                   exp_key=getattr(trials, "exp_key", None))
 
@@ -716,13 +797,14 @@ def suggest_batch(new_ids, domain, trials, seed,
                   n_EI_candidates=_default_n_EI_candidates,
                   gamma=_default_gamma,
                   linear_forgetting=_default_linear_forgetting,
-                  split="sqrt", multivariate=False, startup=None):
+                  split="sqrt", multivariate=False, startup=None,
+                  cat_prior=None):
     """Raw (vals[n, P], active[n, P]) suggestions without doc packaging."""
     handle = suggest_dispatch(
         new_ids, domain, trials, seed, prior_weight=prior_weight,
         n_startup_jobs=n_startup_jobs, n_EI_candidates=n_EI_candidates,
         gamma=gamma, linear_forgetting=linear_forgetting, split=split,
-        multivariate=multivariate, startup=startup)
+        multivariate=multivariate, startup=startup, cat_prior=cat_prior)
     rows, acts = handle[3]
     return np.asarray(rows), np.asarray(acts)
 
@@ -745,7 +827,7 @@ def suggest_dispatch(new_ids, domain, trials, seed,
                      gamma=_default_gamma,
                      linear_forgetting=_default_linear_forgetting,
                      split="sqrt", multivariate=False, startup=None,
-                     verbose=True):
+                     cat_prior=None, verbose=True):
     """Enqueue the suggest computation on device; returns an opaque handle
     for :func:`suggest_materialize`.  History is snapshotted NOW — a handle
     materialized later proposes from the history as of dispatch time (the
@@ -772,13 +854,13 @@ def suggest_dispatch(new_ids, domain, trials, seed,
     n_rows = h["vals"].shape[0]
     kern = get_kernel(cs, _bucket(n_rows),
                       int(n_EI_candidates), int(linear_forgetting), split,
-                      multivariate)
+                      multivariate, cat_prior)
     if n_rows >= 0.75 * kern.n_cap:
         # Approaching the bucket boundary: compile the next bucket's
         # program in the background so the switchover doesn't stall.
         _prewarm_async(get_kernel(cs, kern.n_cap * 2, int(n_EI_candidates),
                                   int(linear_forgetting), split,
-                                  multivariate))
+                                  multivariate, cat_prior))
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
     key = jax.random.key(int(seed) % (2 ** 32))
     if n == 1:

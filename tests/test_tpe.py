@@ -401,6 +401,42 @@ class TestSuggestApi:
         counts = np.bincount(picks, minlength=3)
         assert counts[2] > counts[0], counts
 
+    def test_cat_prior_const_reference_parity_mode(self):
+        # cat_prior="const" selects the reference's constant prior strength
+        # (ap_categorical_sampler: counts + n_options·prior_weight·p).  It
+        # must compile as a distinct kernel, propose valid options, and the
+        # optimization must still find the best arm.  NOTE: unlike the sqrt
+        # schedule, a constant prior over a tiny sqrt-split below-set makes
+        # EI reward options *rare in the above set* (an exploration artifact
+        # of the reference's formula) — so the suggest distribution is NOT
+        # asserted to exploit; the at-budget quality A/B lives in
+        # benchmarks/quality.py (tpe_cat_const row).
+        from hyperopt_tpu.base import Domain
+        from hyperopt_tpu.space import compile_space
+        from hyperopt_tpu.tpe import _bucket, get_kernel
+
+        space = {"c": hp.choice("c", ["a", "b", "c", "d"])}
+        cs = compile_space(space)
+        n_cap = _bucket(64)
+        k_sqrt = get_kernel(cs, n_cap, 64, 25, cat_prior="sqrt")
+        k_const = get_kernel(cs, n_cap, 64, 25, cat_prior="const")
+        assert k_sqrt is not k_const
+        assert k_const.cat_prior == "const"
+
+        def fn(cfg):
+            return {"a": 3.0, "b": 2.0, "c": 1.0, "d": 0.0}[cfg["c"]]
+
+        d = Domain(fn, space)
+        t = Trials()
+        algo = lambda *a, **kw: tpe.suggest(*a, cat_prior="const", **kw)
+        fmin(fn, space, algo=algo, max_evals=40, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert t.best_trial["result"]["loss"] == 0.0
+        docs = tpe.suggest(list(range(1000, 1032)), d, t, 7,
+                           cat_prior="const")
+        picks = [int(doc["misc"]["vals"]["c"][0]) for doc in docs]
+        assert all(0 <= p <= 3 for p in picks)
+
 
 # ---------------------------------------------------------------------------
 # end-to-end statistical assertions
@@ -587,6 +623,33 @@ class TestPairwiseSortMode:
         monkeypatch.setenv("HYPEROPT_TPU_SORT", "pairwise")
         t = _run("quadratic1", tpe.suggest, 0)
         assert t.best_trial["result"]["loss"] < 0.1
+
+    def test_auto_resolves_from_measured_probe(self, monkeypatch):
+        # auto must (a) run the real probe once and cache per backend,
+        # (b) pick "sort" on a healthy backend (this CPU), and (c) honor a
+        # probe that reported the sort-floor pathology.
+        from hyperopt_tpu import tpe as tpe_mod
+
+        monkeypatch.delenv("HYPEROPT_TPU_SORT", raising=False)
+        monkeypatch.setattr(tpe_mod, "_sort_probe_cache", {})
+        calls = []
+        real_probe = tpe_mod._probe_sort_floor
+
+        def counting_probe(backend):
+            calls.append(backend)
+            return real_probe(backend)
+
+        monkeypatch.setattr(tpe_mod, "_probe_sort_floor", counting_probe)
+        assert tpe_mod._sort_mode() == "sort"     # healthy CPU backend
+        assert tpe_mod._sort_mode() == "sort"
+        assert len(calls) == 1                    # probed once, then cached
+        # pathological backend (simulated): auto flips to pairwise
+        monkeypatch.setattr(tpe_mod, "_sort_probe_cache",
+                            {"cpu": "pairwise"})
+        assert tpe_mod._sort_mode() == "pairwise"
+        # explicit env always wins over the probe
+        monkeypatch.setenv("HYPEROPT_TPU_SORT", "sort")
+        assert tpe_mod._sort_mode() == "sort"
 
 
 class TestChunkedScoring:

@@ -345,6 +345,7 @@ class _TpeKernel:
         ensure_persistent_compilation_cache()
         self._pick_score_chunk()
         self._fn = jax.jit(self._suggest_one)
+        self._fn_seeded = jax.jit(self._seeded_one)
         self._batch_fns = {}  # n -> jitted vmapped suggest (K proposals)
 
     # -- sharding hook -------------------------------------------------------
@@ -635,7 +636,24 @@ class _TpeKernel:
 
     def __call__(self, key, vals, active, loss, ok, gamma, prior_weight):
         return self._fn(key, vals, active, loss, ok,
-                        jnp.float32(gamma), jnp.float32(prior_weight))
+                        np.float32(gamma), np.float32(prior_weight))
+
+    # Seeded entry points: key construction (`jax.random.key` is a ~0.7 ms
+    # un-jitted primitive dispatch) and scalar conversion happen INSIDE the
+    # compiled program, so the host-side cost of one suggest call is a
+    # single jit dispatch.  Profiled on the 1-core host: the e2e loop floor
+    # went from ~320 to ~500+ trials/s (the TPU path saves the same
+    # per-step host milliseconds).
+
+    def _seeded_one(self, seed, vals, active, loss, ok, gamma, prior_weight):
+        return self._suggest_one(jax.random.key(seed), vals, active, loss,
+                                 ok, gamma, prior_weight)
+
+    def suggest_seeded(self, seed, vals, active, loss, ok, gamma,
+                       prior_weight):
+        """One proposal from an integer seed (hot path for ``fmin``)."""
+        return self._fn_seeded(np.uint32(seed), vals, active, loss, ok,
+                               np.float32(gamma), np.float32(prior_weight))
 
     def suggest_many(self, key, n, vals, active, loss, ok, gamma,
                      prior_weight):
@@ -652,7 +670,23 @@ class _TpeKernel:
             self._batch_fns[n] = fn
         keys = jax.random.split(key, n)
         return fn(keys, vals, active, loss, ok,
-                  jnp.float32(gamma), jnp.float32(prior_weight))
+                  np.float32(gamma), np.float32(prior_weight))
+
+    def suggest_many_seeded(self, seed, n, vals, active, loss, ok, gamma,
+                            prior_weight):
+        """``suggest_many`` from an integer seed, key split compiled in."""
+        fn = self._batch_fns.get(("seeded", n))
+        if fn is None:
+            def run(seed, vals, active, loss, ok, gamma, prior_weight):
+                keys = jax.random.split(jax.random.key(seed), n)
+                return jax.vmap(
+                    self._suggest_one,
+                    in_axes=(0, None, None, None, None, None, None))(
+                        keys, vals, active, loss, ok, gamma, prior_weight)
+
+            fn = self._batch_fns[("seeded", n)] = jax.jit(run)
+        return fn(np.uint32(seed), vals, active, loss, ok,
+                  np.float32(gamma), np.float32(prior_weight))
 
 
 # ---------------------------------------------------------------------------
@@ -692,11 +726,11 @@ def _prewarm_async(kern: _TpeKernel) -> None:
             f32 = jnp.float32
             sd = jax.ShapeDtypeStruct
             n_cap, p = kern.n_cap, kern.cs.n_params
-            args = (sd((), jax.random.key(0).dtype),
+            args = (sd((), jnp.uint32),
                     sd((n_cap, p), f32), sd((n_cap, p), jnp.bool_),
                     sd((n_cap,), f32), sd((n_cap,), jnp.bool_),
                     sd((), f32), sd((), f32))
-            kern._fn.lower(*args).compile()
+            kern._fn_seeded.lower(*args).compile()
         except Exception:   # pragma: no cover - purely opportunistic
             logger = __import__("logging").getLogger(__name__)
             logger.debug("bucket prewarm failed", exc_info=True)
@@ -806,7 +840,11 @@ def suggest_batch(new_ids, domain, trials, seed,
         gamma=gamma, linear_forgetting=linear_forgetting, split=split,
         multivariate=multivariate, startup=startup, cat_prior=cat_prior)
     rows, acts = handle[3]
-    return np.asarray(rows), np.asarray(acts)
+    rows = np.asarray(rows)
+    acts = np.asarray(acts)
+    if rows.ndim == 1:          # single-proposal dispatch is rank-1
+        rows, acts = rows[None, :], acts[None, :]
+    return rows, acts
 
 
 # -- async dispatch/materialize (the PP-analog plugin surface) --------------
@@ -862,21 +900,26 @@ def suggest_dispatch(new_ids, domain, trials, seed,
                                   int(linear_forgetting), split,
                                   multivariate, cat_prior))
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
-    key = jax.random.key(int(seed) % (2 ** 32))
+    seed32 = int(seed) % (2 ** 32)
     if n == 1:
-        arrs = kern(key, hv, ha, hl, hok, gamma, prior_weight)
-        arrs = (arrs[0][None, :], arrs[1][None, :])
+        # Rank-1 (P,) device arrays; materialize reshapes to [1, P] on the
+        # host — two fewer device dispatches per step than [None, :] here.
+        arrs = kern.suggest_seeded(seed32, hv, ha, hl, hok,
+                                   gamma, prior_weight)
     else:
-        arrs = kern.suggest_many(key, n, hv, ha, hl, hok,
-                                 gamma, prior_weight)
+        arrs = kern.suggest_many_seeded(seed32, n, hv, ha, hl, hok,
+                                        gamma, prior_weight)
     return ("pending", cs, list(new_ids), arrs, exp_key)
 
 
 def suggest_materialize(handle):
     """Block on a :func:`suggest_dispatch` handle and package trial docs."""
     _, cs, new_ids, (rows, acts), exp_key = handle
-    return base.docs_from_samples(cs, new_ids, np.asarray(rows),
-                                  np.asarray(acts), exp_key=exp_key)
+    rows = np.asarray(rows)
+    acts = np.asarray(acts)
+    if rows.ndim == 1:          # single-proposal dispatch is rank-1
+        rows, acts = rows[None, :], acts[None, :]
+    return base.docs_from_samples(cs, new_ids, rows, acts, exp_key=exp_key)
 
 
 suggest.dispatch = suggest_dispatch

@@ -310,17 +310,21 @@ class TestSuggestApi:
                 assert abs(vals["f"][0] - round(vals["f"][0])) < 1e-5
 
 
-    def test_bucket_prewarm_matches_call_signature(self):
+    def test_bucket_prewarm_matches_call_signature(self, monkeypatch):
         # The background AOT compile must land in the same jit-cache entry
-        # the real call uses — a signature mismatch would silently waste
-        # the prewarm and recompile at the bucket switch.
+        # the real (seeded) hot path uses — a signature mismatch would
+        # silently waste the prewarm and recompile at the bucket switch.
         import threading
         import time
 
+        from hyperopt_tpu import tpe as tpe_mod
         from hyperopt_tpu.tpe import (_padded_history, _prewarm_async,
                                       get_kernel)
         from hyperopt_tpu.space import compile_space
 
+        # The 1-core-CPU policy guard skips the prewarm entirely on this
+        # box; bypass it — the contract under test is signature equality.
+        monkeypatch.setattr(tpe_mod.os, "cpu_count", lambda: 2)
         cs = compile_space({"pw": hp.uniform("pw", -5, 5)})
         kern = get_kernel(cs, n_cap=64, n_cand=64, lf=25)
         _prewarm_async(kern)
@@ -333,7 +337,7 @@ class TestSuggestApi:
              "ok": np.ones(50, bool)}
         hv, ha, hl, hok = _padded_history(h, 64)
         t0 = time.perf_counter()
-        out = kern(jax.random.key(0), hv, ha, hl, hok, 0.25, 1.0)
+        out = kern.suggest_seeded(0, hv, ha, hl, hok, 0.25, 1.0)
         jax.block_until_ready(out)
         assert (time.perf_counter() - t0) * 1e3 < 1500, \
             "first call recompiled despite prewarm"

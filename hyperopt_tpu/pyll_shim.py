@@ -1,16 +1,25 @@
 """``pyll``-compat shim for reference-code migration.
 
 Reference surface covered (``hyperopt/pyll/__init__.py`` re-exports,
-SURVEY.md §2 L0): ``scope`` (expression namespace) and
-``stochastic.sample(space, rng)`` (draw one concrete configuration).  The
-graph-interpreter internals (``rec_eval``, ``toposort``, ``clone``) have no
-equivalent by design — spaces compile once to an XLA sampler
-(:mod:`hyperopt_tpu.space`), there is no per-call graph to interpret.
+SURVEY.md §2 L0): ``scope`` (expression namespace),
+``stochastic.sample(space, rng)`` (draw one concrete configuration), and
+the graph-interpreter surface reference code uses for graph surgery —
+``rec_eval`` (memoized lazy evaluator), ``dfs``/``toposort`` (node
+enumeration), ``clone`` (substituting copy), ``Literal``/``as_apply``.
+
+These operate on THIS framework's expression graph
+(:class:`hyperopt_tpu.space.Expr` trees: ``Param``/``Choice`` stochastic
+leaves, ``Apply`` deterministic nodes, plain dict/list/tuple containers).
+The *hot path* never interprets: spaces compile once to an XLA sampler
+(:mod:`hyperopt_tpu.space`) and the interpreter exists purely so
+migration-era host code (``rec_eval(expr, memo=...)`` idioms,
+``clone``-based space rewrites) keeps working.
 
 Importable as ``hyperopt_tpu.pyll``::
 
     from hyperopt_tpu import pyll
     cfg = pyll.stochastic.sample(space, rng=np.random.default_rng(0))
+    val = pyll.rec_eval(expr, memo={"x": 0.5})
 """
 
 from __future__ import annotations
@@ -18,7 +27,42 @@ from __future__ import annotations
 import numpy as np
 
 from .scope import scope  # noqa: F401
-from .space import compile_space
+from .space import (
+    _SCOPE_IMPLS,
+    CATEGORICAL,
+    LOGNORMAL,
+    LOGUNIFORM,
+    NORMAL,
+    QLOGNORMAL,
+    QLOGUNIFORM,
+    QNORMAL,
+    QUNIFORM,
+    RANDINT,
+    UNIFORM,
+    UNIFORMINT,
+    Apply,
+    Choice,
+    Expr,
+    Param,
+    compile_space,
+)
+
+
+class Literal(Expr):
+    """A constant wrapped as a graph node (reference: ``pyll.Literal``).
+
+    Plain Python values embedded in a space already act as literals; this
+    class exists for reference code that constructs/inspects ``Literal``
+    nodes explicitly (e.g. during ``clone``-based rewrites).
+    """
+
+    __slots__ = ("obj",)
+
+    def __init__(self, obj=None):
+        self.obj = obj
+
+    def __repr__(self):
+        return f"Literal({self.obj!r})"
 
 
 def as_apply(obj):
@@ -31,6 +75,190 @@ def as_apply(obj):
     ``pyll.as_apply(space)`` gets its input back unchanged.
     """
     return obj
+
+
+# ---------------------------------------------------------------------------
+# graph interpretation (reference: pyll/base.py::rec_eval ~L550-700)
+# ---------------------------------------------------------------------------
+
+
+def _memo_get(memo, node):
+    """Memo lookup by node identity first (the reference's convention),
+    then by label (the natural spelling for this framework's users)."""
+    if memo is None:
+        return False, None
+    try:
+        if node in memo:
+            return True, memo[node]
+    except TypeError:       # unhashable memo key types — label path below
+        pass
+    label = getattr(node, "label", None)
+    if label is not None and label in memo:
+        return True, memo[label]
+    return False, None
+
+
+def _draw_leaf(p: Param, rng: np.random.Generator):
+    """One numpy draw from a stochastic leaf's marginal (the generative
+    semantics ``pyll/stochastic.py``'s samplers implement per node)."""
+    k = p.kind
+    if k == UNIFORM:
+        return float(rng.uniform(p.low, p.high))
+    if k == LOGUNIFORM:
+        return float(np.exp(rng.uniform(p.low, p.high)))
+    if k == QUNIFORM:
+        return float(np.round(rng.uniform(p.low, p.high) / p.q) * p.q)
+    if k == QLOGUNIFORM:
+        return float(np.round(np.exp(rng.uniform(p.low, p.high)) / p.q) * p.q)
+    if k == NORMAL:
+        return float(rng.normal(p.mu, p.sigma))
+    if k == LOGNORMAL:
+        return float(np.exp(rng.normal(p.mu, p.sigma)))
+    if k == QNORMAL:
+        return float(np.round(rng.normal(p.mu, p.sigma) / p.q) * p.q)
+    if k == QLOGNORMAL:
+        return float(np.round(np.exp(rng.normal(p.mu, p.sigma)) / p.q) * p.q)
+    if k == RANDINT:
+        if p.probs is not None:
+            return int(p.low) + int(rng.choice(len(p.probs), p=p.probs))
+        return int(rng.integers(p.low, p.high))
+    if k == UNIFORMINT:
+        return int(rng.integers(p.low, int(p.high) + 1))
+    if k == CATEGORICAL:
+        return int(rng.choice(len(p.probs), p=p.probs))
+    raise ValueError(f"cannot draw from {p!r}")
+
+
+def rec_eval(expr, memo=None, rng=None):
+    """Evaluate an expression tree to a concrete value.
+
+    Reference: ``pyll/base.py::rec_eval(expr, memo=...)`` — the memoized
+    post-order interpreter.  ``memo`` maps nodes (by identity, the
+    reference convention) or labels to concrete values; stochastic leaves
+    not covered by the memo are drawn with ``rng`` (a
+    ``numpy.random.Generator``) or raise.  ``scope.switch`` is lazy: only
+    the selected branch is evaluated, exactly like the reference builtin.
+    """
+
+    def rec(node):
+        if isinstance(node, Choice):
+            # A memo entry for a Choice holds the BRANCH INDEX (the value
+            # stored in trials' misc.vals), not the branch's final value.
+            hit_i, idx = _memo_get(memo, node)
+            if not hit_i:
+                if rng is None:
+                    raise KeyError(
+                        f"rec_eval: no memo value (and no rng) for {node!r}")
+                probs = node.probs or \
+                    [1.0 / len(node.options)] * len(node.options)
+                idx = int(rng.choice(len(node.options), p=probs))
+            return rec(node.options[int(idx)])
+        hit, v = _memo_get(memo, node)
+        if hit:
+            return v
+        if isinstance(node, Literal):
+            return node.obj
+        if isinstance(node, Param):
+            if rng is not None:
+                return _draw_leaf(node, rng)
+            raise KeyError(
+                f"rec_eval: no memo value (and no rng) for {node!r}")
+        if isinstance(node, Apply):
+            if node.op == "switch":
+                sel = int(rec(node.args[0]))
+                options = node.args[1:]
+                if not 0 <= sel < len(options):
+                    raise IndexError(
+                        f"scope.switch index {sel} out of range for "
+                        f"{len(options)} options")
+                return rec(options[sel])
+            return _SCOPE_IMPLS[node.op](*(rec(a) for a in node.args))
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(v) for v in node)
+        return node      # plain literal
+
+    return rec(expr)
+
+
+def dfs(expr):
+    """Post-order list of the UNIQUE graph nodes under ``expr`` (children
+    before parents).  Reference: ``pyll/base.py::dfs``.  Only ``Expr``
+    nodes are returned; container structure is traversed through."""
+    seen: set = set()
+    out: list = []
+
+    def rec(node):
+        if isinstance(node, Expr):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            if isinstance(node, Apply):
+                for a in node.args:
+                    rec(a)
+            elif isinstance(node, Choice):
+                for o in node.options:
+                    rec(o)
+            out.append(node)
+        elif isinstance(node, dict):
+            for v in node.values():
+                rec(v)
+        elif isinstance(node, (list, tuple)):
+            for v in node:
+                rec(v)
+
+    rec(expr)
+    return out
+
+
+def toposort(expr):
+    """Topological order of the expression DAG (every node after all of its
+    inputs).  Reference: ``pyll/base.py::toposort`` (networkx there; the
+    deduplicated post-order is the same ordering for these graphs)."""
+    return dfs(expr)
+
+
+def clone(expr, memo=None):
+    """Deep-copy an expression graph, substituting via ``memo``
+    (node → replacement).  Reference: ``pyll/base.py::clone`` — the graph-
+    surgery primitive behind space rewrites.  Shared subgraphs stay shared
+    in the copy (identity-memoized like the reference)."""
+    memo = dict(memo or {})
+
+    def rec(node):
+        if isinstance(node, Expr):
+            if id(node) in _copies:
+                return _copies[id(node)]
+            if memo:
+                try:
+                    if node in memo:
+                        return memo[node]
+                except TypeError:
+                    pass
+            if isinstance(node, Literal):
+                new = Literal(node.obj)
+            elif isinstance(node, Param):
+                new = Param(node.label, node.kind, low=node.low,
+                            high=node.high, mu=node.mu, sigma=node.sigma,
+                            q=node.q, probs=node.probs)
+            elif isinstance(node, Choice):
+                new = Choice(node.label, [rec(o) for o in node.options],
+                             probs=node.probs)
+            elif isinstance(node, Apply):
+                new = Apply(node.op, tuple(rec(a) for a in node.args))
+            else:       # pragma: no cover - future Expr subclasses
+                raise TypeError(f"clone: unknown node type {type(node)!r}")
+            _copies[id(node)] = new
+            return new
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(v) for v in node)
+        return node
+
+    _copies: dict = {}
+    return rec(expr)
 
 
 class stochastic:

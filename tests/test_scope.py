@@ -150,3 +150,103 @@ class TestPyllImportIdioms:
         assert s2 is scope
         cfg = stochastic.sample(space, seed=0)
         assert 0.0 <= cfg["x"] <= 1.0
+
+
+class TestPyllInterpreter:
+    """rec_eval/dfs/toposort/clone/Literal (reference: pyll/base.py
+    ~L460-800) over this framework's Expr graph — the graph-surgery surface
+    migration-era host code touches; the compiled hot path never interprets."""
+
+    def test_rec_eval_memo_by_label_and_node(self):
+        from hyperopt_tpu import pyll
+
+        x = hp.uniform("x", 0, 10)
+        expr = x * 2 + 1
+        assert pyll.rec_eval(expr, memo={"x": 3.0}) == 7.0
+        assert pyll.rec_eval(expr, memo={x: 4.0}) == 9.0
+
+    def test_rec_eval_switch_is_lazy(self):
+        from hyperopt_tpu import pyll
+
+        # The unselected branch contains a poison op that would raise.
+        bad = scope.int(hp.uniform("bad", 0, 1))
+        expr = scope.switch(hp.randint("i", 2), "ok", bad)
+        assert pyll.rec_eval(expr, memo={"i": 0}) == "ok"
+        # selecting the poison branch WITH a memo'd leaf works too
+        assert pyll.rec_eval(expr, memo={"i": 1, "bad": 0.7}) == 0
+
+    def test_rec_eval_choice_memo_holds_branch_index(self):
+        from hyperopt_tpu import pyll
+
+        c = hp.choice("c", [{"lr": hp.uniform("lr_a", 0, 1)},
+                            {"lr": hp.uniform("lr_b", 1, 2)}])
+        out = pyll.rec_eval({"m": c}, memo={"c": 1, "lr_b": 1.5})
+        assert out == {"m": {"lr": 1.5}}
+
+    def test_rec_eval_rng_draws_uncovered_leaves(self):
+        from hyperopt_tpu import pyll
+
+        space = {"u": hp.uniform("u", 0, 1),
+                 "q": hp.quniform("q", 0, 10, 2),
+                 "c": hp.choice("c", ["a", "b"]),
+                 "n": scope.int(hp.uniformint("n", 1, 4))}
+        rng = np.random.default_rng(0)
+        for _ in range(20):
+            cfg = pyll.rec_eval(space, rng=rng)
+            assert 0 <= cfg["u"] <= 1
+            assert cfg["q"] % 2 == 0 and 0 <= cfg["q"] <= 10
+            assert cfg["c"] in ("a", "b")
+            assert cfg["n"] in (1, 2, 3, 4)
+        with pytest.raises(KeyError):
+            pyll.rec_eval(space)        # no memo, no rng
+
+    def test_dfs_toposort_order(self):
+        from hyperopt_tpu import pyll
+
+        x = hp.uniform("x", 0, 1)
+        y = hp.uniform("y", 0, 1)
+        expr = x * 2 + y            # add(mul(x, 2), y)
+        nodes = pyll.dfs({"e": expr})
+        assert nodes == pyll.toposort({"e": expr})
+        pos = {id(n): i for i, n in enumerate(nodes)}
+        for node in nodes:
+            if isinstance(node, pyll.Apply):
+                for a in node.args:
+                    if isinstance(a, pyll.Expr):
+                        assert pos[id(a)] < pos[id(node)]
+        assert sum(isinstance(n, pyll.Param) for n in nodes) == 2
+        # shared subgraph appears once
+        shared = x + 1
+        both = pyll.dfs([shared * 2, shared * 3])
+        assert sum(1 for n in both if n is shared) == 1
+
+    def test_clone_substitutes_and_preserves_sharing(self):
+        from hyperopt_tpu import pyll
+
+        x = hp.uniform("x", 0, 1)
+        shared = x * 2
+        expr = {"a": shared + 1, "b": shared + 2}
+        cp = pyll.clone(expr)
+        assert cp is not expr
+        assert pyll.rec_eval(cp, memo={"x": 1.0}) == {"a": 3.0, "b": 4.0}
+        # sharing preserved: the cloned `shared` node is one object
+        nodes = [n for n in pyll.dfs(cp)
+                 if isinstance(n, pyll.Apply) and n.op == "mul"]
+        assert len(nodes) == 1
+        # substitution: replace the leaf with a Literal
+        cp2 = pyll.clone(expr, memo={x: pyll.Literal(5.0)})
+        assert pyll.rec_eval(cp2) == {"a": 11.0, "b": 12.0}
+        # original untouched
+        assert pyll.rec_eval(expr, memo={"x": 0.0}) == {"a": 1.0, "b": 2.0}
+
+    def test_clone_result_still_compiles_and_optimizes(self):
+        from hyperopt_tpu import pyll
+
+        space = {"lr": hp.loguniform("lr", -3, 0),
+                 "arch": hp.choice("arch", ["s", "m"])}
+        clone = pyll.clone(space)
+        t = ho.Trials()
+        ho.fmin(lambda d: d["lr"], clone, algo=ho.rand.suggest, max_evals=10,
+                trials=t, rstate=np.random.default_rng(0),
+                show_progressbar=False)
+        assert len(t) == 10

@@ -623,6 +623,7 @@ class TestPairwiseSortMode:
         assert np.array_equal(np.asarray(b1), np.asarray(b2))
         assert np.array_equal(np.asarray(a1), np.asarray(a2))
 
+    @pytest.mark.slow
     def test_pairwise_mode_converges(self, monkeypatch):
         monkeypatch.setenv("HYPEROPT_TPU_SORT", "pairwise")
         t = _run("quadratic1", tpe.suggest, 0)
@@ -698,6 +699,7 @@ class TestMultivariate:
             else:
                 assert len(vals["amp"]) == 1
 
+    @pytest.mark.slow
     def test_multivariate_converges(self):
         # correlated 2-D objective: the joint winner must at least meet the
         # factorized threshold

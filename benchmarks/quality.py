@@ -84,11 +84,20 @@ def main(argv=None):
     which = set(argv)
     import subprocess
 
+    import tempfile
+
     rows = []
     for name in _domain_names(which):
+        # Fresh ATPE transfer cache per domain: the cross-experiment memory
+        # is a real feature, but letting seed N inherit seed N-1's arm
+        # statistics (or a developer's ~/.cache) would make this benchmark
+        # order-dependent; here every algo measures from a cold start.
+        env = dict(os.environ,
+                   HYPEROPT_TPU_CACHE_DIR=tempfile.mkdtemp(
+                       prefix="hyperopt_tpu_quality_"))
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--one", name],
-            capture_output=True, text=True, env=dict(os.environ))
+            capture_output=True, text=True, env=env)
         for line in r.stdout.splitlines():
             if line.startswith("{"):
                 rec = json.loads(line)
@@ -104,6 +113,7 @@ def _run_domains(names):
     import hyperopt_tpu as ho
     from zoo import ZOO
 
+    base_cache = os.environ.get("HYPEROPT_TPU_CACHE_DIR", "/tmp")
     for name in names:
         z = ZOO[name]
         rec = {"domain": name, "budget": z.budget,
@@ -112,6 +122,10 @@ def _run_domains(names):
             t0 = time.perf_counter()
             finals = []
             for s in SEEDS:
+                # Per-seed cold start (see main()): seeds must stay
+                # independent repetitions, not a transfer-learning chain.
+                os.environ["HYPEROPT_TPU_CACHE_DIR"] = os.path.join(
+                    base_cache, f"{aname}_{s}")
                 t = ho.Trials()
                 ho.fmin(z.fn, z.space, algo=algo, max_evals=z.budget,
                         trials=t, rstate=np.random.default_rng(s),

@@ -1,0 +1,97 @@
+"""ATPE transfer-memory A/B: does experiment 2 benefit from experiment 1?
+
+The round-3 transfer memory persists Thompson-sampling arm posteriors per
+space fingerprint (``atpe._TransferStore``) — the self-contained analog of
+the reference's pretrained ``atpe_models/``.  This benchmark records its
+value as a number instead of a claim:
+
+For each seed: run experiment 1 (``budget`` evals) with a fresh cache,
+then experiment 2 twice at a SMALLER budget — once seeded by experiment
+1's cache (transfer) and once with another fresh cache (cold) — and
+compare best-loss-at-budget.
+
+Run::
+
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python benchmarks/transfer_ab.py
+
+Writes ``benchmarks/transfer_ab_latest.json`` and prints one table.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_ROOT, "tests"))
+sys.path.insert(0, _ROOT)
+
+SEEDS = [0, 1, 2, 3, 4]
+DOMAINS = ["quadratic1", "q1_choice", "many_dists"]
+EXP2_FRACTION = 0.5          # experiment 2 runs at half the domain budget
+# Short startup for BOTH arms: with the default 20 random startup trials a
+# 30-eval experiment 2 leaves the bandit ~10 decisions — measuring noise,
+# not the transfer memory.  10 is the regime a user re-running experiments
+# on a known space would pick.
+N_STARTUP = 10
+
+
+def _run(z, seed, cache_dir, budget):
+    import hyperopt_tpu as ho
+
+    os.environ["HYPEROPT_TPU_CACHE_DIR"] = cache_dir
+    t = ho.Trials()
+    algo = ho.partial(ho.atpe.suggest, n_startup_jobs=N_STARTUP)
+    ho.fmin(z.fn, z.space, algo=algo, max_evals=budget,
+            trials=t, rstate=np.random.default_rng(seed),
+            show_progressbar=False)
+    return t.best_trial["result"]["loss"]
+
+
+def main(argv=None):
+    from zoo import ZOO
+
+    which = set(argv or sys.argv[1:])
+    rows = []
+    for name in DOMAINS:
+        if which and name not in which:
+            continue
+        z = ZOO[name]
+        b2 = max(10, int(z.budget * EXP2_FRACTION))
+        cold, warm = [], []
+        t0 = time.perf_counter()
+        for s in SEEDS:
+            exp1_dir = tempfile.mkdtemp(prefix="transfer_ab_")
+            _run(z, s, exp1_dir, z.budget)            # experiment 1 learns
+            warm.append(_run(z, 1000 + s, exp1_dir, b2))   # seeded exp 2
+            cold.append(_run(z, 1000 + s,
+                             tempfile.mkdtemp(prefix="transfer_ab_"), b2))
+        rec = {"domain": name, "exp1_budget": z.budget, "exp2_budget": b2,
+               "cold_median": float(np.median(cold)),
+               "transfer_median": float(np.median(warm)),
+               "transfer_wins": int(sum(w <= c for w, c in zip(warm, cold))),
+               "n_seeds": len(SEEDS),
+               "wall_s": round(time.perf_counter() - t0, 1)}
+        rows.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "transfer_ab_latest.json")
+    with open(out, "w") as f:
+        json.dump({"seeds": SEEDS, "rows": rows}, f, indent=1)
+    print("\n| domain | exp2 budget | cold | transfer | transfer wins |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['domain']} | {r['exp2_budget']} | "
+              f"{r['cold_median']:.4g} | {r['transfer_median']:.4g} | "
+              f"{r['transfer_wins']}/{r['n_seeds']} |")
+    print(f"\n# wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

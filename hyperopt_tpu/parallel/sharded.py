@@ -127,12 +127,15 @@ def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
                                int(n_EI_candidates), int(linear_forgetting),
                                mesh, split)
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
-    key = jax.random.key(int(seed) % (2 ** 32))
+    seed32 = int(seed) % (2 ** 32)
     rows, acts = [], []
     with mesh:
         for i in range(len(new_ids)):
-            r, a = kern(jax.random.fold_in(key, i), hv, ha, hl, hok,
-                        gamma, prior_weight)
+            # Seeded entry: key construction is compiled into the sharded
+            # program (one jit dispatch per proposal, no un-jitted
+            # random_seed/fold_in primitives on the host).
+            r, a = kern.suggest_seeded((seed32 + i) % (2 ** 32), hv, ha,
+                                       hl, hok, gamma, prior_weight)
             rows.append(np.asarray(r))
             acts.append(np.asarray(a))
     return base.docs_from_samples(cs, new_ids, np.stack(rows),
@@ -200,8 +203,8 @@ def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
     keys = jax.random.split(jax.random.key(int(seed) % (2 ** 32)), n_starts)
     with mesh:
-        rows, acts = fn(keys, hv, ha, hl, hok, jnp.float32(gamma),
-                        jnp.float32(prior_weight))
+        rows, acts = fn(keys, hv, ha, hl, hok, np.float32(gamma),
+                        np.float32(prior_weight))
     rows = np.asarray(rows)[:n]
     acts = np.asarray(acts)[:n]
     return base.docs_from_samples(cs, new_ids, rows, acts,

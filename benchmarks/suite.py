@@ -228,10 +228,14 @@ def main(argv=None):
     merged = {}
     try:
         with open(out) as f:
-            for rec in json.load(f).get("records", []):
-                rec.setdefault("backend", "unknown")
-                merged[(rec["metric"], rec["backend"],
-                        rec.get("n_devices"))] = rec
+            old = json.load(f)
+        # Pre-merge files carried one top-level backend for all records;
+        # back-fill per-record labels from it, not from "unknown".
+        legacy_backend = old.get("backend", "unknown")
+        for rec in old.get("records", []):
+            rec.setdefault("backend", legacy_backend)
+            merged[(rec["metric"], rec["backend"],
+                    rec.get("n_devices"))] = rec
     except (OSError, ValueError):
         pass
     for rec in _RECORDS:

@@ -230,14 +230,23 @@ class _TransferStore:
             return {}
 
     def load(self, fp, n_arms):
-        """Seed posteriors: Beta(1,1) plus capped stored evidence."""
+        """Seed posteriors: Beta(1,1) plus capped stored evidence.  A
+        malformed record (schema drift, hand edits) degrades to the flat
+        prior rather than crashing every experiment on that space."""
         rec = self._read().get(fp)
         wins = np.ones(n_arms)
         losses = np.ones(n_arms)
-        if rec and len(rec.get("wins", ())) == n_arms:
-            w = np.asarray(rec["wins"], float)
-            l = np.asarray(rec["losses"], float)
+        if (isinstance(rec, dict)
+                and len(rec.get("wins", ())) == n_arms
+                and len(rec.get("losses", ())) == n_arms):
+            try:
+                w = np.asarray(rec["wins"], float)
+                l = np.asarray(rec["losses"], float)
+            except (TypeError, ValueError):
+                return wins, losses
             total = float(w.sum() + l.sum())
+            if not np.isfinite(total):
+                return wins, losses
             s = min(1.0, self.EVIDENCE_CAP / total) if total > 0 else 0.0
             wins += s * w
             losses += s * l
@@ -252,13 +261,21 @@ class _TransferStore:
                 data = self._read()
                 rec = data.get(fp)
                 n = len(d_wins)
-                if not rec or len(rec.get("wins", ())) != n:
-                    rec = {"wins": [0.0] * n, "losses": [0.0] * n,
-                           "n_experiments": 0}
-                rec["wins"] = (np.asarray(rec["wins"], float)
-                               + d_wins).tolist()
-                rec["losses"] = (np.asarray(rec["losses"], float)
-                                 + d_losses).tolist()
+                try:
+                    if (not isinstance(rec, dict)
+                            or len(rec.get("wins", ())) != n
+                            or len(rec.get("losses", ())) != n):
+                        raise ValueError
+                    old_w = np.asarray(rec["wins"], float)
+                    old_l = np.asarray(rec["losses"], float)
+                    if not np.isfinite(old_w.sum() + old_l.sum()):
+                        raise ValueError
+                except (TypeError, ValueError):   # schema drift → restart
+                    rec = {"n_experiments": 0}
+                    old_w = np.zeros(n)
+                    old_l = np.zeros(n)
+                rec["wins"] = (old_w + d_wins).tolist()
+                rec["losses"] = (old_l + d_losses).tolist()
                 rec["n_experiments"] = int(rec.get("n_experiments", 0)
                                            + n_new_exp)
                 data[fp] = rec

@@ -153,9 +153,13 @@ def rec_eval(expr, memo=None, rng=None):
                     [1.0 / len(node.options)] * len(node.options)
                 idx = int(rng.choice(len(node.options), p=probs))
             return rec(node.options[int(idx)])
-        hit, v = _memo_get(memo, node)
-        if hit:
-            return v
+        # The memo applies to GRAPH NODES only — a plain literal that
+        # happens to equal a label key (e.g. option string "c" vs label
+        # "c") must never be substituted.
+        if isinstance(node, Expr):
+            hit, v = _memo_get(memo, node)
+            if hit:
+                return v
         if isinstance(node, Literal):
             return node.obj
         if isinstance(node, Param):

@@ -839,12 +839,7 @@ def suggest_batch(new_ids, domain, trials, seed,
         n_startup_jobs=n_startup_jobs, n_EI_candidates=n_EI_candidates,
         gamma=gamma, linear_forgetting=linear_forgetting, split=split,
         multivariate=multivariate, startup=startup, cat_prior=cat_prior)
-    rows, acts = handle[3]
-    rows = np.asarray(rows)
-    acts = np.asarray(acts)
-    if rows.ndim == 1:          # single-proposal dispatch is rank-1
-        rows, acts = rows[None, :], acts[None, :]
-    return rows, acts
+    return _force_rows(handle)
 
 
 # -- async dispatch/materialize (the PP-analog plugin surface) --------------
@@ -912,13 +907,21 @@ def suggest_dispatch(new_ids, domain, trials, seed,
     return ("pending", cs, list(new_ids), arrs, exp_key)
 
 
-def suggest_materialize(handle):
-    """Block on a :func:`suggest_dispatch` handle and package trial docs."""
-    _, cs, new_ids, (rows, acts), exp_key = handle
+def _force_rows(handle):
+    """Force a dispatch handle's arrays to host [n, P] form (the
+    single-proposal dispatch returns rank-1 device arrays)."""
+    rows, acts = handle[3]
     rows = np.asarray(rows)
     acts = np.asarray(acts)
-    if rows.ndim == 1:          # single-proposal dispatch is rank-1
+    if rows.ndim == 1:
         rows, acts = rows[None, :], acts[None, :]
+    return rows, acts
+
+
+def suggest_materialize(handle):
+    """Block on a :func:`suggest_dispatch` handle and package trial docs."""
+    _, cs, new_ids, _arrs, exp_key = handle
+    rows, acts = _force_rows(handle)
     return base.docs_from_samples(cs, new_ids, rows, acts, exp_key=exp_key)
 
 

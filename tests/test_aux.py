@@ -185,6 +185,18 @@ class TestAtpeTransfer:
         (tmp_path / "atpe_transfer.json").write_text("{broken")
         w, l = store.load(fp, 3)
         assert np.allclose(w, 1.0)
+        # schema-drifted records (missing/mismatched/non-numeric fields)
+        # degrade to the flat prior instead of crashing every experiment
+        for bad in ('{"%s": {"wins": [1, 2, 3]}}' % fp,
+                    '{"%s": {"wins": [1, 2, 3], "losses": [1]}}' % fp,
+                    '{"%s": {"wins": [1, "x", 3], "losses": [1, 2, 3]}}' % fp,
+                    '{"%s": [1, 2]}' % fp):
+            (tmp_path / "atpe_transfer.json").write_text(bad)
+            w, l = store.load(fp, 3)
+            assert np.allclose(w, 1.0) and np.allclose(l, 1.0), bad
+            store.flush(fp, np.ones(3), np.zeros(3))   # heals the record
+            assert json.load(open(tmp_path / "atpe_transfer.json"))[
+                fp]["wins"] == [1.0, 1.0, 1.0]
 
     def test_disabled_by_env(self, tmp_path, monkeypatch):
         monkeypatch.setenv("HYPEROPT_TPU_CACHE_DIR", str(tmp_path))

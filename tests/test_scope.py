@@ -175,6 +175,17 @@ class TestPyllInterpreter:
         # selecting the poison branch WITH a memo'd leaf works too
         assert pyll.rec_eval(expr, memo={"i": 1, "bad": 0.7}) == 0
 
+    def test_rec_eval_memo_never_substitutes_plain_literals(self):
+        # Literal values colliding with a memo key (option string "c" vs
+        # label "c") must evaluate to themselves, not the memo value.
+        from hyperopt_tpu import pyll
+
+        c = hp.choice("c", ["a", "b", "c", "d"])
+        assert pyll.rec_eval(c, memo={"c": 2}) == "c"
+        assert pyll.rec_eval({"lr": "x", "m": c},
+                             memo={"c": 0, "lr": 99}) == \
+            {"lr": "x", "m": "a"}
+
     def test_rec_eval_choice_memo_holds_branch_index(self):
         from hyperopt_tpu import pyll
 

@@ -1,0 +1,72 @@
+"""Adaptive TPE: the self-tuning optimizer, and its cross-experiment memory.
+
+``atpe.suggest`` (reference: ``hyperopt/atpe.py``) runs a Thompson-sampling
+portfolio over TPE configurations — γ value and schedule, EI candidate
+count, prior weight, history forgetting, and per-parameter lockout driven
+by online η² importance — so you don't hand-tune TPE's knobs per problem.
+
+Arm statistics persist per space fingerprint under
+``~/.cache/hyperopt_tpu/`` (the self-contained analog of the reference's
+pretrained ``atpe_models/``): re-running an experiment over the same space
+starts from what earlier runs learned. ``HYPEROPT_TPU_ATPE_TRANSFER=0``
+turns the memory off; ``HYPEROPT_TPU_CACHE_DIR`` relocates it.
+
+Run: python examples/08_adaptive_tpe.py
+"""
+
+import numpy as np
+
+import hyperopt_tpu as ho
+from hyperopt_tpu import atpe, hp
+
+# A 6-dim problem where only two parameters matter — the regime ATPE's
+# importance-driven lockout arms are built for.
+space = {
+    "lr": hp.loguniform("lr", np.log(1e-4), np.log(1.0)),
+    "depth": hp.uniformint("depth", 1, 8),
+    **{f"noise{i}": hp.uniform(f"noise{i}", -1, 1) for i in range(4)},
+}
+
+
+def objective(cfg):
+    return (np.log(cfg["lr"] / 1e-2) ** 2          # optimum at lr=1e-2
+            + (cfg["depth"] - 5) ** 2 * 0.2        # ... and depth=5
+            + 0.001 * sum(cfg[f"noise{i}"] for i in range(4)))
+
+
+t = ho.Trials()
+ho.fmin(objective, space, algo=atpe.suggest, max_evals=80, trials=t,
+        rstate=np.random.default_rng(0))
+print("atpe best loss:", round(t.best_trial["result"]["loss"], 4))
+
+# The bandit state this experiment accumulated (wins/losses per arm):
+st = t._atpe_state
+print("arm outcomes  wins:", st.wins.round(1), " losses:",
+      st.losses.round(1))
+
+# Parameter importance as ATPE saw it (η² of loss across value groups).
+# lr ranks top; expect noisy scores for the rest at this budget — η² over
+# an adaptively-sampled 80-trial history is an online heuristic (it drives
+# the lockout arms), not a final-analysis tool.
+from hyperopt_tpu.utils import parameter_importance
+
+for label, score in parameter_importance(t, space).items():
+    print(f"  importance[{label}] = {score:.2f}")
+
+# A second experiment on the SAME space is seeded from the first one's arm
+# posteriors (capped, so fresh evidence can override) — inspect the store:
+import json
+import os
+
+from hyperopt_tpu.space import compile_space
+
+path = os.path.join(os.environ.get("HYPEROPT_TPU_CACHE_DIR")
+                    or os.path.expanduser("~/.cache/hyperopt_tpu"),
+                    "atpe_transfer.json")
+if os.path.exists(path):
+    store = json.load(open(path))
+    fp = atpe._fingerprint(compile_space(space))
+    rec = store.get(fp, {})
+    print("transfer store:", {k: (np.round(v, 1).tolist()
+                                  if isinstance(v, list) else v)
+                              for k, v in rec.items()})

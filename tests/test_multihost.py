@@ -81,6 +81,84 @@ def _free_port():
     return port
 
 
+_TWO_PROC_SCRIPT = """
+    from __graft_entry__ import _force_cpu_platform
+    jax = _force_cpu_platform(4, probe=False)   # 4 local devices per process
+
+    import numpy as np
+    from hyperopt_tpu.parallel import multihost
+
+    mesh = multihost.initialize(
+        coordinator_address="127.0.0.1:{port}", num_processes=2,
+        process_id={pid})
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, jax.devices()        # the GLOBAL mesh
+    assert len(jax.local_devices()) == 4
+    assert mesh.devices.size == 8
+
+    # A real cross-process XLA collective (the DCN-tier analog): every
+    # process contributes its id, every process sees both.
+    from jax.experimental import multihost_utils
+    g = multihost_utils.process_allgather(np.asarray([{pid}], np.int32))
+    print("ALLGATHER", sorted(np.asarray(g).ravel().tolist()))
+
+    # Sharded TPE suggest over the JOINT mesh: the candidate axis spans
+    # both processes' devices; identical (seeded) history on each host ->
+    # the SPMD program must produce the identical proposal on both.
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.space import compile_space
+    from hyperopt_tpu.parallel.sharded import _get_sharded_kernel
+
+    cs = compile_space({{"x": hp.uniform("x", -2.0, 2.0)}})
+    rng = np.random.default_rng(0)
+    n, cap = 24, 32
+    vals = np.zeros((cap, 1), np.float32)
+    vals[:n] = rng.uniform(-2, 2, (n, 1)).astype(np.float32)
+    act = np.zeros((cap, 1), bool); act[:n] = True
+    loss = np.full(cap, np.inf, np.float32)
+    loss[:n] = (vals[:n, 0] - 1.0) ** 2
+    ok = np.zeros(cap, bool); ok[:n] = True
+    kern = _get_sharded_kernel(cs, cap, 64, 25, mesh, "sqrt")
+    with mesh:
+        r, a = kern.suggest_seeded(7, vals, act, loss, ok, 0.25, 1.0)
+    print("PROPOSAL", round(float(np.asarray(r)[0]), 6))
+"""
+
+
+@pytest.mark.slow
+class TestTwoProcessGlobalMesh:
+    def test_cross_process_collective_and_sharded_suggest(self):
+        """TWO real processes × 4 CPU devices form one 8-device global mesh
+        (jax.distributed over local gRPC — the DCN tier, SURVEY.md §5.8):
+        a cross-process allgather sees both hosts, and the sharded TPE
+        kernel runs one SPMD program over the joint mesh with both
+        processes computing the identical proposal."""
+        port = _free_port()
+        # Blank XLA_FLAGS: the pytest process carries the 8-device force
+        # flag, which would beat each subprocess's own 4-device setting.
+        procs = [subprocess.Popen(
+            [sys.executable, "-c",
+             textwrap.dedent(_TWO_PROC_SCRIPT).format(port=port, pid=pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=dict(os.environ, XLA_FLAGS="")) for pid in (0, 1)]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=420)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for pid, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"proc {pid}:\n{out[-3000:]}"
+            assert "ALLGATHER [0, 1]" in out, f"proc {pid}:\n{out[-3000:]}"
+        props = [line.split()[-1] for out in outs
+                 for line in out.splitlines() if line.startswith("PROPOSAL")]
+        assert len(props) == 2 and props[0] == props[1], props
+
+
 @pytest.mark.slow
 class TestDriverWorkerRoles:
     def test_driver_and_worker_subprocesses(self, tmp_path):

@@ -194,6 +194,46 @@ def bench_5_100k_sweep():
     _emit("tpe_suggest_latency_100k_cand_100dim", ms, "ms")
 
 
+def bench_5s_100k_sweep_sharded():
+    """Config 5 with the candidate axis sharded over the device mesh — the
+    long-axis scaling path (SURVEY.md §5.7): 100k candidates split across
+    all devices, argmax reduced with collectives."""
+    import jax
+
+    from hyperopt_tpu.parallel.sharded import (
+        _get_sharded_kernel,
+        default_mesh,
+    )
+    from hyperopt_tpu.space import compile_space
+    from hyperopt_tpu.tpe import _bucket, _padded_history
+    from __graft_entry__ import _history
+
+    mesh = default_mesh()
+    n_dev = int(np.prod(list(mesh.shape.values())))
+    cs = compile_space(_flagship(100))
+    n_cand = 100_000 - (100_000 % n_dev)     # divisible by the mesh axis
+    kern = _get_sharded_kernel(cs, _bucket(1000), n_cand, 25, mesh, "sqrt")
+    hv, ha, hl, hok = _padded_history(_history(cs, 1000), kern.n_cap)
+    ts = []
+    with mesh:
+        out = kern.suggest_seeded(0, hv, ha, hl, hok, 0.25, 1.0)
+        jax.block_until_ready(out)
+        for i in range(2):
+            t0 = time.perf_counter()
+            out = kern.suggest_seeded(i + 1, hv, ha, hl, hok, 0.25, 1.0)
+            jax.block_until_ready(out)
+            ts.append((time.perf_counter() - t0) * 1e3)
+    extra = {"n_devices": n_dev, "n_cand": n_cand}
+    if _backend() == "cpu":
+        extra["note"] = (
+            "virtual mesh: all devices share one physical core, so this "
+            "measures partitioning CORRECTNESS, not speedup — the sharded "
+            "program pays partition overhead with zero extra compute; "
+            "compare against the unsharded row only on real multi-chip")
+    _emit("tpe_suggest_latency_100k_cand_100dim_sharded", float(np.median(ts)),
+          "ms", extra)
+
+
 def main(argv=None):
     which = set(argv or sys.argv[1:])
 
@@ -212,6 +252,8 @@ def main(argv=None):
         bench_4_multistart()
     if want("5"):
         bench_5_100k_sweep()
+    if want("5s"):
+        bench_5s_100k_sweep_sharded()
 
     if not _RECORDS:
         print(f"# no benchmarks matched {sorted(which)!r} — "

@@ -206,6 +206,7 @@ class Trials:
         self._lock = threading.RLock()
         # SoA mirror cache, invalidated on refresh.
         self._soa_cache = None
+        self._best_cache = None
         if refresh:
             self.refresh()
 
@@ -280,6 +281,10 @@ class Trials:
             # _soa_cache is NOT cleared here: history() revalidates it by
             # tid-prefix comparison, keeping rebuilds incremental. DONE-trial
             # results are written exactly once, so the prefix cannot go stale.
+            # best_trial IS cleared: state flips (NEW→DONE) mutate docs in
+            # place, and refresh() is the contract's sync point after any
+            # mutation (the same assumption history() already relies on).
+            self._best_cache = None
 
     def insert_trial_doc(self, doc):
         return self.insert_trial_docs([doc])[0]
@@ -308,6 +313,7 @@ class Trials:
             self._ids = set()
             self.attachments = {}
             self._soa_cache = None
+            self._best_cache = None
 
     # -- state bookkeeping ---------------------------------------------------
 
@@ -343,6 +349,12 @@ class Trials:
 
     @property
     def best_trial(self):
+        # One scan per refresh(): fmin reads this several times per batch
+        # (progress postfix, early-stop closures, user callbacks) and the
+        # Python-dict scan is O(N) — the cache turns repeat reads into O(1).
+        cached = getattr(self, "_best_cache", None)
+        if cached is not None:
+            return cached
         candidates = [
             t for t in self._trials
             if t["state"] == JOB_STATE_DONE
@@ -351,7 +363,9 @@ class Trials:
         ]
         if not candidates:
             raise AllTrialsFailed("no successful trials with a loss yet")
-        return min(candidates, key=lambda t: t["result"]["loss"])
+        best = min(candidates, key=lambda t: t["result"]["loss"])
+        self._best_cache = best
+        return best
 
     @property
     def argmin(self):

@@ -413,6 +413,9 @@ def fmin(fn, space, algo=None, max_evals=None,
         from . import anneal, atpe, qmc, rand, tpe
         aliases = {"tpe": tpe.suggest, "tpe_quantile": tpe.suggest_quantile,
                    "tpe_sobol": partial(tpe.suggest, startup="qmc"),
+                   "tpe_mv": partial(tpe.suggest, split="quantile",
+                                     multivariate=True,
+                                     n_EI_candidates=128),
                    "rand": rand.suggest, "random": rand.suggest,
                    "qmc": qmc.suggest, "sobol": qmc.suggest,
                    "halton": qmc.suggest_halton,

@@ -301,7 +301,7 @@ class TestOverlapSuggest:
 
 class TestAlgoAliases:
     def test_string_algos(self):
-        for name in ("tpe", "rand", "anneal"):
+        for name in ("tpe", "rand", "anneal", "tpe_mv"):
             t = ht.Trials()
             ht.fmin(lambda d: d["x"] ** 2, {"x": hp.uniform("x", -2, 2)},
                     algo=name, max_evals=8, trials=t,

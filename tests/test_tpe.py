@@ -538,6 +538,35 @@ class TestQuantizedScoringEdges:
 
 
 @pytest.mark.slow
+class TestLongRun:
+    def test_thousand_trials_bucket_ladder(self):
+        # 1050 evals in one experiment: the history crosses the 32→1024
+        # bucket ladder. Pins (a) one kernel per bucket (no recompile
+        # storm), (b) the loop stays healthy end-to-end at depth, (c) the
+        # optimizer is still improving, not degenerating, late in the run.
+        space = {"x": hp.uniform("x", -3, 3), "y": hp.normal("y", 0, 2)}
+        cs = compile_space(space)
+        t = Trials()
+        algo = lambda *a, **kw: tpe.suggest(
+            *a, n_EI_candidates=16, **kw)
+        fmin(lambda d: (d["x"] - 1) ** 2 + 0.3 * d["y"] ** 2, space,
+             algo=algo, max_evals=1050, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert len(t) == 1050
+        kernels = getattr(cs, "_tpe_kernels", {})
+        caps = sorted({k[0] for k in kernels
+                       if k[1] == 16})          # this run's n_EI only
+        # buckets touched: 32..1024 (+ a possible 2048 prewarm target)
+        assert caps[0] <= 32 and 1024 <= caps[-1] <= 2048, caps
+        assert len(caps) <= 7, caps
+        best = t.best_trial["result"]["loss"]
+        assert best < 0.01, best
+        # late-phase proposals concentrate near the optimum
+        late = [d["misc"]["vals"]["x"][0] for d in list(t)[-100:]]
+        assert abs(np.median(late) - 1.0) < 0.5
+
+
+@pytest.mark.slow
 class TestConvergenceFull:
     """TPE beats random on the ENTIRE convergence zoo (reference bar:
     test_tpe.py sweeps the test_domains zoo — SURVEY.md §4)."""

@@ -54,6 +54,38 @@ def test_points_to_evaluate_run_first():
     assert out == {"x": 3.0}
 
 
+def test_points_to_evaluate_conditional_space():
+    # Seeded points over a conditional space: only the chosen branch's
+    # parameters are provided (the reference's convention) and the inactive
+    # branch's labels must get empty idxs/vals, not bogus values.
+    space = {"m": hp.choice("m", [
+        {"kind": "linear", "lr": hp.uniform("lr_lin", 0.0, 1.0)},
+        {"kind": "tree", "depth": hp.uniformint("depth", 1, 8)}])}
+
+    def fn(cfg):
+        m = cfg["m"]
+        return m["lr"] if m["kind"] == "linear" else m["depth"] * 0.1
+
+    pts = [{"m": 0, "lr_lin": 0.25}, {"m": 1, "depth": 3}]
+    # Reference semantics: an explicit trials= wins over points_to_evaluate
+    # (which only applies when fmin builds the Trials itself); the idiom
+    # for seeding an inspectable Trials is generate_trials_to_calculate.
+    t = ht.generate_trials_to_calculate(pts)
+    ht.fmin(fn, space, algo=rand.suggest, max_evals=4, trials=t,
+            rstate=0, show_progressbar=False)
+    v0, v1 = t[0]["misc"]["vals"], t[1]["misc"]["vals"]
+    # seeded docs carry the provided labels; inactive ones are absent/empty
+    assert v0["m"] == [0] and v0["lr_lin"] == [0.25]
+    assert v0.get("depth", []) == []
+    assert v1["m"] == [1] and v1["depth"] == [3]
+    assert v1.get("lr_lin", []) == []
+    assert abs(t[0]["result"]["loss"] - 0.25) < 1e-6
+    assert abs(t[1]["result"]["loss"] - 0.3) < 1e-6
+    # space_eval round-trips the seeded assignment
+    cfg = ht.space_eval(space, {"m": 0, "lr_lin": 0.25})
+    assert cfg["m"]["kind"] == "linear" and cfg["m"]["lr"] == 0.25
+
+
 def test_generate_trials_to_calculate():
     t = ht.generate_trials_to_calculate([{"x": 1.0}, {"x": 2.0}])
     assert len(t) == 2

@@ -10,6 +10,15 @@ Prints ONE JSON line to stdout: {"metric", "value", "unit", "vs_baseline"}
 (+ diagnostic extras) where ``vs_baseline = 50 ms / measured`` (>1 ⇒ beating
 the target).
 
+``value`` is the fetch-synced steady-state per-step time (k back-to-back
+dispatches + one host fetch, divided by k — see ``_measure``); ``oneshot_ms``
+is the single-call latency, which through the axon tunnel additionally pays a
+~60-90 ms per-fetch synchronous-wait overhead that locally attached TPUs do
+not have (``tunnel_sync_ms`` records the measured difference).  The round-2
+"~65 ms XLA-sort floor" mystery was exactly this tunnel sync overhead —
+``jax.block_until_ready`` is a no-op on axon, so what a blocked timer sees
+per call is whichever host-side RPC happens to sync, not device compute.
+
 Survivability (round-1 postmortem: BENCH_r01 was rc=124/parsed=null because a
 single silent hang on the TPU tunnel zeroed the whole round):
 
@@ -52,7 +61,6 @@ PHASE_DEADLINES = {
     "init": 420.0,
     "warmup_small": 600.0,
     "xla_full": 900.0,
-    "sort_ab": 900.0,
     "pallas_ab": 600.0,
     "trials_sec": 420.0,
     "cpu_ref": 300.0,
@@ -70,24 +78,54 @@ def _say(tag, payload=None):
     print(line, flush=True)
 
 
-def _measure(kern, hv, ha, hl, hok, reps=20):
+def _fetch(out):
+    """Real device sync — see ``benchmarks.fetch_sync`` for the rationale
+    (``jax.block_until_ready`` is a no-op on the axon tunnel)."""
+    from benchmarks import fetch_sync
+
+    fetch_sync(out)
+
+
+def _measure(kern, hv, ha, hl, hok, reps=20, k_steady=32):
+    """Measure one suggest-step kernel; returns ``(steady_ms, oneshot_ms)``.
+
+    * ``oneshot_ms`` — median per-call latency with a fetch-sync after every
+      call.  Through the axon tunnel this includes a ~60-90 ms synchronous
+      wait/RPC overhead per fetch that does NOT exist on locally attached
+      TPUs (a fetch of already-resident data costs <0.1 ms — the overhead is
+      the in-flight sync, not the transfer).
+    * ``steady_ms`` — ``k_steady`` back-to-back dispatches followed by ONE
+      fetch, divided by ``k_steady``: the true per-step device execution
+      time, with the per-fetch tunnel overhead amortized away.  This is the
+      headline number; on the north-star deployment (local v5e, launch+sync
+      overhead ~0.1 ms) one-shot latency ≈ this + ~0.1 ms.
+    """
     import jax
 
     key = jax.random.key(0)
     t0 = time.perf_counter()
     out = kern(key, hv, ha, hl, hok, 0.25, 1.0)   # compile + warm-up
-    jax.block_until_ready(out)
+    _fetch(out)
     _say("compiled", {"s": round(time.perf_counter() - t0, 1)})
     times = []
     for i in range(reps):
         k = jax.random.fold_in(key, i)
         t0 = time.perf_counter()
         out = kern(k, hv, ha, hl, hok, 0.25, 1.0)
-        jax.block_until_ready(out)
+        _fetch(out)
         times.append((time.perf_counter() - t0) * 1e3)
         if i % 5 == 0:
             _say("rep", {"i": i, "ms": round(times[-1], 3)})
-    return float(np.median(times))
+    oneshot = float(np.median(times))
+    t0 = time.perf_counter()
+    for i in range(k_steady):
+        out = kern(jax.random.fold_in(key, reps + i), hv, ha, hl, hok,
+                   0.25, 1.0)
+    _fetch(out)
+    steady = (time.perf_counter() - t0) * 1e3 / k_steady
+    _say("steady", {"ms": round(steady, 3), "k": k_steady,
+                    "oneshot_ms": round(oneshot, 3)})
+    return steady, oneshot
 
 
 def child():
@@ -120,63 +158,46 @@ def child():
     hv, ha = jax.device_put(hv), jax.device_put(ha)
     hl, hok = jax.device_put(hl), jax.device_put(hok)
 
-    def kernel(mode, n_cand, sort="sort"):
+    def kernel(mode, n_cand):
         os.environ["HYPEROPT_TPU_PALLAS"] = mode
-        os.environ["HYPEROPT_TPU_SORT"] = sort
         return get_kernel(cs, n_cap=n_cap, n_cand=n_cand, lf=25)
 
     # Small-shape smoke first: a tiny compile validates the whole path before
     # committing to the big one.
     _say("phase", {"name": "warmup_small"})
-    ms_small = _measure(kernel("0", 256), hv, ha, hl, hok, reps=3)
+    ms_small, _ = _measure(kernel("0", 256), hv, ha, hl, hok,
+                           reps=3, k_steady=8)
     partial["small_shape_ms"] = round(ms_small, 3)
     _say("partial", partial)
 
     # Headline, safe XLA path.  (On a CPU fallback run each rep costs
     # seconds — fewer reps keeps the whole attempt inside the deadline.)
-    reps = 20 if backend == "tpu" else 5
+    on_tpu = backend == "tpu"
+    reps, k_steady = (20, 32) if on_tpu else (5, 4)
     _say("phase", {"name": "xla_full"})
-    ms_xla = _measure(kernel("0", N_CAND), hv, ha, hl, hok, reps=reps)
+    ms_xla, ms_xla_1 = _measure(kernel("0", N_CAND), hv, ha, hl, hok,
+                                reps=reps, k_steady=k_steady)
     partial.update(value=round(ms_xla, 3),
                    vs_baseline=round(TARGET_MS / ms_xla, 3),
-                   mode="xla", xla_ms=round(ms_xla, 3))
+                   mode="xla", xla_ms=round(ms_xla, 3),
+                   oneshot_ms=round(ms_xla_1, 3),
+                   latency_methodology=(
+                       f"steady-state: {k_steady} back-to-back dispatches + "
+                       "one fetch-sync, /k (see _measure docstring); "
+                       "oneshot_ms includes the axon tunnel's per-fetch "
+                       "sync overhead, absent on local TPUs"))
+    if on_tpu:
+        # oneshot − steady ≈ the tunnel's per-fetch sync cost.  Only
+        # meaningful where dispatch is async; on the 1-core CPU fallback
+        # the difference is timing noise (and can go negative).
+        partial["tunnel_sync_ms"] = round(ms_xla_1 - ms_xla, 3)
     _say("partial", partial)
 
     fast = os.environ.get("HYPEROPT_TPU_BENCH_FAST") == "1"
-
-    # Sort-mode A/B: the sort-free pairwise rank/fit path
-    # (HYPEROPT_TPU_SORT=pairwise) vs the XLA-sort path.  Motivated by the
-    # measured ~65 ms floor of any sort-containing program on the axon
-    # tunnel; headline takes the faster mode.
-    if not fast:
-        _say("phase", {"name": "sort_ab"})
-        try:
-            ms_pw = _measure(kernel("0", N_CAND, sort="pairwise"),
-                             hv, ha, hl, hok)
-            partial["pairwise_ms"] = round(ms_pw, 3)
-            if ms_pw < partial["value"]:
-                partial.update(value=round(ms_pw, 3),
-                               vs_baseline=round(TARGET_MS / ms_pw, 3),
-                               mode="xla-pairwise")
-            _say("partial", partial)
-        except Exception as e:
-            partial["sort_ab_error"] = f"{type(e).__name__}: {e}"
-            _say("partial", partial)
-        finally:
-            os.environ["HYPEROPT_TPU_SORT"] = "sort"
-        # Record what HYPEROPT_TPU_SORT=auto resolves to on this backend
-        # (the measured probe, tpe._probe_sort_floor) so the artifact shows
-        # auto picking the faster measured mode.
-        try:
-            del os.environ["HYPEROPT_TPU_SORT"]
-            from hyperopt_tpu.tpe import _sort_mode
-
-            partial["sort_auto_choice"] = _sort_mode()
-            _say("partial", partial)
-        except Exception as e:
-            partial["sort_auto_error"] = f"{type(e).__name__}: {e}"
-        finally:
-            os.environ["HYPEROPT_TPU_SORT"] = "sort"
+    # (rounds 1-3 ran a sort_ab phase here A/B-ing a sort-free "pairwise"
+    # lowering against XLA sort; the pairwise path lost the steady-state
+    # A/B on both backends — TPU 29.0 vs 19.5 ms, CPU 3543 vs 469 ms — and
+    # was deleted.  See the historical note in hyperopt_tpu/tpe.py.)
 
     # Pallas-native A/B (TPU only, unless explicitly disabled): correctness
     # vs the XLA scorer, then latency; headline takes the faster valid mode.
@@ -187,18 +208,26 @@ def child():
             partial["pallas_allclose"] = bool(allclose)
             _say("partial", partial)
             if allclose:
-                ms_pl = _measure(kernel("1", N_CAND), hv, ha, hl, hok)
+                ms_pl, ms_pl_1 = _measure(kernel("1", N_CAND), hv, ha,
+                                          hl, hok,
+                                          reps=reps, k_steady=k_steady)
                 partial["pallas_ms"] = round(ms_pl, 3)
-                if ms_pl < ms_xla:
+                if ms_pl < partial["value"]:
+                    # Keep the headline's diagnostics internally consistent:
+                    # oneshot/tunnel_sync must describe the WINNING mode.
                     partial.update(value=round(ms_pl, 3),
                                    vs_baseline=round(TARGET_MS / ms_pl, 3),
-                                   mode="pallas")
+                                   mode="pallas",
+                                   oneshot_ms=round(ms_pl_1, 3),
+                                   tunnel_sync_ms=round(ms_pl_1 - ms_pl, 3))
             _say("partial", partial)
         except Exception as e:  # A/B is best-effort; keep the XLA headline
             partial["pallas_error"] = f"{type(e).__name__}: {e}"
             _say("partial", partial)
         finally:
-            os.environ["HYPEROPT_TPU_PALLAS"] = "0"
+            # Back to the shipped default ("auto") — the phases below must
+            # measure what users actually get, not a forced A/B mode.
+            os.environ.pop("HYPEROPT_TPU_PALLAS", None)
 
     # End-to-end trials/sec (BASELINE.md second metric): full fmin loop on a
     # 10-dim slice of the flagship space, device suggest + host objective.
@@ -207,6 +236,14 @@ def child():
     # steady state.
     _say("phase", {"name": "trials_sec"})
     try:
+        # Measure the shipped default (auto → Pallas-native on TPU) — unless
+        # this run's allclose check failed, or this is the exotic-off retry
+        # attempt (HYPEROPT_TPU_BENCH_PALLAS=0), in which case pin XLA.
+        if (partial.get("pallas_allclose") is False
+                or os.environ.get("HYPEROPT_TPU_BENCH_PALLAS") == "0"):
+            os.environ["HYPEROPT_TPU_PALLAS"] = "0"
+        else:
+            os.environ.pop("HYPEROPT_TPU_PALLAS", None)
         import hyperopt_tpu as ho
 
         cs10 = compile_space(_flagship_space(10))
@@ -439,6 +476,25 @@ def main():
     out.setdefault("unit", "ms")
     out.setdefault("value", None)
     out.setdefault("vs_baseline", None)
+    if out.get("backend") != "tpu":
+        # The tunnel was down for this run; surface the most recent COMMITTED
+        # on-chip artifact (clearly labeled as such, with its own timestamped
+        # file) so a wedged window doesn't erase recorded hardware evidence.
+        try:
+            here = os.path.dirname(os.path.abspath(__file__))
+            ref = "benchmarks/bench_tpu_20260731_steady.json"
+            with open(os.path.join(here, ref)) as f:
+                prior = json.load(f)
+            if prior.get("backend") == "tpu":
+                out["last_tpu_run"] = {
+                    "artifact": ref,
+                    "value_ms": prior.get("value"),
+                    "vs_baseline": prior.get("vs_baseline"),
+                    "mode": prior.get("mode"),
+                    "speedup_vs_cpu_ref": prior.get("speedup_vs_cpu_ref"),
+                }
+        except (OSError, ValueError):
+            pass
     out["bench_wall_s"] = round(time.time() - t0, 1)
     print(json.dumps(out), flush=True)
 

@@ -1,0 +1,18 @@
+"""Benchmark harness package.  Shared measurement helpers live here."""
+
+import numpy as np
+
+
+def fetch_sync(out):
+    """Force a REAL device sync by pulling one (tiny) output leaf to host.
+
+    ``jax.block_until_ready`` is a silent no-op on the axon-tunneled TPU
+    backend (measured 2026-07-31: a 100-matmul chain "blocked" in 0.15 ms,
+    then a 4-float fetch took the full compute time), so any timing that
+    relies on it measures dispatch, not execution.  A host fetch is the
+    only true sync point there; launches execute in order on the device
+    stream, so fetching the last output also fences everything before it.
+    """
+    import jax
+
+    np.asarray(jax.tree_util.tree_leaves(out)[0])

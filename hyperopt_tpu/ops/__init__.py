@@ -12,6 +12,5 @@ from .gmm import (  # noqa: F401
 )
 from .parzen import (  # noqa: F401
     fit_parzen,
-    fit_parzen_pairwise,
     forgetting_weights,
 )

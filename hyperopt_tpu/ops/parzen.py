@@ -100,60 +100,3 @@ def fit_parzen(x, w, n_obs, prior_mu, prior_sigma, prior_weight, out_cap):
     mus = jnp.where(valid, s, 0.0)
     sigma = jnp.where(valid, sigma, 1.0)
     return sw, mus, sigma
-
-
-def fit_parzen_pairwise(x, w, n_obs, prior_mu, prior_sigma, prior_weight):
-    """Sort-free adaptive-Parzen fit (same estimator as :func:`fit_parzen`).
-
-    Rationale: ``fit_parzen`` needs the observations' sorted order only for
-    (a) neighbor-gap bandwidths and (b) compacting live entries into
-    ``out_cap`` slots.  On backends where XLA's sort is disproportionately
-    expensive (measured on the axon TPU tunnel: any program containing a
-    sort pays a ~65 ms floor), both are replaced by O(C²) masked
-    pairwise reductions — C ≤ history capacity, so ~1M VPU compares, which
-    XLA fuses without materializing the [C, C] matrix.
-
-    Differences vs ``fit_parzen`` (documented deviations):
-    * mixtures keep the full ``C+1`` width (zero-weight padding) instead of
-      compacting to ``out_cap`` — identical distribution, more padded slots;
-    * exactly-tied observations all get the distance-to-nearest-*distinct*
-      neighbor, where the sorted version gives one tie minsigma and the
-      other the outer gap.  Tie bandwidths are clipped to the same
-      [minsigma, maxsigma] range either way; continuous columns are
-      tie-free almost surely and conformance tests pin equality there.
-    """
-    c = x.shape[0]
-    dt = jnp.float32
-    xs = jnp.concatenate([x.astype(dt), jnp.full((1,), prior_mu, dt)])
-    ws = jnp.concatenate([w.astype(dt), jnp.full((1,), prior_weight, dt)])
-    is_prior = jnp.zeros((c + 1,), bool).at[c].set(True)
-    live = jnp.isfinite(xs) & ((ws > 0) | is_prior)
-
-    # Nearest strictly-smaller / strictly-larger LIVE value per slot.
-    xi = xs[:, None]
-    xj = xs[None, :]
-    live_j = live[None, :]
-    smaller = jnp.where(live_j & (xj < xi), xj, -jnp.inf)
-    larger = jnp.where(live_j & (xj > xi), xj, jnp.inf)
-    left = xs - jnp.max(smaller, axis=1)     # +inf when no smaller (edge)
-    right = jnp.min(larger, axis=1) - xs     # +inf when no larger (edge)
-    has_left = jnp.isfinite(left)
-    has_right = jnp.isfinite(right)
-    sigma = jnp.maximum(jnp.where(has_left, left, -jnp.inf),
-                        jnp.where(has_right, right, -jnp.inf))
-    # Interior slots of an all-tied column (no distinct neighbor on either
-    # side) and the single-observation case fall back to prior_sigma/2.
-    sigma = jnp.where(jnp.isneginf(sigma), 0.5 * prior_sigma, sigma)
-    sigma = jnp.where((n_obs == 1) & ~is_prior, 0.5 * prior_sigma, sigma)
-
-    m = jnp.asarray(n_obs, jnp.int32) + 1
-    maxsigma = prior_sigma
-    minsigma = prior_sigma / jnp.minimum(100.0, 1.0 + m.astype(dt))
-    sigma = jnp.clip(sigma, minsigma, maxsigma)
-    sigma = jnp.where(is_prior, prior_sigma, sigma)
-
-    sw = jnp.where(live, ws, 0.0)
-    sw = sw / jnp.sum(sw)
-    mus = jnp.where(live, xs, 0.0)
-    sigma = jnp.where(live, sigma, 1.0)
-    return sw, mus, sigma

@@ -51,7 +51,6 @@ import numpy as np
 from . import base, rand
 from .ops import (
     fit_parzen,
-    fit_parzen_pairwise,
     forgetting_weights,
     gmm_log_qmass,
     gmm_logpdf,
@@ -84,26 +83,32 @@ _LOG_KINDS = (LOGUNIFORM, QLOGUNIFORM, LOGNORMAL, QLOGNORMAL)
 def _pallas_mode() -> str:
     """Select the density-EI execution path.
 
-    ``HYPEROPT_TPU_PALLAS``: ``0``/unset → plain XLA, ``1`` → the fused
-    Pallas kernel natively on TPU (XLA elsewhere), ``interpret`` → Pallas
-    interpreter (CPU correctness testing).
+    ``HYPEROPT_TPU_PALLAS``: unset/``auto`` → the fused Pallas kernel
+    natively on TPU, plain XLA elsewhere; ``1`` → force native on TPU;
+    ``0`` → plain XLA everywhere; ``interpret`` → Pallas interpreter
+    (CPU correctness testing).
 
-    Native is opt-in until proven: the Pallas TPU lowering had never executed
-    natively as of round 1, so the default path is the XLA scorer and
-    ``bench.py``'s ``pallas_ab`` phase A/Bs the native kernel (latency +
-    allclose) on the real chip each round — the default flips only on a
-    recorded win.
+    Native was opt-in until proven; the recorded win that flipped the
+    default (2026-07-31, TPU v5 lite, 10k cand × 50 dims, fetch-synced
+    steady state): Pallas 15.5 ms/step vs XLA 19.5 ms/step with
+    ``pallas_allclose: true`` (``benchmarks/bench_tpu_20260731_steady.json``).
+    ``bench.py``'s ``pallas_ab`` phase re-validates (latency + allclose)
+    every round, so a regression on a future backend shows up in the
+    artifact rather than silently.
     """
-    env = os.environ.get("HYPEROPT_TPU_PALLAS", "0")
+    env = os.environ.get("HYPEROPT_TPU_PALLAS", "auto").strip().lower()
     if env == "interpret":
         return "interpret"
-    if env == "1":
-        try:
-            on_tpu = jax.default_backend() == "tpu"
-        except Exception:
-            on_tpu = False
-        return "native" if on_tpu else "off"
-    return "off"
+    if env not in ("auto", "1"):
+        # "0", the empty string (`HYPEROPT_TPU_PALLAS= python ...`), and any
+        # unrecognized spelling ("off", "false", "no", a typo) all disable:
+        # an opt-out the user attempted must never silently opt in.
+        return "off"
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    return "native" if on_tpu else "off"
 
 
 def _cat_prior_default() -> str:
@@ -120,71 +125,13 @@ def _cat_prior_default() -> str:
     return env if env in ("sqrt", "const") else "sqrt"
 
 
-_sort_probe_cache: dict = {}
-
-
-def _probe_sort_floor(backend: str) -> str:
-    """Measure, once per backend per process, whether jitted XLA sorts pay
-    an anomalous latency floor (a round-2 axon-tunnel pathology: ANY
-    sort-containing program ran ~65 ms while sort-free programs ran
-    ~0.03 ms — transient, so it must be measured, never assumed).
-
-    Returns the faster rank/fit mode: ``"sort"`` when sorts behave (their
-    steady-state latency is small or comparable to a trivial sort-free
-    program), ``"pairwise"`` when the floor pathology is present.  Cost:
-    two tiny compiles + 10 sub-ms executions, paid only on the first
-    ``HYPEROPT_TPU_SORT=auto`` kernel build.
-    """
-    import time as _time
-
-    try:
-        x = jnp.arange(4096, dtype=jnp.float32)[::-1]
-        f_sort = jax.jit(jnp.sort)
-        f_free = jax.jit(lambda v: (v * 2.0 + 1.0).sum())
-        f_sort(x).block_until_ready()
-        f_free(x).block_until_ready()
-
-        def best_of(f, reps=5):
-            ts = []
-            for _ in range(reps):
-                t0 = _time.perf_counter()
-                f(x).block_until_ready()
-                ts.append(_time.perf_counter() - t0)
-            return min(ts)
-
-        t_sort, t_free = best_of(f_sort), best_of(f_free)
-        pathological = t_sort > 0.010 and t_sort > 20.0 * t_free
-        mode = "pairwise" if pathological else "sort"
-        logging.getLogger(__name__).info(
-            "sort-floor probe [%s]: sort=%.3fms free=%.3fms -> %s",
-            backend, t_sort * 1e3, t_free * 1e3, mode)
-        return mode
-    except Exception:   # probe is best-effort; sort is the safe default
-        return "sort"
-
-
-def _sort_mode() -> str:
-    """Rank/fit implementation for the suggest step.
-
-    ``HYPEROPT_TPU_SORT``: ``sort`` → XLA sort-based γ-split ranks +
-    compacted Parzen fits; ``pairwise`` → sort-free O(N²) masked-comparison
-    ranks and nearest-neighbor bandwidths (``ops.fit_parzen_pairwise``).
-    ``auto`` (default) resolves from a one-time measured probe per backend
-    (:func:`_probe_sort_floor`): the round-2 tunnel showed a transient
-    ~65 ms floor on any sort-containing program, so the choice is data,
-    not a hardcode.
-    """
-    env = os.environ.get("HYPEROPT_TPU_SORT", "auto")
-    if env in ("sort", "pairwise"):
-        return env
-    try:
-        backend = jax.default_backend()
-    except Exception:
-        return "sort"
-    mode = _sort_probe_cache.get(backend)
-    if mode is None:
-        mode = _sort_probe_cache[backend] = _probe_sort_floor(backend)
-    return mode
+# Historical note (rounds 1-3): a sort-free O(N²) "pairwise" rank/fit
+# lowering (``HYPEROPT_TPU_SORT``) existed to dodge a suspected ~65 ms
+# XLA-sort latency floor on the round-2 axon tunnel.  Round 3 proved the
+# floor was the tunnel's per-fetch sync overhead, not sort (bench.py
+# docstring), and steady-state A/Bs showed pairwise losing on both
+# backends (TPU v5 lite: 29.0 vs 19.5 ms at the 10k×50 bench shape;
+# CPU: 3543 vs 469 ms at 1k cand), so the whole path was deleted.
 
 
 # A bounded quantized column's support is a lattice of at most this many
@@ -295,10 +242,6 @@ class _TpeKernel:
         # factorized per-parameter argmax (broadcast_best).
         self.multivariate = multivariate
         self.pallas = _pallas_mode()
-        # Pairwise rank/fit is O(N²) in history capacity — a fine trade at
-        # the few-thousand-trial scale it exists for (dodging the backend
-        # sort floor), quadratic nonsense at 100k; fall back to sort there.
-        self.sort_mode = _sort_mode() if n_cap <= 8192 else "sort"
 
         cont_q, cont_n, cat = [], [], []
         for s in cs.params:
@@ -407,16 +350,7 @@ class _TpeKernel:
         n_below = jnp.minimum(n_below.astype(jnp.int32),
                               jnp.minimum(self.lf, n_ok))
         # Stable rank by (loss, index): ok trials occupy ranks [0, n_ok).
-        if self.sort_mode == "pairwise":
-            # Sort-free: rank_i = #{j : (loss_j, j) < (loss_i, i)} — an
-            # O(N²) masked compare+reduce XLA fuses on the VPU, identical
-            # to the stable double-argsort rank.
-            idx = jnp.arange(loss.shape[0])
-            lt = (loss[None, :] < loss[:, None]) | (
-                (loss[None, :] == loss[:, None]) & (idx[None, :] < idx[:, None]))
-            rank = jnp.sum(lt, axis=1)
-        else:
-            rank = jnp.argsort(jnp.argsort(loss))
+        rank = jnp.argsort(jnp.argsort(loss))
         below = ok & (rank < n_below)
         above = ok & (rank >= n_below)
         return below, above
@@ -454,12 +388,8 @@ class _TpeKernel:
         def models(set_mask, cap):
             m, w, n_set = self._set_weights(set_mask, act)
             x = jnp.where(m, z, jnp.inf)
-            if self.sort_mode == "pairwise":
-                fit = jax.vmap(fit_parzen_pairwise,
-                               in_axes=(1, 1, 0, 0, 0, None))
-            else:
-                fit = jax.vmap(partial(fit_parzen, out_cap=cap),
-                               in_axes=(1, 1, 0, 0, 0, None))
+            fit = jax.vmap(partial(fit_parzen, out_cap=cap),
+                           in_axes=(1, 1, 0, 0, 0, None))
             return fit(x, w, n_set, jnp.asarray(g.prior_mu),
                        jnp.asarray(g.prior_sigma), prior_weight)
 
@@ -749,7 +679,7 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
         cache = cs._tpe_kernels = {}
     cat_prior = cat_prior or _cat_prior_default()
     k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
-         _pallas_mode(), _sort_mode())
+         _pallas_mode())
     if k not in cache:
         cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split, multivariate,
                               cat_prior)

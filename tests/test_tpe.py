@@ -599,91 +599,36 @@ class TestConvergenceFull:
             assert best <= z.tpe_thresh * 1.5 + 1e-12, (name, best)
 
 
-class TestPairwiseSortMode:
-    """HYPEROPT_TPU_SORT=pairwise: the sort-free rank + Parzen-fit path must
-    match the sorted implementation (same estimator, different lowering)."""
+class TestPallasModeEnv:
+    """HYPEROPT_TPU_PALLAS resolution: auto/1/unset -> native only on TPU;
+    0 and any unrecognized opt-out spelling -> off.  (The sort-free
+    pairwise lowering that used to be tested here was deleted in round 3
+    after losing the steady-state A/B on both backends — see the
+    historical note above tpe._cat_prior_default.)"""
 
-    def test_fit_matches_sorted_density(self, rng):
-        from hyperopt_tpu.ops import fit_parzen, fit_parzen_pairwise
-
-        n_cap = 64
-        n_live = 17
-        x = np.full(n_cap, np.inf, np.float32)
-        x[:n_live] = rng.normal(0, 2, n_live).astype(np.float32)
-        w = np.zeros(n_cap, np.float32)
-        w[:n_live] = rng.uniform(0.2, 1.0, n_live).astype(np.float32)
-        args = (jnp.asarray(x), jnp.asarray(w), n_live,
-                jnp.float32(0.3), jnp.float32(4.0), jnp.float32(1.0))
-        ws, ms, ss = fit_parzen(*args, out_cap=n_cap + 1)
-        wp, mp, sp = fit_parzen_pairwise(*args)
-        # same mixture as a set of (mu, sigma, weight) triples (the sorted
-        # variant orders by mu; the pairwise one keeps input order)
-        live_s = np.asarray(ws) > 0
-        live_p = np.asarray(wp) > 0
-        a = sorted(zip(np.asarray(ms)[live_s].tolist(),
-                       np.asarray(ss)[live_s].tolist(),
-                       np.asarray(ws)[live_s].tolist()))
-        b = sorted(zip(np.asarray(mp)[live_p].tolist(),
-                       np.asarray(sp)[live_p].tolist(),
-                       np.asarray(wp)[live_p].tolist()))
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-5, atol=1e-6)
-
-    def test_rank_split_identical(self, rng):
-        import os
-
-        from hyperopt_tpu.space import compile_space
-        from hyperopt_tpu import hp as hp_
-        from hyperopt_tpu.tpe import _TpeKernel
-
-        cs = compile_space({"x": hp_.uniform("x", -1, 1)})
-        loss = jnp.asarray(rng.normal(0, 1, 64).astype(np.float32))
-        ok = jnp.asarray(rng.random(64) > 0.3)
-        loss = jnp.where(ok, loss, jnp.inf)
-        try:
-            os.environ["HYPEROPT_TPU_SORT"] = "sort"
-            k_sort = _TpeKernel(cs, 64, 16, 25)
-            os.environ["HYPEROPT_TPU_SORT"] = "pairwise"
-            k_pw = _TpeKernel(cs, 64, 16, 25)
-        finally:
-            os.environ.pop("HYPEROPT_TPU_SORT", None)
-        b1, a1 = k_sort._split(loss, ok, jnp.float32(0.25))
-        b2, a2 = k_pw._split(loss, ok, jnp.float32(0.25))
-        assert np.array_equal(np.asarray(b1), np.asarray(b2))
-        assert np.array_equal(np.asarray(a1), np.asarray(a2))
-
-    @pytest.mark.slow
-    def test_pairwise_mode_converges(self, monkeypatch):
-        monkeypatch.setenv("HYPEROPT_TPU_SORT", "pairwise")
-        t = _run("quadratic1", tpe.suggest, 0)
-        assert t.best_trial["result"]["loss"] < 0.1
-
-    def test_auto_resolves_from_measured_probe(self, monkeypatch):
-        # auto must (a) run the real probe once and cache per backend,
-        # (b) pick "sort" on a healthy backend (this CPU), and (c) honor a
-        # probe that reported the sort-floor pathology.
+    @pytest.mark.parametrize("val,expect_cpu", [
+        (None, "off"), ("auto", "off"), ("1", "off"),   # auto gates on TPU
+        ("0", "off"), ("off", "off"), ("false", "off"), ("typo", "off"),
+        ("interpret", "interpret"),
+    ])
+    def test_resolution_on_cpu(self, monkeypatch, val, expect_cpu):
         from hyperopt_tpu import tpe as tpe_mod
 
-        monkeypatch.delenv("HYPEROPT_TPU_SORT", raising=False)
-        monkeypatch.setattr(tpe_mod, "_sort_probe_cache", {})
-        calls = []
-        real_probe = tpe_mod._probe_sort_floor
+        if val is None:
+            monkeypatch.delenv("HYPEROPT_TPU_PALLAS", raising=False)
+        else:
+            monkeypatch.setenv("HYPEROPT_TPU_PALLAS", val)
+        assert tpe_mod._pallas_mode() == expect_cpu
 
-        def counting_probe(backend):
-            calls.append(backend)
-            return real_probe(backend)
+    def test_opt_out_never_opts_in(self, monkeypatch):
+        # Even if the backend were TPU, every non-auto spelling must
+        # resolve off: simulate by asserting the gate only passes for the
+        # auto set.
+        from hyperopt_tpu import tpe as tpe_mod
 
-        monkeypatch.setattr(tpe_mod, "_probe_sort_floor", counting_probe)
-        assert tpe_mod._sort_mode() == "sort"     # healthy CPU backend
-        assert tpe_mod._sort_mode() == "sort"
-        assert len(calls) == 1                    # probed once, then cached
-        # pathological backend (simulated): auto flips to pairwise
-        monkeypatch.setattr(tpe_mod, "_sort_probe_cache",
-                            {"cpu": "pairwise"})
-        assert tpe_mod._sort_mode() == "pairwise"
-        # explicit env always wins over the probe
-        monkeypatch.setenv("HYPEROPT_TPU_SORT", "sort")
-        assert tpe_mod._sort_mode() == "sort"
+        for val in ("0", "off", "no", "disable", "NONE"):
+            monkeypatch.setenv("HYPEROPT_TPU_PALLAS", val)
+            assert tpe_mod._pallas_mode() == "off", val
 
 
 class TestChunkedScoring:

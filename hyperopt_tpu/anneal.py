@@ -139,7 +139,7 @@ def suggest(new_ids, domain, trials, seed,
     shrink = 1.0 / (1.0 + t_obs * shrink_coef)
 
     key = jax.random.key(int(seed) % (2 ** 32))
-    rows, acts = [], []
+    rows = []
     for i in range(n):
         gi = min(int(rng.geometric(1.0 / avg_best_idx)) - 1, n_ok - 1)
         inc = order[gi]
@@ -147,9 +147,8 @@ def suggest(new_ids, domain, trials, seed,
                     jnp.asarray(h["vals"][inc]),
                     jnp.asarray(h["active"][inc]),
                     jnp.asarray(shrink))
-        vals = np.asarray(vals)
-        rows.append(vals)
-        acts.append(np.asarray(cs.active_mask(vals[None, :])[0]))
-    return base.docs_from_samples(cs, new_ids, np.stack(rows),
-                                  np.stack(acts),
+        rows.append(np.asarray(vals))
+    rows = np.stack(rows)
+    return base.docs_from_samples(cs, new_ids, rows,
+                                  cs.active_mask_host(rows),
                                   exp_key=getattr(trials, "exp_key", None))

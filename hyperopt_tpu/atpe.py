@@ -175,8 +175,7 @@ def _apply_lockout(cs, rows, acts, trials, h, frac, rng):
     rows = np.array(rows, copy=True)
     for _, pid, v in lockable[:n_lock]:
         rows[:, pid] = v
-    acts = np.asarray(cs.active_mask(rows))
-    return rows, acts
+    return rows, cs.active_mask_host(rows)
 
 
 def _fingerprint(cs) -> str:

@@ -128,18 +128,19 @@ def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
                                mesh, split)
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
     seed32 = int(seed) % (2 ** 32)
-    rows, acts = [], []
+    rows = []
     with mesh:
         for i in range(len(new_ids)):
             # Seeded entry: key construction is compiled into the sharded
             # program (one jit dispatch per proposal, no un-jitted
             # random_seed/fold_in primitives on the host).
-            r, a = kern.suggest_seeded((seed32 + i) % (2 ** 32), hv, ha,
+            r, _ = kern.suggest_seeded((seed32 + i) % (2 ** 32), hv, ha,
                                        hl, hok, gamma, prior_weight)
             rows.append(np.asarray(r))
-            acts.append(np.asarray(a))
-    return base.docs_from_samples(cs, new_ids, np.stack(rows),
-                                  np.stack(acts),
+    # One fetch per proposal (values only); masks rebuilt on host.
+    rows = np.stack(rows)
+    return base.docs_from_samples(cs, new_ids, rows,
+                                  cs.active_mask_host(rows),
                                   exp_key=getattr(trials, "exp_key", None))
 
 
@@ -150,19 +151,36 @@ def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
 
 def _multi_start_fn(kern, mesh):
     """Build the shard_mapped K-start suggest step (cached per kernel;
-    shape-polymorphic in the number of starts via jit retracing)."""
+    shape-polymorphic in the number of starts via jit retracing).
 
-    def one_host(keys, vals, active, loss, ok, gamma, prior_weight):
-        # keys: [local] — this device's share of the K starts.
+    Each start gets its OWN γ (``gammas`` is sharded like ``keys``): K
+    EI-argmax draws against one posterior at a single γ collapse onto the
+    same EI peak (the batch-collapse defect tpe._liar_scan fixes
+    sequentially), but the sequential liar would serialize the mesh.  A
+    per-start γ spread diversifies in parallel instead — different
+    below/above splits give genuinely different posteriors, so the K
+    argmax winners spread while every start still exploits the history."""
+
+    def one_host(keys, gammas, vals, active, loss, ok, prior_weight):
+        # keys/gammas: [local] — this device's share of the K starts.
         return jax.vmap(
-            lambda k: kern._suggest_one(k, vals, active, loss, ok,
-                                        gamma, prior_weight))(keys)
+            lambda k, g: kern._suggest_one(k, vals, active, loss, ok,
+                                           g, prior_weight))(keys, gammas)
 
     return jax.jit(jax.shard_map(
         one_host, mesh=mesh,
-        in_specs=(P(START_AXIS), P(), P(), P(), P(), P(), P()),
+        in_specs=(P(START_AXIS), P(START_AXIS), P(), P(), P(), P(), P()),
         out_specs=P(START_AXIS),
         check_vma=False))
+
+
+def _gamma_spread(gamma, n_starts):
+    """Per-start γ ladder: ``γ·2**linspace(-1, 1, K)`` clipped to a sane
+    split range; K=1 degenerates to the base γ."""
+    if n_starts == 1:
+        return np.asarray([gamma], np.float32)
+    return np.clip(gamma * np.exp2(np.linspace(-1.0, 1.0, n_starts)),
+                   0.05, 0.75).astype(np.float32)
 
 
 def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
@@ -173,8 +191,10 @@ def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
                         linear_forgetting=_default_linear_forgetting,
                         split="sqrt"):
     """``algo=`` callable proposing ``len(new_ids)`` configs in ONE device
-    program: each new trial gets an independent TPE posterior draw (its own
-    RNG stream), laid out one-per-mesh-slot along the ``dp`` axis.
+    program: each new trial gets its own RNG stream AND its own γ from a
+    ``2**linspace(-1,1,K)`` ladder (see ``_gamma_spread``) — the
+    mesh-parallel answer to batch collapse, laid out one-per-mesh-slot
+    along the ``dp`` axis.
 
     Use with ``fmin(..., max_queue_len=K)`` (or an async Trials backend) to
     evaluate K proposals in parallel — BASELINE.md config 4.
@@ -203,9 +223,9 @@ def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
     keys = jax.random.split(jax.random.key(int(seed) % (2 ** 32)), n_starts)
     with mesh:
-        rows, acts = fn(keys, hv, ha, hl, hok, np.float32(gamma),
-                        np.float32(prior_weight))
+        rows, _ = fn(keys, _gamma_spread(gamma, n_starts), hv, ha, hl, hok,
+                     np.float32(prior_weight))
     rows = np.asarray(rows)[:n]
-    acts = np.asarray(acts)[:n]
-    return base.docs_from_samples(cs, new_ids, rows, acts,
+    return base.docs_from_samples(cs, new_ids, rows,
+                                  cs.active_mask_host(rows),
                                   exp_key=getattr(trials, "exp_key", None))

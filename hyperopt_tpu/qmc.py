@@ -137,8 +137,7 @@ def suggest_batch(new_ids, domain, trials, seed, engine="sobol"):
     vals = np.zeros((n, cs.n_params), np.float32)
     for j, spec in enumerate(cs.params):
         vals[:, j] = _transform_column(spec, u[:, j])
-    active = np.asarray(cs.active_mask(vals))
-    return vals, active
+    return vals, cs.active_mask_host(vals)
 
 
 def suggest(new_ids, domain, trials, seed, engine="sobol"):

@@ -22,9 +22,12 @@ def suggest(new_ids, domain, trials, seed):
     if n == 0:
         return []
     key = jax.random.key(int(seed) % (2 ** 32))
-    vals, active = domain.cs.sample(key, n)
+    vals, _ = domain.cs.sample(key, n)
+    # Fetch only the values (one device sync); the mask is a pure host
+    # function of them (space.py::active_mask_host).
+    vals = np.asarray(vals)
     return base.docs_from_samples(domain.cs, new_ids,
-                                  np.asarray(vals), np.asarray(active),
+                                  vals, domain.cs.active_mask_host(vals),
                                   exp_key=getattr(trials, "exp_key", None))
 
 

@@ -295,6 +295,49 @@ def test_max_queue_len_batched_suggest():
     assert len(trials) == 12
 
 
+def test_max_queue_len_batched_tpe():
+    """TPE under max_queue_len>1 crosses startup into the constant-liar
+    batch program: one device dispatch + one fetch per batch (the bench's
+    trials_per_sec_q8 path).
+
+    Regression pin for the batch-collapse bug: K independent EI-argmax
+    draws from one posterior all landed within <1.0 of each other at the
+    EI peak (a wasted batch); the liar's fantasy refits must spread each
+    batch across the space while still converging overall."""
+    from functools import partial
+
+    trials = ht.Trials()
+    algo = partial(ht.tpe.suggest, n_startup_jobs=8, n_EI_candidates=32)
+    best = ht.fmin(q1, SPACE1, algo=algo, max_evals=32, max_queue_len=8,
+                   trials=trials, rstate=np.random.default_rng(0),
+                   show_progressbar=False)
+    assert len(trials) == 32
+    xs_all = [d["misc"]["vals"]["x"][0] for d in trials.trials]
+    assert len(set(xs_all[24:32])) == 8          # distinct within a batch
+    # Anti-collapse: every post-startup batch spans a real fraction of the
+    # [-5, 5] domain (the collapsed batches spanned <1.0).
+    for lo in (8, 16, 24):
+        batch = xs_all[lo:lo + 8]
+        assert max(batch) - min(batch) > 2.0
+    # Convergence smoke: the batched run still finds the optimum region.
+    assert q1(best) < 1.0
+
+
+def test_max_queue_len_partial_final_batch():
+    """max_evals not a multiple of max_queue_len: the final partial batch
+    reuses the compiled full-batch program (rounded up + sliced) and the
+    run completes with exactly max_evals trials."""
+    from functools import partial
+
+    trials = ht.Trials()
+    algo = partial(ht.tpe.suggest, n_startup_jobs=8, n_EI_candidates=32)
+    ht.fmin(q1, SPACE1, algo=algo, max_evals=30, max_queue_len=8,
+            trials=trials, rstate=np.random.default_rng(0),
+            show_progressbar=False)
+    assert len(trials) == 30
+    assert all(len(d["misc"]["vals"]["x"]) == 1 for d in trials.trials)
+
+
 class TestOverlapSuggest:
     """PP-analog overlap: the next suggest is pre-dispatched on device while
     the host evaluates (fmin(overlap_suggest=True))."""

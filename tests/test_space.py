@@ -178,6 +178,21 @@ def test_nested_choice_mask_conjunction():
     assert np.array_equal(a[:, px], expect)
 
 
+def test_active_mask_host_matches_device():
+    """The host-numpy mask (fetch-saving path: suggest fetches only the
+    values array and rebuilds the mask) must be bit-identical to the
+    device mask, nested conditionals included."""
+    cs, v, a = _sample({
+        "c": hp.choice("c", [
+            {"d": hp.choice("d", [{"x": hp.uniform("x", 0, 1)}, "leaf"]),
+             "y": hp.normal("y", 0, 1)},
+            "other",
+        ]),
+        "u": hp.uniform("u", -1, 1),
+    }, n=256)
+    assert np.array_equal(cs.active_mask_host(v), a)
+
+
 # -- decode / eval_point -----------------------------------------------------
 
 

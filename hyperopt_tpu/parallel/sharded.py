@@ -33,6 +33,7 @@ from .. import base
 from ..space import CompiledSpace
 from ..tpe import (
     _TpeKernel,
+    _batch_size_for,
     _bucket,
     _default_gamma,
     _default_linear_forgetting,
@@ -123,22 +124,31 @@ def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
     h = trials.history(cs)
     if int(h["ok"].sum()) < n_startup_jobs or cs.n_params == 0:
         return rand.suggest(new_ids, domain, trials, seed)
-    kern = _get_sharded_kernel(cs, _bucket(h["vals"].shape[0]),
+    n = len(new_ids)
+    n_rows = h["vals"].shape[0]
+    # Batched proposals run the inherited constant-liar scan (the sharding
+    # constraints live inside _suggest_one, so each scan step's EI sweep
+    # is still mesh-sharded): one dispatch + one fetch for all n, with n
+    # rows of bucket slack for the fantasy cursor.
+    kern = _get_sharded_kernel(cs, _bucket(n_rows + (n if n > 1 else 0)),
                                int(n_EI_candidates), int(linear_forgetting),
                                mesh, split)
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
     seed32 = int(seed) % (2 ** 32)
-    rows = []
     with mesh:
-        for i in range(len(new_ids)):
+        if n == 1:
             # Seeded entry: key construction is compiled into the sharded
-            # program (one jit dispatch per proposal, no un-jitted
-            # random_seed/fold_in primitives on the host).
-            r, _ = kern.suggest_seeded((seed32 + i) % (2 ** 32), hv, ha,
-                                       hl, hok, gamma, prior_weight)
-            rows.append(np.asarray(r))
-    # One fetch per proposal (values only); masks rebuilt on host.
-    rows = np.stack(rows)
+            # program (one jit dispatch, no un-jitted random_seed/fold_in
+            # primitives on the host).
+            r, _ = kern.suggest_seeded(seed32, hv, ha, hl, hok,
+                                       gamma, prior_weight)
+            rows = np.asarray(r)[None, :]
+        else:
+            m = _batch_size_for(kern, n, n_rows)
+            r, _ = kern.suggest_many_seeded(seed32, m, n_rows, hv, ha,
+                                            hl, hok, gamma, prior_weight)
+            rows = np.asarray(r)[:n]
+    # Values only (one fetch); masks rebuilt on host.
     return base.docs_from_samples(cs, new_ids, rows,
                                   cs.active_mask_host(rows),
                                   exp_key=getattr(trials, "exp_key", None))

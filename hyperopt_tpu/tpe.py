@@ -748,6 +748,24 @@ def _padded_history(h, n_cap):
 # ---------------------------------------------------------------------------
 
 
+def _batch_size_for(kern, n, n_rows):
+    """Round a partial batch up to an already-compiled liar-scan size.
+
+    A final partial batch (``max_evals % max_queue_len != 0``) would
+    trace+compile a one-shot n-proposal program; instead reuse a compiled
+    larger size and let the caller slice the surplus rows off (the scan
+    is sequential, so the first n proposals are unaffected by surplus
+    steps).  The bucket-slack guard keeps the fantasy cursor in bounds.
+    Shared by :func:`suggest_dispatch` and ``parallel.sharded_suggest``.
+    """
+    if ("seeded", n) in kern._batch_fns:
+        return n
+    compiled = sorted(k[1] for k in kern._batch_fns
+                      if isinstance(k, tuple) and k[0] == "seeded"
+                      and k[1] > n and n_rows + k[1] <= kern.n_cap)
+    return compiled[0] if compiled else n
+
+
 def _startup_batch(startup, new_ids, domain, trials, seed):
     """Resolve the warm-start sampler: None/'rand' → pseudo-random
     (reference behavior), 'qmc'/'sobol'/'halton' → low-discrepancy
@@ -885,20 +903,7 @@ def suggest_dispatch(new_ids, domain, trials, seed,
         arrs = kern.suggest_seeded(seed32, hv, ha, hl, hok,
                                    gamma, prior_weight)
     else:
-        # A final partial batch (max_evals % max_queue_len != 0) would
-        # trace+compile a one-shot n-proposal program; instead round n up
-        # to an already-compiled batch size and slice the extra proposals
-        # off at materialize (the scan is sequential, so the first n rows
-        # are unaffected by the surplus steps; the bucket-slack guard
-        # keeps the fantasy cursor in bounds).
-        m = n
-        if ("seeded", n) not in kern._batch_fns:
-            compiled = sorted(
-                k[1] for k in kern._batch_fns
-                if isinstance(k, tuple) and k[0] == "seeded"
-                and k[1] > n and n_rows + k[1] <= kern.n_cap)
-            if compiled:
-                m = compiled[0]
+        m = _batch_size_for(kern, n, n_rows)
         arrs = kern.suggest_many_seeded(seed32, m, n_rows, hv, ha, hl, hok,
                                         gamma, prior_weight)
     return ("pending", cs, list(new_ids), arrs, exp_key)

@@ -53,6 +53,25 @@ class TestShardedSuggest:
              show_progressbar=False)
         assert t.best_trial["result"]["loss"] < ZOO["quadratic1"].rand_thresh
 
+    def test_batched_sharded_suggest(self):
+        """max_queue_len>1 over the sharded kernel runs the inherited
+        constant-liar scan (one dispatch + one fetch for the batch) and
+        the proposals stay distinct."""
+        mesh = default_mesh(n_starts=1)
+        from functools import partial
+        t = Trials()
+        fmin(_quad, _quad_space(),
+             algo=partial(sharded_suggest, mesh=mesh, n_EI_candidates=512,
+                          n_startup_jobs=8),
+             max_evals=24, max_queue_len=8, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert len(t) == 24
+        xs = [d["misc"]["vals"]["x"][0] for d in t.trials[16:24]]
+        assert len(set(xs)) == 8
+        # Anti-collapse: K independent EI-argmax draws cluster within <1.0
+        # of one EI peak; the liar's fantasy refits must spread the batch.
+        assert max(xs) - min(xs) > 2.0
+
     def test_rejects_indivisible_candidates(self):
         mesh = default_mesh(n_starts=1)
         from functools import partial

@@ -112,9 +112,14 @@ def _get_kernel(cs):
 
         return out
 
-    fn = jax.jit(sample_one)
-    cs._anneal_kernel = fn
-    return fn
+    # (single, batched): the batched entry vmaps over (key, incumbent) so
+    # n proposals cost ONE dispatch + ONE fetch — anneal *samples* shrunk
+    # neighborhoods (no shared-argmax collapse, unlike TPE), so a plain
+    # vmap is the right batching.
+    fns = (jax.jit(sample_one),
+           jax.jit(jax.vmap(sample_one, in_axes=(0, 0, 0, None))))
+    cs._anneal_kernel = fns
+    return fns
 
 
 def suggest(new_ids, domain, trials, seed,
@@ -131,7 +136,7 @@ def suggest(new_ids, domain, trials, seed,
         return rand.suggest(new_ids, domain, trials, seed)
 
     rng = np.random.default_rng(int(seed) % (2 ** 32))
-    kern = _get_kernel(cs)
+    kern_one, kern_batch = _get_kernel(cs)
     ok_rows = np.nonzero(h["ok"])[0]
     order = ok_rows[np.argsort(h["loss"][ok_rows], kind="stable")]
     # Per-parameter observation counts drive the shrink schedule.
@@ -139,16 +144,22 @@ def suggest(new_ids, domain, trials, seed,
     shrink = 1.0 / (1.0 + t_obs * shrink_coef)
 
     key = jax.random.key(int(seed) % (2 ** 32))
-    rows = []
-    for i in range(n):
-        gi = min(int(rng.geometric(1.0 / avg_best_idx)) - 1, n_ok - 1)
-        inc = order[gi]
-        vals = kern(jax.random.fold_in(key, i),
-                    jnp.asarray(h["vals"][inc]),
-                    jnp.asarray(h["active"][inc]),
-                    jnp.asarray(shrink))
-        rows.append(np.asarray(vals))
-    rows = np.stack(rows)
+    # Incumbent picks (geometric over the loss ranking) are host-side;
+    # the neighborhood draws batch into one device program + one fetch.
+    gis = np.minimum(rng.geometric(1.0 / avg_best_idx, size=n) - 1,
+                     n_ok - 1)
+    incs = order[gis]
+    if n == 1:
+        vals = kern_one(key, jnp.asarray(h["vals"][incs[0]]),
+                        jnp.asarray(h["active"][incs[0]]),
+                        jnp.asarray(shrink))
+        rows = np.asarray(vals)[None, :]
+    else:
+        vals = kern_batch(jax.random.split(key, n),
+                          jnp.asarray(h["vals"][incs]),
+                          jnp.asarray(h["active"][incs]),
+                          jnp.asarray(shrink))
+        rows = np.asarray(vals)
     return base.docs_from_samples(cs, new_ids, rows,
                                   cs.active_mask_host(rows),
                                   exp_key=getattr(trials, "exp_key", None))

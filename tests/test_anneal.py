@@ -49,6 +49,20 @@ class TestAnneal:
         assert len(t) == 30
         assert t.best_trial["result"]["loss"] is not None
 
+    def test_batched_suggest(self):
+        """max_queue_len>1 runs the vmapped neighborhood sampler: one
+        device dispatch + one fetch per batch, distinct proposals, and
+        the run still converges."""
+        z = ZOO["quadratic1"]
+        t = Trials()
+        fmin(z.fn, z.space, algo=anneal.suggest, max_evals=40,
+             max_queue_len=4, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert len(t) == 40
+        xs = [d["misc"]["vals"]["x"][0] for d in t.trials[-4:]]
+        assert len(set(xs)) == 4
+        assert t.best_trial["result"]["loss"] < z.rand_thresh
+
 
 class TestMix:
     def test_routes_between_algos(self):

@@ -181,6 +181,18 @@ def docs_from_samples(cs: CompiledSpace, new_ids, vals, active,
     return docs
 
 
+def _parse_doc_row(tvals, cs, vals, active, i):
+    """Fill row ``i`` of dense ``vals``/``active`` from one trial doc's
+    ``misc.vals`` (the single value-encoding convention — shared by
+    ``Trials.history`` and ``Trials.inflight`` so the two dense views
+    cannot diverge)."""
+    for spec in cs.params:
+        v = tvals.get(spec.label, [])
+        if len(v):
+            vals[i, spec.pid] = v[0]
+            active[i, spec.pid] = True
+
+
 # ---------------------------------------------------------------------------
 # Trials
 # ---------------------------------------------------------------------------
@@ -452,16 +464,34 @@ class Trials:
                         and np.isfinite(r["loss"]):
                     loss[i] = r["loss"]
                     ok[i] = True
-                tvals = t["misc"]["vals"]
-                for spec in cs.params:
-                    v = tvals.get(spec.label, [])
-                    if len(v):
-                        vals[i, spec.pid] = v[0]
-                        active[i, spec.pid] = True
+                _parse_doc_row(t["misc"]["vals"], cs, vals, active, i)
             out = dict(vals=vals, active=active, loss=loss, ok=ok,
                        tids=new_tids)
             self._soa_cache = (cs, out)
             return out
+
+    def inflight(self, cs: CompiledSpace):
+        """Dense ``(vals f32[M, P], active bool[M, P])`` of NEW/RUNNING
+        trials — the points currently being (or about to be) evaluated.
+
+        ``tpe.suggest_dispatch`` injects these as constant-liar fantasy
+        rows so concurrent suggests (overlapped batches, pool workers,
+        file-store workers) repel proposals from points already in
+        flight instead of duplicating them — a gap the reference's
+        parallel backends share (suggest there conditions on completed
+        trials only).  In-flight sets are small; no caching.
+        """
+        with self._lock:
+            # _trials, not _dynamic_trials: the exp_key-filtered view —
+            # other experiments' in-flight work must not repel this one.
+            live = [t for t in self._trials
+                    if t["state"] in (JOB_STATE_NEW, JOB_STATE_RUNNING)]
+            m, p = len(live), cs.n_params
+            vals = np.zeros((m, p), dtype=np.float32)
+            active = np.zeros((m, p), dtype=bool)
+            for i, t in enumerate(live):
+                _parse_doc_row(t["misc"]["vals"], cs, vals, active, i)
+            return vals, active
 
     # -- convenience --------------------------------------------------------
 

@@ -124,12 +124,13 @@ class FMinIter:
         # PP-analog overlap (SURVEY.md §2 parallelism table): pre-dispatch
         # the NEXT suggest on device before evaluating on host, hiding
         # suggest latency behind the objective.  Needs a dispatch-capable
-        # algo (tpe.suggest / suggest_quantile), a synchronous backend and a
-        # serial queue; the pre-dispatched posterior is one result stale —
-        # the standard async-optimizer tradeoff.
+        # algo (tpe.suggest / suggest_quantile) and a synchronous backend;
+        # the pre-dispatched posterior is one batch stale — the standard
+        # async-optimizer tradeoff.  With max_queue_len=K the next K-batch
+        # (one liar-scan program) hides behind the K host evaluations.
         self._pending_suggest = None
         self._dispatch = self._materialize = None
-        if overlap_suggest and not self.asynchronous and max_queue_len == 1:
+        if overlap_suggest and not self.asynchronous:
             fn, kw = algo, {}
             if isinstance(algo, partial) and not algo.args:
                 fn = algo.func
@@ -222,8 +223,12 @@ class FMinIter:
             with self.tracer.span("suggest"):
                 if self._pending_suggest is not None:
                     # Dispatched during the previous batch's evaluation —
-                    # the device has (usually) already finished.
-                    new_trials = self._materialize(self._pending_suggest)
+                    # the device has (usually) already finished.  Clamp to
+                    # the CURRENT allowance: a pending K-batch that
+                    # outlived a stop condition (then run(N) resumed with
+                    # a smaller budget) must not overshoot max_evals.
+                    new_trials = self._materialize(
+                        self._pending_suggest)[:n_to_enqueue]
                     self._pending_suggest = None
                 else:
                     seed = int(self.rstate.integers(2 ** 31 - 1))
@@ -236,11 +241,12 @@ class FMinIter:
                 trials.insert_trial_docs(new_trials)
                 trials.refresh()
                 if self.overlap_suggest and remaining > n_to_enqueue:
-                    # Pre-dispatch the NEXT suggest before evaluating: it
+                    # Pre-dispatch the NEXT batch before evaluating: it
                     # conditions on history up to the previous batch and
                     # computes on device while the host runs the objective.
                     seed = int(self.rstate.integers(2 ** 31 - 1))
-                    ids = trials.new_trial_ids(1)
+                    ids = trials.new_trial_ids(
+                        min(self.max_queue_len, remaining - n_to_enqueue))
                     self._pending_suggest = self._dispatch(
                         ids, self.domain, trials, seed)
 

@@ -881,6 +881,25 @@ def suggest_dispatch(new_ids, domain, trials, seed,
             a = cs.active_mask_host(v)
         return ("ready", cs, list(new_ids),
                 (np.asarray(v), np.asarray(a)), exp_key)
+    # Constant-liar treatment of CONCURRENT work: trials currently NEW/
+    # RUNNING (an overlapped pre-dispatched batch, pool workers, file-store
+    # workers) enter the history as fantasy rows at the mean observed loss,
+    # so this suggest repels its proposals from points already in flight
+    # instead of re-proposing them.  Applied only past startup — a
+    # pure-fantasy posterior (zero real observations) would model noise.
+    infl = getattr(trials, "inflight", None)
+    if infl is not None:
+        pv, pa = infl(cs)
+        if len(pv):
+            okl = h["loss"][h["ok"]]
+            lie = np.float32(okl.mean()) if okl.size else np.float32(0.0)
+            h = dict(
+                vals=np.concatenate([h["vals"], pv]),
+                active=np.concatenate([h["active"], pa]),
+                loss=np.concatenate(
+                    [h["loss"], np.full(len(pv), lie, np.float32)]),
+                ok=np.concatenate([h["ok"], np.ones(len(pv), bool)]))
+
     n_rows = h["vals"].shape[0]
     # Batched proposals insert n constant-liar fantasy rows (see
     # _liar_scan), so the bucket needs n rows of padding slack.

@@ -187,6 +187,83 @@ def test_history_soa():
     assert t.history(cs)["vals"].shape[0] == 3
 
 
+def test_inflight_rows():
+    """`Trials.inflight` exposes NEW/RUNNING trials as dense rows (the
+    fantasy source for concurrent-suggest repulsion); DONE trials are
+    excluded and conditional blanks parse as inactive."""
+    space = {"c": hp.choice("c", [{"x": hp.uniform("x", 0, 1)},
+                                  {"y": hp.uniform("y", 0, 1)}])}
+    cs = ht.compile_space(space)
+    t = ht.Trials()
+    d0 = base.new_trial_doc(0)                      # DONE: excluded
+    d0["misc"]["idxs"] = {"c": [0], "x": [0], "y": []}
+    d0["misc"]["vals"] = {"c": [0], "x": [0.25], "y": []}
+    d0["result"] = {"loss": 0.5, "status": ht.STATUS_OK}
+    d0["state"] = base.JOB_STATE_DONE
+    d1 = base.new_trial_doc(1)                      # NEW: in flight
+    d1["misc"]["idxs"] = {"c": [1], "x": [], "y": [1]}
+    d1["misc"]["vals"] = {"c": [1], "x": [], "y": [0.75]}
+    d1["state"] = base.JOB_STATE_NEW
+    d2 = base.new_trial_doc(2)                      # RUNNING: in flight
+    d2["misc"]["idxs"] = {"c": [2], "x": [2], "y": []}
+    d2["misc"]["vals"] = {"c": [0], "x": [0.5], "y": []}
+    d2["state"] = base.JOB_STATE_RUNNING
+    t.insert_trial_docs([d0, d1, d2])
+    t.refresh()
+    pv, pa = t.inflight(cs)
+    px, py = cs.by_label["x"].pid, cs.by_label["y"].pid
+    assert pv.shape == (2, 3)
+    assert pa[0, py] and not pa[0, px]
+    assert pv[0, py] == np.float32(0.75)
+    assert pa[1, px] and not pa[1, py]
+
+
+def test_suggest_repels_inflight_points():
+    """A suggest issued while another proposal is in flight must not
+    re-propose the same point: the in-flight row enters the posterior as
+    a fantasy at the mean loss, pushing EI elsewhere (deterministic
+    under a fixed seed)."""
+    from functools import partial
+
+    space = {"x": hp.uniform("x", -5, 5)}
+    cs = ht.compile_space(space)
+
+    def hist(n=24):
+        t = ht.Trials()
+        ids = t.new_trial_ids(n)
+        rng = np.random.default_rng(0)
+        docs = []
+        for tid in ids:
+            x = float(rng.uniform(-5, 5))
+            d = base.new_trial_doc(tid)
+            d["misc"]["idxs"] = {"x": [tid]}
+            d["misc"]["vals"] = {"x": [x]}
+            d["result"] = {"loss": (x - 3.0) ** 2, "status": ht.STATUS_OK}
+            d["state"] = base.JOB_STATE_DONE
+            docs.append(d)
+        t.insert_trial_docs(docs)
+        t.refresh()
+        return t
+
+    dom = base.Domain(lambda d: d["x"], space)
+    algo = partial(ht.tpe.suggest, n_startup_jobs=8, n_EI_candidates=64)
+    # Baseline proposal (no in-flight work).
+    t1 = hist()
+    [doc_a] = algo(t1.new_trial_ids(1), dom, t1, 7)
+    xa = doc_a["misc"]["vals"]["x"][0]
+    # Same history + the baseline proposal left in flight (NEW).
+    t2 = hist()
+    [d] = algo(t2.new_trial_ids(1), dom, t2, 7)
+    t2.insert_trial_docs([d])
+    t2.refresh()
+    [doc_b] = algo(t2.new_trial_ids(1), dom, t2, 7)
+    xb = doc_b["misc"]["vals"]["x"][0]
+    # Identical seed, identical real history — only the fantasy differs;
+    # the second proposal must move off the in-flight point.
+    assert xb != xa
+    assert abs(xb - xa) > 1e-3
+
+
 def test_domain_evaluate_normalization():
     d = ht.Domain(lambda cfg: cfg["x"] * 2, {"x": hp.uniform("x", 0, 1)})
     out = d.evaluate({"x": 0.5}, None)

@@ -364,6 +364,23 @@ class TestOverlapSuggest:
         assert len(t) == 40
         assert t.best_trial["result"]["loss"] < 0.5
 
+    def test_overlap_batched(self):
+        """Overlap composes with max_queue_len>1: the next K-batch (one
+        liar-scan dispatch) computes while the host evaluates the current
+        K trials; counts, states, and tids all stay exact — including a
+        partial final batch."""
+        t = ht.Trials()
+        algo = ht.partial(ht.tpe.suggest, n_startup_jobs=8,
+                          n_EI_candidates=32)
+        ht.fmin(lambda d: (d["x"] - 3.0) ** 2,
+                {"x": hp.uniform("x", -5, 5)},
+                algo=algo, max_evals=36, max_queue_len=8, trials=t,
+                rstate=np.random.default_rng(0),
+                show_progressbar=False, overlap_suggest=True)
+        assert len(t) == 36
+        assert all(d["state"] == ht.JOB_STATE_DONE for d in t)
+        assert sorted(d["tid"] for d in t) == list(range(36))
+
     def test_overlap_ignored_for_non_dispatch_algo(self):
         # rand.suggest has no dispatch surface: overlap degrades silently
         t = ht.Trials()

@@ -100,6 +100,21 @@ class TestPallasEiKernel:
              rstate=np.random.default_rng(0), show_progressbar=False)
         assert t.best_trial["result"]["loss"] < 0.5
 
+    def test_batched_liar_composes_with_pallas(self, monkeypatch):
+        # The constant-liar scan wraps the whole suggest body — including
+        # the Pallas EI scorer (the TPU default) — in lax.scan; pin that
+        # the composition traces and runs via the interpreter.
+        from functools import partial as _partial
+        monkeypatch.setenv("HYPEROPT_TPU_PALLAS", "interpret")
+        t = Trials()
+        fmin(lambda d: (d["x"] - 3.0) ** 2, {"x": hp.uniform("x", -5, 5)},
+             algo=_partial(tpe.suggest, n_startup_jobs=8,
+                           n_EI_candidates=64),
+             max_evals=24, max_queue_len=8, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert len(t) == 24
+        assert t.best_trial["result"]["loss"] < 1.0
+
 
 def test_auto_dispatch_helpers():
     # pallas_available is backend-conditional (False on forced CPU);

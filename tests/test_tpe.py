@@ -341,6 +341,18 @@ class TestSuggestApi:
         jax.block_until_ready(out)
         assert (time.perf_counter() - t0) * 1e3 < 1500, \
             "first call recompiled despite prewarm"
+        # Same contract for the batched (liar-scan) entry: prewarm with
+        # n>1 must land in the exact jit-cache slot suggest_many_seeded
+        # hits (uint32 seed, int32 cursor, history, f32 scalars).
+        _prewarm_async(kern, n=4)
+        for th in threading.enumerate():
+            if th.name.startswith("tpe-prewarm"):
+                th.join(timeout=120)
+        t0 = time.perf_counter()
+        out = kern.suggest_many_seeded(0, 4, 50, hv, ha, hl, hok, 0.25, 1.0)
+        jax.block_until_ready(out)
+        assert (time.perf_counter() - t0) * 1e3 < 1500, \
+            "first batched call recompiled despite prewarm"
 
     def test_gamma_zero_empty_below_set(self):
         # gamma=0 → n_below=0: the below model is the bare prior; the step

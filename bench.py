@@ -274,12 +274,13 @@ def child():
         partial["trials_per_sec"] = round(run(objective, False), 2)
         partial["trials_sec_n_EI"] = n_cand_ts
         _say("partial", partial)
-        if not fast:
-            # Batched suggestion (max_queue_len=8): one suggest_many program
+        if not fast and on_tpu:
+            # Batched suggestion (max_queue_len=8): one liar-scan program
             # + ONE fetch per 8 trials — the shipped mitigation for
             # high-RTT attachment (through the axon tunnel the per-trial
-            # fetch sync is the whole cost, so this should approach 8x the
-            # unbatched figure; on local attachment it saves dispatches).
+            # fetch sync is the whole cost).  TPU-only: on a 1-core CPU
+            # retry attempt the scan's 8x compute per dispatch could
+            # starve the phase's silence deadline for no useful signal.
             # Counts are multiples of 8 so every post-startup batch is full
             # and only the n=8 program shape is ever used.  The warm-up must
             # mirror the timed run exactly (n=64): suggest programs are also

@@ -128,9 +128,10 @@ def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
     n_rows = h["vals"].shape[0]
     # Batched proposals run the inherited constant-liar scan (the sharding
     # constraints live inside _suggest_one, so each scan step's EI sweep
-    # is still mesh-sharded): one dispatch + one fetch for all n, with n
-    # rows of bucket slack for the fantasy cursor.
-    kern = _get_sharded_kernel(cs, _bucket(n_rows + (n if n > 1 else 0)),
+    # is still mesh-sharded): one dispatch + one fetch for all n, with
+    # m = pow2(n) rows of bucket slack for the fantasy cursor.
+    m = _batch_size_for(n)
+    kern = _get_sharded_kernel(cs, _bucket(n_rows + (m if n > 1 else 0)),
                                int(n_EI_candidates), int(linear_forgetting),
                                mesh, split)
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
@@ -144,7 +145,6 @@ def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
                                        gamma, prior_weight)
             rows = np.asarray(r)[None, :]
         else:
-            m = _batch_size_for(kern, n, n_rows)
             r, _ = kern.suggest_many_seeded(seed32, m, n_rows, hv, ha,
                                             hl, hok, gamma, prior_weight)
             rows = np.asarray(r)[:n]

@@ -748,22 +748,26 @@ def _padded_history(h, n_cap):
 # ---------------------------------------------------------------------------
 
 
-def _batch_size_for(kern, n, n_rows):
-    """Round a partial batch up to an already-compiled liar-scan size.
+def _batch_size_for(n):
+    """Canonical liar-scan batch size: ``n`` rounded up to a power of two.
 
-    A final partial batch (``max_evals % max_queue_len != 0``) would
-    trace+compile a one-shot n-proposal program; instead reuse a compiled
-    larger size and let the caller slice the surplus rows off (the scan
-    is sequential, so the first n proposals are unaffected by surplus
-    steps).  The bucket-slack guard keeps the fantasy cursor in bounds.
+    Batch sizes vary run-to-run (a final partial batch when ``max_evals %
+    max_queue_len != 0``; async backends enqueue into however many queue
+    slots are free each poll), and every distinct size is a separate XLA
+    program — on TPU a multi-second compile stall apiece.  Rounding to
+    the next power of two canonicalizes all sizes in (m/2, m] onto one
+    program (O(log K) compiles total); callers slice the surplus rows
+    off (the scan is sequential, so the first n proposals are unaffected
+    by surplus steps) and size the history bucket with m rows of slack.
+    Deliberately pow2-ONLY (no exact-size fast path): program selection
+    stays a pure function of n, so prewarm always warms the slot the
+    next call hits — a fixed non-pow2 queue (say 5) pays the surplus
+    scan steps, which hide behind the per-batch fetch sync on TPU.
     Shared by :func:`suggest_dispatch` and ``parallel.sharded_suggest``.
     """
-    if ("seeded", n) in kern._batch_fns:
+    if n <= 1:
         return n
-    compiled = sorted(k[1] for k in kern._batch_fns
-                      if isinstance(k, tuple) and k[0] == "seeded"
-                      and k[1] > n and n_rows + k[1] <= kern.n_cap)
-    return compiled[0] if compiled else n
+    return 1 << (n - 1).bit_length()
 
 
 def _startup_batch(startup, new_ids, domain, trials, seed):
@@ -901,9 +905,11 @@ def suggest_dispatch(new_ids, domain, trials, seed,
                 ok=np.concatenate([h["ok"], np.ones(len(pv), bool)]))
 
     n_rows = h["vals"].shape[0]
-    # Batched proposals insert n constant-liar fantasy rows (see
-    # _liar_scan), so the bucket needs n rows of padding slack.
-    kern = get_kernel(cs, _bucket(n_rows + (n if n > 1 else 0)),
+    # Batched proposals run m = pow2(n) liar-scan steps (surplus sliced
+    # off at materialize) and insert m fantasy rows, so the bucket needs
+    # m rows of padding slack.
+    m = _batch_size_for(n)
+    kern = get_kernel(cs, _bucket(n_rows + (m if n > 1 else 0)),
                       int(n_EI_candidates), int(linear_forgetting), split,
                       multivariate, cat_prior)
     if n_rows >= 0.75 * kern.n_cap:
@@ -913,7 +919,7 @@ def suggest_dispatch(new_ids, domain, trials, seed,
         # one they will actually call — not the single-proposal entry.
         _prewarm_async(get_kernel(cs, kern.n_cap * 2, int(n_EI_candidates),
                                   int(linear_forgetting), split,
-                                  multivariate, cat_prior), n=n)
+                                  multivariate, cat_prior), n=m)
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
     seed32 = int(seed) % (2 ** 32)
     if n == 1:
@@ -922,7 +928,6 @@ def suggest_dispatch(new_ids, domain, trials, seed,
         arrs = kern.suggest_seeded(seed32, hv, ha, hl, hok,
                                    gamma, prior_weight)
     else:
-        m = _batch_size_for(kern, n, n_rows)
         arrs = kern.suggest_many_seeded(seed32, m, n_rows, hv, ha, hl, hok,
                                         gamma, prior_weight)
     return ("pending", cs, list(new_ids), arrs, exp_key)

@@ -577,6 +577,32 @@ class TestLongRun:
         late = [d["misc"]["vals"]["x"][0] for d in list(t)[-100:]]
         assert abs(np.median(late) - 1.0) < 0.5
 
+    def test_batched_bucket_ladder(self):
+        # 320 evals at max_queue_len=8: every batch runs the liar scan
+        # whose fantasy cursor needs m=8 rows of slack ABOVE the real
+        # history, across the 32→512 bucket ladder. Pins the
+        # bucket-sizing arithmetic (_bucket(n_rows + m)) at every ladder
+        # crossing, pow2 program canonicalization (only m=8 batch
+        # programs exist), and end-to-end health of a long batched run.
+        space = {"x": hp.uniform("x", -3, 3), "y": hp.normal("y", 0, 2)}
+        cs = compile_space(space)
+        t = Trials()
+        algo = lambda *a, **kw: tpe.suggest(
+            *a, n_EI_candidates=16, **kw)
+        fmin(lambda d: (d["x"] - 1) ** 2 + 0.3 * d["y"] ** 2, space,
+             algo=algo, max_evals=320, max_queue_len=8, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert len(t) == 320
+        kernels = getattr(cs, "_tpe_kernels", {})
+        batch_sizes = set()
+        for k, kern in kernels.items():
+            if k[1] == 16:
+                batch_sizes |= {bk[1] for bk in kern._batch_fns
+                                if isinstance(bk, tuple)
+                                and bk[0] == "seeded"}
+        assert batch_sizes <= {8}, batch_sizes   # pow2-canonical only
+        assert t.best_trial["result"]["loss"] < 0.05
+
 
 @pytest.mark.slow
 class TestConvergenceFull:

@@ -35,6 +35,7 @@ from ..tpe import (
     _TpeKernel,
     _batch_size_for,
     _bucket,
+    _with_inflight_fantasies,
     _default_gamma,
     _default_linear_forgetting,
     _default_n_EI_candidates,
@@ -124,6 +125,7 @@ def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
     h = trials.history(cs)
     if int(h["ok"].sum()) < n_startup_jobs or cs.n_params == 0:
         return rand.suggest(new_ids, domain, trials, seed)
+    h = _with_inflight_fantasies(h, trials, cs)
     n = len(new_ids)
     n_rows = h["vals"].shape[0]
     # Batched proposals run the inherited constant-liar scan (the sharding
@@ -215,6 +217,7 @@ def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
     h = trials.history(cs)
     if int(h["ok"].sum()) < n_startup_jobs or cs.n_params == 0:
         return rand.suggest(new_ids, domain, trials, seed)
+    h = _with_inflight_fantasies(h, trials, cs)
 
     n = len(new_ids)
     n_dev = mesh.shape[START_AXIS]

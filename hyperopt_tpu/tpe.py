@@ -748,6 +748,34 @@ def _padded_history(h, n_cap):
 # ---------------------------------------------------------------------------
 
 
+def _with_inflight_fantasies(h, trials, cs):
+    """Constant-liar treatment of CONCURRENT work.
+
+    Trials currently NEW/RUNNING (an overlapped pre-dispatched batch,
+    pool workers, file-store workers) enter the history as fantasy rows
+    at the mean observed loss, so a suggest repels its proposals from
+    points already in flight instead of re-proposing them.  Call only
+    PAST startup — a pure-fantasy posterior (zero real observations)
+    would model noise.  No-op for Trials without ``inflight`` (exotic
+    reference-API subclasses) or when nothing is in flight.  Shared by
+    :func:`suggest_dispatch`, ``parallel.sharded_suggest``, and
+    ``parallel.multi_start_suggest``.
+    """
+    infl = getattr(trials, "inflight", None)
+    if infl is None:
+        return h
+    pv, pa = infl(cs)
+    if not len(pv):
+        return h
+    okl = h["loss"][h["ok"]]
+    lie = np.float32(okl.mean()) if okl.size else np.float32(0.0)
+    return dict(
+        vals=np.concatenate([h["vals"], pv]),
+        active=np.concatenate([h["active"], pa]),
+        loss=np.concatenate([h["loss"], np.full(len(pv), lie, np.float32)]),
+        ok=np.concatenate([h["ok"], np.ones(len(pv), bool)]))
+
+
 def _batch_size_for(n):
     """Canonical liar-scan batch size: ``n`` rounded up to a power of two.
 
@@ -885,25 +913,7 @@ def suggest_dispatch(new_ids, domain, trials, seed,
             a = cs.active_mask_host(v)
         return ("ready", cs, list(new_ids),
                 (np.asarray(v), np.asarray(a)), exp_key)
-    # Constant-liar treatment of CONCURRENT work: trials currently NEW/
-    # RUNNING (an overlapped pre-dispatched batch, pool workers, file-store
-    # workers) enter the history as fantasy rows at the mean observed loss,
-    # so this suggest repels its proposals from points already in flight
-    # instead of re-proposing them.  Applied only past startup — a
-    # pure-fantasy posterior (zero real observations) would model noise.
-    infl = getattr(trials, "inflight", None)
-    if infl is not None:
-        pv, pa = infl(cs)
-        if len(pv):
-            okl = h["loss"][h["ok"]]
-            lie = np.float32(okl.mean()) if okl.size else np.float32(0.0)
-            h = dict(
-                vals=np.concatenate([h["vals"], pv]),
-                active=np.concatenate([h["active"], pa]),
-                loss=np.concatenate(
-                    [h["loss"], np.full(len(pv), lie, np.float32)]),
-                ok=np.concatenate([h["ok"], np.ones(len(pv), bool)]))
-
+    h = _with_inflight_fantasies(h, trials, cs)
     n_rows = h["vals"].shape[0]
     # Batched proposals run m = pow2(n) liar-scan steps (surplus sliced
     # off at materialize) and insert m fantasy rows, so the bucket needs

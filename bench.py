@@ -291,8 +291,11 @@ def child():
             partial["trials_per_sec_q8"] = round(
                 run(objective, False, n=64, qlen=8), 2)
             _say("partial", partial)
+        if not fast:
             # Overlap A/B against a ~25 ms objective: suggest latency hides
-            # behind host evaluation (fmin(overlap_suggest=True)).
+            # behind host evaluation (fmin(overlap_suggest=True)).  NOT
+            # TPU-gated: full CPU runs keep emitting these fields (round-3
+            # advisor finding — only the q8 scan above is TPU-only).
             partial["trials_per_sec_25ms_obj"] = round(
                 run(slow_objective, False), 2)
             partial["trials_per_sec_25ms_obj_overlap"] = round(
@@ -362,11 +365,58 @@ def _pallas_allclose():
 # ---------------------------------------------------------------------------
 
 
-def _run_child(extra_env, log):
-    """Run one child attempt; returns (result_dict_or_None, partials_dict)."""
+def _preflight(log, deadline=180.0):
+    """Claim-free tunnel probe (round-3 verdict ask #1).
+
+    Attempts ``jax.devices()`` in a DISPOSABLE subprocess with a hard cap
+    and returns the backend string (``"tpu"``/``"cpu"``) or ``None`` when
+    the tunnel is unreachable.  Safety argument: a probe that exceeds the
+    cap is *blocked waiting* on the tunnel's exclusive chip claim — it
+    never held the claim, so killing it cannot wedge the chip.  That is
+    the opposite of the old failure mode, where the measurement child was
+    killed *mid-claim* during its init phase (the round-3 driver capture:
+    "init 420s silent -> kill"), which is the documented multi-hour wedge
+    cause (.claude/skills/verify/SKILL.md).  With the preflight in front,
+    a wedged tunnel means the real child is simply never started on the
+    TPU path; bench falls straight to the CPU-labeled measurement without
+    ever touching the chip.
+
+    Set ``HYPEROPT_TPU_BENCH_PREFLIGHT=0`` to skip (old behavior).
+    """
+    code = ("import jax, sys\n"
+            "sys.stdout.write('@backend ' + jax.default_backend())\n"
+            "sys.stdout.flush()\n")
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+            timeout=deadline)
+    except subprocess.TimeoutExpired:
+        log(f"preflight: no device contact in {deadline:.0f}s — tunnel "
+            "wedged (probe killed claim-free; chip untouched)")
+        return None
+    out = proc.stdout or ""
+    for tok in out.splitlines():
+        if tok.startswith("@backend "):
+            backend = tok[len("@backend "):].strip()
+            log(f"preflight: backend={backend} in {time.time() - t0:.1f}s")
+            return backend
+    log(f"preflight: probe exited rc={proc.returncode} without a backend "
+        f"({out.strip()[-200:]!r})")
+    return None
+
+
+def _run_child(extra_env, log, script=None):
+    """Run one child attempt; returns (result_dict_or_None, partials_dict).
+
+    ``script`` defaults to this file; other harnesses (benchmarks/
+    profile_step.py) pass their own path to reuse the deadline/SIGTERM-first
+    machinery for their own ``--child`` protocol."""
     env = dict(os.environ, **extra_env)
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--child"],
+        [sys.executable, script or os.path.abspath(__file__), "--child"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd=os.path.dirname(os.path.abspath(__file__)) or ".", env=env)
 
@@ -460,6 +510,25 @@ def main():
         pass
 
     t0 = time.time()
+    backend = "skipped"
+    if os.environ.get("HYPEROPT_TPU_BENCH_PREFLIGHT", "1") != "0":
+        backend = _preflight(log)
+        if backend is None:
+            # Tunnel wedged: skip the TPU attempts entirely (starting the
+            # measurement child would claim the chip and end in the very
+            # mid-claim kill the preflight exists to prevent) and take the
+            # CPU-labeled fallback directly.
+            log("TPU unreachable (claim-free preflight); falling back to a "
+                "CPU-labeled measurement without touching the chip")
+            result, partial = _run_child(
+                {"PALLAS_AXON_POOL_IPS": "", "JAX_PLATFORMS": "cpu",
+                 "HYPEROPT_TPU_PALLAS": "0", "HYPEROPT_TPU_BENCH_PALLAS": "0",
+                 "HYPEROPT_TPU_BENCH_FAST": "1"},
+                log)
+            partial = (result or partial or {})
+            partial["tpu_preflight"] = "wedged"
+            _emit(partial, t0)
+            return
     result, partial = _run_child({}, log)
     if result is None and partial.get("backend") is not None:
         # Attempt 1 got past init but died later — a Pallas/kernel issue is
@@ -487,7 +556,10 @@ def main():
         if result is None and partial3.get("value") is not None:
             partial = partial3
 
-    out = result or partial or {}
+    _emit(result or partial or {}, t0)
+
+
+def _emit(out, t0):
     out.setdefault("metric", "tpe_suggest_latency_10k_cand_50dim")
     out.setdefault("unit", "ms")
     out.setdefault("value", None)

@@ -3,5 +3,5 @@
 # (bench.py incl. the liar-batch trials_per_sec_q8 + suite TPU rows).
 # Launch: nohup bash benchmarks/chain_harvest.sh > /tmp/chain.log 2>&1 &
 cd "$(dirname "$0")/.."
-bash benchmarks/tpu_probe.sh /tmp/tpu_probe_chain.log 300 80 \
+bash benchmarks/tpu_probe.sh /tmp/tpu_probe_chain.log 300 140 \
   && bash benchmarks/tpu_window.sh

@@ -1,0 +1,257 @@
+"""Component-level breakdown of the TPE suggest step (round-3 verdict ask #3).
+
+The full suggest step is ONE jitted XLA program, so a wall clock can't see
+inside it.  This harness times each sub-stage as its OWN jitted program with
+the fetch-synced steady-state methodology from ``bench.py::_measure`` (k
+back-to-back dispatches + one host fetch, divided by k — ``jax.block_until_
+ready`` is a no-op through the axon tunnel), so the ~15 ms full-step time can
+be attributed:
+
+  ``split``      γ-split double-argsort over the history bucket
+  ``fit``        adaptive-Parzen below+above fits, all groups
+  ``fit_draw``   fits + inverse-CDF candidate draws (diff vs fit = sampling,
+                 which includes the per-column threefry bit generation)
+  ``cont``       full continuous path: fits + draws + EI scores
+  ``cat``        categorical scoring incl. the [D, n_cand, kmax] gumbel draw
+  ``rng_bits``   raw threefry draws of the same total shape as the step's
+                 (attributes generator cost independent of the math around it)
+  ``full``       the shipped program (pallas default) — equals bench.py value
+  ``full_xla``   same with HYPEROPT_TPU_PALLAS=0
+
+Attribution is by difference (stages overlap by construction); ``residual``
+= full − cont − cat − split is assembly/argmax/active-mask + anything not
+covered.  Results: ``benchmarks/profile_step_<backend>_<stamp>.json``.
+
+Run via the parent wrapper (deadline-enforced child, SIGTERM-first — reuses
+bench.py's machinery so a tunnel hang cannot end in a mid-claim SIGKILL):
+
+    python benchmarks/profile_step.py          # parent
+    python benchmarks/profile_step.py --child  # (internal)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_DIMS = int(os.environ.get("HYPEROPT_TPU_PROFILE_DIMS", 50))
+N_CAND = int(os.environ.get("HYPEROPT_TPU_PROFILE_NCAND", 10_000))
+N_HISTORY = int(os.environ.get("HYPEROPT_TPU_PROFILE_HIST", 1_000))
+K_STEADY = int(os.environ.get("HYPEROPT_TPU_PROFILE_K", 32))
+
+
+def _say(tag, payload=None):
+    line = f"@{tag}" if payload is None else f"@{tag} {json.dumps(payload)}"
+    print(line, flush=True)
+
+
+def _steady(fn, args, reps=3, k=K_STEADY):
+    """(steady_ms, oneshot_ms) for one jitted stage; fetch-syncs one leaf."""
+    import jax
+
+    from benchmarks import fetch_sync
+
+    t0 = time.perf_counter()
+    out = fn(*args)
+    fetch_sync(out)
+    _say("compiled", {"s": round(time.perf_counter() - t0, 1)})
+    times = []
+    for i in range(reps):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        fetch_sync(out)
+        times.append((time.perf_counter() - t0) * 1e3)
+        _say("rep", {"i": i, "ms": round(times[-1], 2)})
+    oneshot = float(np.median(times))
+    t0 = time.perf_counter()
+    for _ in range(k):
+        out = fn(*args)
+    fetch_sync(out)
+    steady = (time.perf_counter() - t0) * 1e3 / k
+    return steady, oneshot
+
+
+def child():
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+
+    _say("phase", {"name": "init"})
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _flagship_space, _history
+    from hyperopt_tpu.space import compile_space
+    from hyperopt_tpu.tpe import _bucket, _padded_history, get_kernel
+
+    backend = jax.default_backend()
+    result = {"metric": "tpe_step_breakdown", "unit": "ms",
+              "backend": backend, "device": str(jax.devices()[0]),
+              "n_cand": N_CAND, "n_history": N_HISTORY, "n_dims": N_DIMS,
+              "stages": {}}
+    _say("partial", result)
+
+    cs = compile_space(_flagship_space(N_DIMS))
+    n_cap = _bucket(N_HISTORY)
+    hv, ha, hl, hok = _padded_history(_history(cs, N_HISTORY), n_cap)
+    hv, ha = jax.device_put(hv), jax.device_put(ha)
+    hl, hok = jax.device_put(hl), jax.device_put(hok)
+    key = jax.random.key(0)
+    gamma, pw = np.float32(0.25), np.float32(1.0)
+
+    os.environ["HYPEROPT_TPU_PALLAS"] = "1" if backend == "tpu" else "0"
+    kern = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
+
+    def stage(name, fn, args, deadline_phase=True):
+        if deadline_phase:
+            _say("phase", {"name": name})
+        try:
+            steady, oneshot = _steady(jax.jit(fn), args)
+            result["stages"][name] = {"steady_ms": round(steady, 3),
+                                      "oneshot_ms": round(oneshot, 3)}
+        except Exception as e:
+            result["stages"][name] = {"error": f"{type(e).__name__}: {e}"}
+        _say("partial", result)
+
+    # γ-split alone (the double argsort over the bucket).
+    stage("split", lambda l, o: kern._split(l, o, gamma), (hl, hok))
+
+    # Parzen fits, all groups.
+    def fit_all(v, a, l, o):
+        below, above = kern._split(l, o, gamma)
+        return tuple(kern._cont_fit(g, v, a, below, above, pw)
+                     for g in kern.groups)
+
+    stage("fit", fit_all, (hv, ha, hl, hok))
+
+    # Fits + inverse-CDF draws.
+    def fit_draw(k_, v, a, l, o):
+        below, above = kern._split(l, o, gamma)
+        outs = []
+        for g, kg in zip(kern.groups, jax.random.split(k_, len(kern.groups))):
+            fits = kern._cont_fit(g, v, a, below, above, pw)
+            outs.append(kern._cont_draw(g, kg, *fits[:3]))
+        return tuple(outs)
+
+    stage("fit_draw", fit_draw, (key, hv, ha, hl, hok))
+
+    # Full continuous path (fits + draws + EI).
+    def cont_all(k_, v, a, l, o):
+        below, above = kern._split(l, o, gamma)
+        return tuple(
+            kern._cont_scores(g, kg, v, a, below, above, pw)
+            for g, kg in zip(kern.groups,
+                             jax.random.split(k_, len(kern.groups))))
+
+    stage("cont", cont_all, (key, hv, ha, hl, hok))
+
+    # Categorical path.
+    if len(kern.cat_pids):
+        def cat(k_, v, a, l, o):
+            below, above = kern._split(l, o, gamma)
+            return kern._cat_scores(k_, v, a, below, above, pw)
+
+        stage("cat", cat, (key, hv, ha, hl, hok))
+
+    # Raw generator cost: same bit volume as the step's draws.
+    n_cont = sum(len(g) for g in kern.groups)
+    d, kmax = len(kern.cat_pids), kern.cat_kmax
+
+    def rng_bits(k_):
+        ks = jax.random.split(k_, n_cont + 1)
+        u = jax.vmap(lambda kk: jax.random.uniform(
+            kk, (2, N_CAND), dtype=jnp.float32))(ks[:-1])
+        gmb = jax.random.gumbel(ks[-1], (d, N_CAND, kmax), dtype=jnp.float32)
+        return u.sum() + gmb.sum()
+
+    stage("rng_bits", rng_bits, (key,))
+
+    # The shipped full program (separately for each EI mode on TPU).
+    stage("full", kern._suggest_one, (key, hv, ha, hl, hok, gamma, pw))
+    if backend == "tpu":
+        os.environ["HYPEROPT_TPU_PALLAS"] = "0"
+        kx = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
+        stage("full_xla", kx._suggest_one, (key, hv, ha, hl, hok, gamma, pw))
+
+    # Derived attribution.
+    st = result["stages"]
+
+    def ms(name):
+        return st.get(name, {}).get("steady_ms")
+
+    if all(ms(n) is not None for n in ("full", "cont", "split")):
+        result["attribution"] = {
+            "fit": ms("fit"),
+            "draw": round(ms("fit_draw") - ms("fit"), 3)
+            if ms("fit_draw") else None,
+            "ei_score": round(ms("cont") - ms("fit_draw"), 3)
+            if ms("fit_draw") else None,
+            "cat": ms("cat"),
+            "residual_assembly": round(
+                ms("full") - ms("cont") - (ms("cat") or 0.0), 3),
+        }
+        _say("partial", result)
+
+    # Best-effort device trace of the full program (may be unsupported
+    # through the tunnel; the JSON breakdown above is the primary output).
+    _say("phase", {"name": "trace"})
+    stamp = os.environ.get("HYPEROPT_TPU_PROFILE_STAMP", "dev")
+    here = os.path.dirname(os.path.abspath(__file__))
+    trace_dir = os.path.join(here, f"trace_step_{backend}_{stamp}")
+    try:
+        fn = jax.jit(kern._suggest_one)
+        from benchmarks import fetch_sync
+
+        with jax.profiler.trace(trace_dir):
+            for _ in range(8):
+                out = fn(key, hv, ha, hl, hok, gamma, pw)
+            fetch_sync(out)
+        result["trace_dir"] = os.path.relpath(trace_dir, here)
+    except Exception as e:
+        result["trace_error"] = f"{type(e).__name__}: {e}"
+    _say("partial", result)
+
+    _say("phase", {"name": "result"})
+    _say("result", result)
+
+
+def main():
+    if "--child" in sys.argv:
+        child()
+        return
+
+    import bench
+
+    def log(msg):
+        print(f"[profile] {msg}", file=sys.stderr, flush=True)
+
+    # Reuse bench.py's deadline-enforced child runner by pointing it at THIS
+    # file (claim-free preflight first: a wedged tunnel must not be claimed).
+    backend = bench._preflight(log)
+    if backend is None:
+        log("tunnel wedged — aborting without touching the chip")
+        print(json.dumps({"metric": "tpe_step_breakdown",
+                          "error": "tpu_preflight_wedged"}))
+        return
+
+    stamp = time.strftime("%Y%m%d_%H%M", time.gmtime())
+    os.environ["HYPEROPT_TPU_PROFILE_STAMP"] = stamp
+    result, partial = bench._run_child({}, log,
+                                       script=os.path.abspath(__file__))
+    out = result or partial or {}
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, f"profile_step_{out.get('backend')}_{stamp}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    log(f"wrote {path}")
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,16 +1,22 @@
 #!/bin/bash
-# One-shot TPU-window harvest (round-3 runbook, .claude/skills/verify/SKILL.md).
+# One-shot TPU-window harvest (round-4 runbook, .claude/skills/verify/SKILL.md).
 #
 # Run the moment a probe answers.  Captures, in strict priority order with
-# the machine otherwise idle:
-#   1. python bench.py            — the driver-format headline artifact
-#                                   (archived with a timestamp under benchmarks/)
-#   2. suite configs 3 5 5s      — kernel-latency TPU rows (safe: no fmin loop)
-#   3. suite config 2            — one e2e fmin TPU row (WEDGE RISK: a
+# the machine otherwise idle (wedge-risk ascending):
+#   1. python bench.py            — the driver-format headline artifact,
+#                                   now incl. trials_per_sec_q8 (archived
+#                                   with a timestamp under benchmarks/)
+#   2. profile_step.py            — per-stage breakdown of the suggest step
+#                                   (round-3 verdict ask #3); parent/child
+#                                   deadlines + claim-free preflight inside
+#   3. suite configs 3 5 5s      — kernel-latency TPU rows (safe: no fmin)
+#   4. suite configs 2q 4        — batched-liar e2e + multi-start rows
+#                                   (fmin loops: slower, mild wedge risk)
+#   5. suite config 2            — one e2e fmin TPU row (WEDGE RISK: a
 #                                   2026-07-31 run wedged inside config 1's
-#                                   fmin; config 2 is shorter, run it LAST)
-# Restarts the probe loop afterwards.  Each stage's output is archived even
-# if a later stage wedges.
+#                                   fmin; run it LAST)
+# Commits the artifacts, then restarts the probe loop.  Each stage's output
+# is archived even if a later stage wedges.
 set -u
 cd "$(dirname "$0")/.."
 STAMP=$(date -u +%Y%m%d_%H%M)
@@ -21,7 +27,7 @@ pkill -f tpu_probe.sh 2>/dev/null && say "probe loop stopped"
 sleep 2
 
 say "stage 1: bench.py"
-timeout 3000 python bench.py > "benchmarks/bench_${STAMP}.json" 2>>"$LOG"
+timeout 5400 python bench.py > "benchmarks/bench_${STAMP}.json" 2>>"$LOG"
 rc=$?
 say "bench rc=$rc: $(cat benchmarks/bench_${STAMP}.json)"
 if python - "benchmarks/bench_${STAMP}.json" <<'EOF'
@@ -30,17 +36,29 @@ d = json.load(open(sys.argv[1]))
 sys.exit(0 if d.get("backend") == "tpu" else 1)
 EOF
 then
-  say "stage 2: suite 3 5 5s"
+  say "stage 2: profile_step.py"
+  timeout 5400 python benchmarks/profile_step.py >> "$LOG" 2>&1
+  say "profile rc=$?"
+  say "stage 3: suite 3 5 5s"
   timeout 3000 python -m benchmarks.suite 3 5 5s >> "$LOG" 2>&1
   say "suite(3 5 5s) rc=$?"
-  say "stage 3: suite 2 (e2e fmin — wedge risk, last)"
+  say "stage 4: suite 2q 4 (batched e2e + multi-start fmin loops)"
+  timeout 3000 python -m benchmarks.suite 2q 4 >> "$LOG" 2>&1
+  say "suite(2q 4) rc=$?"
+  say "stage 5: suite 2 (e2e fmin — wedge risk, last)"
   timeout 1200 python -m benchmarks.suite 2 >> "$LOG" 2>&1
   say "suite(2) rc=$?"
 else
-  say "bench did not get a TPU backend — skipping suite stages"
+  say "bench did not get a TPU backend — skipping remaining stages"
 fi
+
+say "committing artifacts"
+git add benchmarks/bench_${STAMP}.json benchmarks/profile_step_*.json \
+    benchmarks/results_latest.json "$LOG" 2>>"$LOG"
+git commit -m "TPU window ${STAMP}: harvest bench + profile + suite rows" \
+    >>"$LOG" 2>&1 || say "git commit failed (builder may hold the lock) — artifacts left staged"
 
 say "restarting probe loop"
 nohup bash benchmarks/tpu_probe.sh /tmp/tpu_probe_next.log 600 120 \
   > /dev/null 2>&1 &
-say "done; artifacts: benchmarks/bench_${STAMP}.json + results_latest.json + $LOG"
+say "done; artifacts: benchmarks/bench_${STAMP}.json + profile_step_*.json + results_latest.json + $LOG"

@@ -377,13 +377,13 @@ class _TpeKernel:
         bi = jnp.argmax(ei, axis=1)
         return v[jnp.arange(len(g)), bi]
 
-    def _cont_scores(self, g: _ContGroup, key, vals, active, below, above,
-                     prior_weight):
-        """Candidate values + EI scores for one group: ([C, n_cand], [C, n_cand])."""
+    def _cont_fit(self, g: _ContGroup, vals, active, below, above,
+                  prior_weight):
+        """Adaptive-Parzen fits for one group's below/above sets:
+        ``(lwb, mub, sgb, lwa, mua, sga)`` (log-weights, means, sigmas)."""
         z = vals[:, g.pids]
         z = jnp.where(g.is_log, jnp.log(jnp.maximum(z, _TINY)), z)
         act = active[:, g.pids]
-        c = len(g)
 
         def models(set_mask, cap):
             m, w, n_set = self._set_weights(set_mask, act)
@@ -399,17 +399,31 @@ class _TpeKernel:
         # of the step.
         wb, mub, sgb = models(below, min(self.lf, self.n_cap) + 1)
         wa, mua, sga = models(above, self.n_cap + 1)
-        lwb, lwa = jnp.log(wb), jnp.log(wa)
+        return jnp.log(wb), mub, sgb, jnp.log(wa), mua, sga
 
-        keys = jax.random.split(key, c)
-        fit_lo = jnp.asarray(g.fit_lo)
-        fit_hi = jnp.asarray(g.fit_hi)
+    def _cont_draw(self, g: _ContGroup, key, lwb, mub, sgb):
+        """Inverse-CDF candidate draws from the below model: ``zc [C, n_cand]``
+        in fit space."""
+        keys = jax.random.split(key, len(g))
         zc = jax.vmap(
             lambda k, lw, mu, sg, lo, hi:
             gmm_sample(k, lw, mu, sg, lo, hi, self.n_cand)
-        )(keys, lwb, mub, sgb, fit_lo, fit_hi)              # [C, n_cand]
-        zc = self._constrain_cand(zc)
+        )(keys, lwb, mub, sgb, jnp.asarray(g.fit_lo),
+          jnp.asarray(g.fit_hi))                            # [C, n_cand]
+        return self._constrain_cand(zc)
 
+    def _cont_scores(self, g: _ContGroup, key, vals, active, below, above,
+                     prior_weight):
+        """Candidate values + EI scores for one group: ([C, n_cand], [C, n_cand])."""
+        fits = self._cont_fit(g, vals, active, below, above, prior_weight)
+        zc = self._cont_draw(g, key, *fits[:3])
+        return self._cont_ei(g, zc, fits)
+
+    def _cont_ei(self, g: _ContGroup, zc, fits):
+        """Natural-space values + EI scores from fit-space draws ``zc``."""
+        lwb, mub, sgb, lwa, mua, sga = fits
+        fit_lo = jnp.asarray(g.fit_lo)
+        fit_hi = jnp.asarray(g.fit_hi)
         x_nat = jnp.where(g.is_log[:, None], jnp.exp(zc), zc)
         if g.is_q:
             q = jnp.asarray(g.q)[:, None]

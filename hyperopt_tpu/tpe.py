@@ -954,6 +954,14 @@ def suggest_dispatch(new_ids, domain, trials, seed,
     else:
         arrs = kern.suggest_many_seeded(seed32, m, n_rows, hv, ha, hl, hok,
                                         gamma, prior_weight)
+        # A batched run's FINAL batch can be a single proposal
+        # (max_evals % max_queue_len == 1), which takes the n==1 path —
+        # usually on this same bucket (the m completed rows land before
+        # that call, so _bucket(n_rows_final) == this kernel's n_cap in
+        # all but the boundary band).  Warm the single-proposal program
+        # too so the last trial doesn't pay a compile stall (round-3
+        # advisor finding).
+        _prewarm_async(kern, n=1)
     return ("pending", cs, list(new_ids), arrs, exp_key)
 
 

@@ -381,6 +381,51 @@ class TestOverlapSuggest:
         assert all(d["state"] == ht.JOB_STATE_DONE for d in t)
         assert sorted(d["tid"] for d in t) == list(range(36))
 
+    def test_clamped_resume_pending_batch(self):
+        """Stop mid-run with a pre-dispatched K-batch still pending, then
+        resume with a smaller budget: the ``[:n_to_enqueue]`` clamp discards
+        the surplus proposals WITH their pre-allocated tids.  The dropped
+        tids leave a gap at the top, which is safe only because
+        ``new_trial_ids`` derives from the max existing tid — this test
+        pins that invariant (round-3 advisor finding): exact trial count,
+        no duplicate tids, and clean continuation after the gap."""
+        from hyperopt_tpu.base import Domain
+        from hyperopt_tpu.fmin import FMinIter
+
+        t = ht.Trials()
+        algo = ht.partial(ht.tpe.suggest, n_startup_jobs=2,
+                          n_EI_candidates=16)
+        d = Domain(lambda cfg: (cfg["x"] - 1.0) ** 2,
+                   {"x": hp.uniform("x", -5, 5)})
+        armed = {"stop": True}
+
+        def early_stop(trials, *args):
+            return armed["stop"], ()
+
+        it = FMinIter(algo, d, t, rstate=np.random.default_rng(0),
+                      max_queue_len=4, overlap_suggest=True,
+                      show_progressbar=False, early_stop_fn=early_stop)
+        # Batch 1: enqueue tids 0-3, pre-dispatch tids 4-7, evaluate,
+        # early-stop fires -> run ends holding the pending 4-batch.
+        it.run(8)
+        assert it.n_done() == 4
+        assert it._pending_suggest is not None
+
+        # Resume with a SMALLER allowance (2 < K=4): the pending batch is
+        # clamped, tids 6-7 silently dropped.
+        it.early_stop_fn = None
+        armed["stop"] = False
+        it.run(2)
+        assert it.n_done() == 6
+        assert sorted(doc["tid"] for doc in t) == list(range(6))
+
+        # Continuation allocates past the max EXISTING tid: no duplicates,
+        # exact final count.
+        it.run(3)
+        tids = sorted(doc["tid"] for doc in t)
+        assert len(tids) == len(set(tids)) == 9
+        assert all(d_["state"] == ht.JOB_STATE_DONE for d_ in t)
+
     def test_overlap_ignored_for_non_dispatch_algo(self):
         # rand.suggest has no dispatch surface: overlap degrades silently
         t = ht.Trials()

@@ -89,6 +89,77 @@ class TestShardedSuggest:
         assert mesh.shape == {START_AXIS: 2, CAND_AXIS: 4}
 
 
+class TestSuggestKwargParity:
+    """Round-3 verdict ask #4: the three TPE entry points accept the same
+    tuning kwargs (a quality-tuned config ports to the mesh unchanged),
+    and the sharded kernel cache keys on everything baked into the
+    compiled program (cat_prior / pallas mode env toggles)."""
+
+    TUNING = {"prior_weight", "n_startup_jobs", "n_EI_candidates", "gamma",
+              "linear_forgetting", "split", "multivariate", "startup",
+              "cat_prior"}
+
+    def test_signature_parity(self):
+        import inspect
+
+        from hyperopt_tpu import tpe
+
+        for fn in (tpe.suggest, sharded_suggest, multi_start_suggest):
+            params = set(inspect.signature(fn).parameters)
+            missing = self.TUNING - params
+            assert not missing, f"{fn.__name__} missing {missing}"
+
+    def test_sharded_multivariate_quality(self):
+        """multivariate=True on the mesh: the quality-winning joint-EI
+        config (README table) now runs sharded; conditional + categorical
+        space exercises the cat path end-to-end."""
+        z = ZOO["q1_choice"]
+        mesh = default_mesh(n_starts=1)
+        from functools import partial
+
+        t = Trials()
+        fmin(z.fn, z.space,
+             algo=partial(sharded_suggest, mesh=mesh, n_EI_candidates=512,
+                          multivariate=True, cat_prior="const",
+                          startup="qmc"),
+             max_evals=z.budget, trials=t, rstate=np.random.default_rng(3),
+             show_progressbar=False)
+        assert len(t) == z.budget
+        assert t.best_trial["result"]["loss"] < z.rand_thresh
+
+    def test_multistart_multivariate_runs(self):
+        mesh = Mesh(np.asarray(jax.devices()), (START_AXIS,))
+        from functools import partial
+
+        t = Trials()
+        fmin(_quad, _quad_space(),
+             algo=partial(multi_start_suggest, mesh=mesh, multivariate=True,
+                          startup="qmc", cat_prior="sqrt"),
+             max_evals=32, max_queue_len=8, trials=t,
+             rstate=np.random.default_rng(0), show_progressbar=False)
+        assert len(t) == 32
+        assert t.best_trial["result"]["loss"] < 1.0
+
+    def test_sharded_cache_keys_on_toggles(self, monkeypatch):
+        """Env toggles are baked into the compiled program, so they must
+        key the sharded cache — a stale kernel after a mid-process toggle
+        was the round-3 verdict's latent footgun."""
+        from hyperopt_tpu import compile_space
+        from hyperopt_tpu.parallel.sharded import _get_sharded_kernel
+
+        cs = compile_space({"x": hp.uniform("x", -5, 5)})
+        mesh = default_mesh(n_starts=1)
+        monkeypatch.delenv("HYPEROPT_TPU_CAT_PRIOR", raising=False)
+        k1 = _get_sharded_kernel(cs, 32, 64, 25, mesh, "sqrt")
+        monkeypatch.setenv("HYPEROPT_TPU_CAT_PRIOR", "const")
+        k2 = _get_sharded_kernel(cs, 32, 64, 25, mesh, "sqrt")
+        assert k1 is not k2
+        assert (k1.cat_prior, k2.cat_prior) == ("sqrt", "const")
+        k3 = _get_sharded_kernel(cs, 32, 64, 25, mesh, "sqrt",
+                                 multivariate=True)
+        assert k3 is not k2 and k3.multivariate
+
+
 class TestMultiStart:
     def test_k_distinct_proposals_one_call(self):
         mesh = Mesh(np.asarray(jax.devices()), (START_AXIS,))

@@ -145,10 +145,16 @@ def _run_domains(names):
 
 
 def _finish(rows):
-    # Filtered A/B runs must not clobber the full-table artifact.
-    fname = ("quality_ab_latest.json"
-             if os.environ.get("HYPEROPT_TPU_QUALITY_ALGOS")
-             else "quality_latest.json")
+    # Filtered A/B runs get a PER-EXPERIMENT artifact name derived from the
+    # algo list (round-3 verdict: a shared "latest" file that different
+    # experiments overwrite destroys provenance — the cat-prior A/B numbers
+    # were lost to the batch-liar A/B this way).  The full table keeps its
+    # canonical name.  ``HYPEROPT_TPU_QUALITY_OUT`` overrides.
+    only = os.environ.get("HYPEROPT_TPU_QUALITY_ALGOS")
+    fname = os.environ.get("HYPEROPT_TPU_QUALITY_OUT") or (
+        "quality_ab_" + "_vs_".join(
+            a.strip() for a in only.split(",") if a.strip()) + ".json"
+        if only else "quality_latest.json")
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), fname)
     with open(out, "w") as f:
         json.dump({"seeds": SEEDS, "rows": rows}, f, indent=1)

@@ -13,7 +13,10 @@ layer.  The TPU-native equivalents here (per SURVEY.md §5.8):
   of BASELINE.md config 4).
 * **cross-host (DCN / host network)** — ``hyperopt_tpu.parallel.filestore``:
   an elastic, durable trial store playing MongoDB's role (atomic claim,
-  owner stamps, experiment keys) for fleets of workers.
+  owner stamps, experiment keys) for fleets of workers sharing a mount;
+  ``hyperopt_tpu.parallel.netstore`` serves the same store over HTTP for
+  hosts with ONLY network reachability (the MongoTrials wire-protocol
+  analog).
 """
 
 from .sharded import (  # noqa: F401
@@ -23,4 +26,5 @@ from .sharded import (  # noqa: F401
     sharded_suggest,
 )
 from .filestore import FileTrials, FileWorker  # noqa: F401
+from .netstore import NetTrials, NetWorker, StoreServer  # noqa: F401
 from .pool import PoolTrials  # noqa: F401

@@ -375,7 +375,7 @@ class FileWorker:
                  poll_interval=0.1, reserve_timeout=None,
                  max_consecutive_failures=4, workdir=None,
                  heartbeat_interval=15.0):
-        self.trials = FileTrials(root, exp_key=exp_key)
+        self.trials = self._make_trials(root, exp_key)
         self._domain = domain
         self.poll_interval = poll_interval
         self.reserve_timeout = reserve_timeout
@@ -387,6 +387,13 @@ class FileWorker:
         import uuid
         self.owner = (f"{socket.gethostname()}:{os.getpid()}:"
                       f"{uuid.uuid4().hex[:8]}")
+
+    @staticmethod
+    def _make_trials(root, exp_key):
+        """Store-binding hook: ``netstore.NetWorker`` overrides this to run
+        the identical reserve/heartbeat/evaluate/write loop over a network
+        store instead of a shared mount."""
+        return FileTrials(root, exp_key=exp_key)
 
     @property
     def domain(self):

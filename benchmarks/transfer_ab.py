@@ -41,22 +41,112 @@ EXP2_FRACTION = 0.5          # experiment 2 runs at half the domain budget
 N_STARTUP = 10
 
 
-def _run(z, seed, cache_dir, budget):
+def _run_space(space, fn, seed, cache_dir, budget):
     import hyperopt_tpu as ho
 
     os.environ["HYPEROPT_TPU_CACHE_DIR"] = cache_dir
     t = ho.Trials()
     algo = ho.partial(ho.atpe.suggest, n_startup_jobs=N_STARTUP)
-    ho.fmin(z.fn, z.space, algo=algo, max_evals=budget,
+    ho.fmin(fn, space, algo=algo, max_evals=budget,
             trials=t, rstate=np.random.default_rng(seed),
             show_progressbar=False)
     return t.best_trial["result"]["loss"]
 
 
+def _run(z, seed, cache_dir, budget):
+    return _run_space(z.space, z.fn, seed, cache_dir, budget)
+
+
+# -- cross-space mode (round-4): the reference capability is generalizing
+# to UNSEEN problems.  Train the store on a structurally similar VARIANT
+# space (shifted bounds -> different fingerprint, near-identical
+# _space_features), then run the TRUE domain at a budget-starved size:
+# transfer seeds from the variant via nearest-neighbor similarity, cold
+# explores from flat.  Arm identity matters most when the bandit gets few
+# post-startup decisions, so exp2 budgets are deliberately tiny.
+
+
+def _variant_space(name):
+    from hyperopt_tpu import hp
+
+    if name == "branin":
+        return {"x": hp.uniform("x", -5.5, 10.5),
+                "y": hp.uniform("y", -0.5, 15.5)}
+    if name == "many_dists":
+        return {
+            "a": hp.choice("a", [0, 1, 2]),
+            "b": hp.randint("b", 10),
+            "bb": hp.randint("bb", 5, 25),
+            "c": hp.uniform("c", 0, 1.1),
+            "d": hp.loguniform("d", -3.2, 2.1),
+            "e": hp.quniform("e", 1, 12, 2),
+            "f": hp.qloguniform("f", 0, 3.1, 1),
+            "g": hp.normal("g", 4, 2.2),
+            "h": hp.lognormal("h", 0, 1.1),
+            "i": hp.qnormal("i", 0, 5.5, 1),
+            "j": hp.qlognormal("j", 0, 2.1, 1),
+            "k": hp.pchoice("k", [(0.15, 0), (0.85, 1)]),
+            "l": hp.uniformint("l", 1, 9),
+            "z": hp.choice("z", [
+                {"zz": hp.uniform("zz", 0, 1.1)},
+                {"zw": hp.normal("zw", 0, 1.1),
+                 "zc": hp.choice("zc", ["p", "q"])},
+            ]),
+        }
+    raise KeyError(name)
+
+
+CROSS_DOMAINS = {"branin": 30, "many_dists": 20}   # starved exp2 budgets
+
+
+def cross_main():
+    from zoo import ZOO
+
+    rows = []
+    for name, b2 in CROSS_DOMAINS.items():
+        z = ZOO[name]
+        vspace = _variant_space(name)
+        cold, warm = [], []
+        t0 = time.perf_counter()
+        for s in SEEDS:
+            exp1_dir = tempfile.mkdtemp(prefix="transfer_x_")
+            # exp1 trains the store on the VARIANT space (new fingerprint).
+            _run_space(vspace, z.fn, s, exp1_dir, z.budget)
+            # exp2 runs the TRUE domain: transfer must come via the
+            # feature-similarity neighbor path, not an exact fingerprint.
+            warm.append(_run(z, 1000 + s, exp1_dir, b2))
+            cold.append(_run(z, 1000 + s,
+                             tempfile.mkdtemp(prefix="transfer_x_"), b2))
+        rec = {"domain": name, "exp1_space": "variant(shifted bounds)",
+               "exp1_budget": z.budget, "exp2_budget": b2,
+               "cold_median": float(np.median(cold)),
+               "transfer_median": float(np.median(warm)),
+               "transfer_wins": int(sum(w <= c for w, c in zip(warm, cold))),
+               "n_seeds": len(SEEDS),
+               "wall_s": round(time.perf_counter() - t0, 1)}
+        rows.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "transfer_ab_cross.json")
+    with open(out, "w") as f:
+        json.dump({"seeds": SEEDS, "rows": rows}, f, indent=1)
+    print("\n| domain | exp2 budget | cold | transfer (cross-space) | wins |")
+    print("|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {r['domain']} | {r['exp2_budget']} | "
+              f"{r['cold_median']:.4g} | {r['transfer_median']:.4g} | "
+              f"{r['transfer_wins']}/{r['n_seeds']} |")
+    print(f"\n# wrote {out}")
+
+
 def main(argv=None):
     from zoo import ZOO
 
-    which = set(argv or sys.argv[1:])
+    argv = list(argv if argv is not None else sys.argv[1:])
+    if "--cross" in argv:
+        return cross_main()
+    which = set(argv)
     rows = []
     for name in DOMAINS:
         if which and name not in which:

@@ -178,6 +178,17 @@ def child():
         os.environ["HYPEROPT_TPU_PALLAS"] = "0"
         kx = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
         stage("full_xla", kx._suggest_one, (key, hv, ha, hl, hok, gamma, pw))
+        os.environ["HYPEROPT_TPU_PALLAS"] = "1"
+
+    # Candidate optimization A/B: inverse-CDF component pick in gmm_sample
+    # (one uniform per draw + CDF compares vs the gumbel trick's n*K draws
+    # + logs).  Same distribution, different RNG stream — flipping the
+    # default is a canary re-baselining decision; this stage records
+    # whether it's worth it.
+    os.environ["HYPEROPT_TPU_COMP_SAMPLER"] = "icdf"
+    ki = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
+    stage("full_icdf", ki._suggest_one, (key, hv, ha, hl, hok, gamma, pw))
+    os.environ.pop("HYPEROPT_TPU_COMP_SAMPLER", None)
 
     # Derived attribution.
     st = result["stages"]

@@ -104,6 +104,25 @@ def gmm_log_qmass(zl, zh, logw, mu, sigma, trunc_lo=-jnp.inf,
             - log_z)
 
 
+def _comp_sampler() -> str:
+    """Component-selection lowering for :func:`gmm_sample`.
+
+    ``HYPEROPT_TPU_COMP_SAMPLER``: ``gumbel`` (default) uses
+    ``jax.random.categorical`` — the Gumbel-argmax trick, which generates
+    ``n·K`` uniforms plus two logs each; ``icdf`` draws ONE uniform per
+    sample and picks the component by CDF comparison — ``O(n)`` generator
+    work plus ``n·K`` compares, an identical distribution lowered with
+    ~K× fewer transcendentals.  Opt-in until an on-chip A/B shows a win
+    (profile_step.py measures both): flipping it changes the RNG stream,
+    so proposals (and the cross-round `tpe` quality canary) shift —
+    that's a re-baselining decision, not a silent default change.
+    """
+    import os
+
+    env = os.environ.get("HYPEROPT_TPU_COMP_SAMPLER", "gumbel")
+    return env if env in ("gumbel", "icdf") else "gumbel"
+
+
 def gmm_sample(key, logw, mu, sigma, trunc_lo, trunc_hi, n):
     """Draw ``n`` fit-space samples from a truncated GMM, inverse-CDF style.
 
@@ -113,8 +132,17 @@ def gmm_sample(key, logw, mu, sigma, trunc_lo, trunc_hi, n):
     ``u ~ U[Φ(a), Φ(b)] → ndtri(u)``.
     """
     kc, ku = jax.random.split(key)
-    log_wmass, _ = _log_trunc_mass(logw, mu, sigma, trunc_lo, trunc_hi)
-    comp = jax.random.categorical(kc, log_wmass, shape=(n,))
+    log_wmass, log_z = _log_trunc_mass(logw, mu, sigma, trunc_lo, trunc_hi)
+    if _comp_sampler() == "icdf":
+        # Padding components carry −inf log_wmass ⇒ zero CDF increments;
+        # clamping u below 1 keeps the pick off the trailing pad.
+        cdf = jnp.cumsum(jnp.exp(log_wmass - log_z))
+        uc = jax.random.uniform(kc, (n,), dtype=jnp.float32,
+                                maxval=1.0 - 1e-7)
+        comp = jnp.sum(uc[:, None] >= cdf[None, :-1],
+                       axis=1).astype(jnp.int32)
+    else:
+        comp = jax.random.categorical(kc, log_wmass, shape=(n,))
     m = mu[comp]
     s = sigma[comp]
     pa = jax.scipy.special.ndtr((trunc_lo - m) / s)

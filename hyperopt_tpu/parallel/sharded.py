@@ -99,17 +99,19 @@ def _mesh_key(mesh):
 
 def _get_sharded_kernel(cs, n_cap, n_cand, lf, mesh, split,
                         multivariate=False, cat_prior=None):
+    from ..ops.gmm import _comp_sampler
     from ..tpe import _cat_prior_default, _pallas_mode
 
     cache = getattr(cs, "_sharded_tpe_kernels", None)
     if cache is None:
         cache = cs._sharded_tpe_kernels = {}
     cat_prior = cat_prior or _cat_prior_default()
-    # Same key discipline as tpe.get_kernel: cat_prior and the pallas mode
-    # are baked into the compiled program, so they MUST key the cache —
-    # otherwise an env toggle mid-process hands back a stale kernel.
+    # Same key discipline as tpe.get_kernel: cat_prior, pallas mode, and
+    # the component-sampler lowering are baked into the compiled program,
+    # so they MUST key the cache — otherwise an env toggle mid-process
+    # hands back a stale kernel.
     k = (n_cap, n_cand, lf, _mesh_key(mesh), split, multivariate,
-         cat_prior, _pallas_mode())
+         cat_prior, _pallas_mode(), _comp_sampler())
     if k not in cache:
         cache[k] = ShardedTpeKernel(cs, n_cap, n_cand, lf, mesh, split,
                                     multivariate=multivariate,

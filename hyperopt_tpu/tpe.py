@@ -732,12 +732,16 @@ def _prewarm_async(kern: _TpeKernel, n: int = 1) -> None:
 def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
                split: str = "sqrt", multivariate: bool = False,
                cat_prior: str | None = None) -> _TpeKernel:
+    from .ops.gmm import _comp_sampler
+
     cache = getattr(cs, "_tpe_kernels", None)
     if cache is None:
         cache = cs._tpe_kernels = {}
     cat_prior = cat_prior or _cat_prior_default()
+    # Env toggles baked into the traced program all key the cache —
+    # a mid-process toggle must produce a fresh kernel, never a stale one.
     k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
-         _pallas_mode())
+         _pallas_mode(), _comp_sampler())
     if k not in cache:
         cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split, multivariate,
                               cat_prior)

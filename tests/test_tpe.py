@@ -241,6 +241,35 @@ class TestGmmSample:
         d, p = stats.kstest(s, stats.norm.cdf)
         assert p > 0.01, (d, p)
 
+    def test_icdf_component_sampler_same_distribution(self, monkeypatch):
+        """HYPEROPT_TPU_COMP_SAMPLER=icdf is a lowering change, not a
+        semantics change: component frequencies match the weights (incl.
+        zero-weight padding never picked) and the samples pass the same
+        truncated-mixture KS test as the default gumbel path."""
+        monkeypatch.setenv("HYPEROPT_TPU_COMP_SAMPLER", "icdf")
+        w = np.array([0.6, 0.4, 0.0], np.float32)       # padded component
+        mu = np.array([-1.0, 2.0, 50.0], np.float32)
+        sg = np.array([0.5, 1.0, 1.0], np.float32)
+        lo, hi = -2.0, 3.0
+        logw = jnp.log(jnp.asarray(w))
+        s = np.asarray(gmm_sample(jax.random.key(0), logw,
+                                  jnp.asarray(mu), jnp.asarray(sg),
+                                  lo, hi, 4000))
+        assert s.min() >= lo and s.max() <= hi          # pad never sampled
+
+        def cdf(x):
+            x = np.asarray(x)
+            num = sum(wk * (stats.norm.cdf(x, mk, sk)
+                            - stats.norm.cdf(lo, mk, sk))
+                      for wk, mk, sk in zip(w[:2], mu[:2], sg[:2]))
+            den = sum(wk * (stats.norm.cdf(hi, mk, sk)
+                            - stats.norm.cdf(lo, mk, sk))
+                      for wk, mk, sk in zip(w[:2], mu[:2], sg[:2]))
+            return num / den
+
+        d, p = stats.kstest(s, cdf)
+        assert p > 0.01, (d, p)
+
 
 # ---------------------------------------------------------------------------
 # suggest API behavior

@@ -33,3 +33,46 @@ def test_dryrun_multichip_8(capsys):
     out = capsys.readouterr().out
     assert "mesh={'dp': 2, 'sp': 4}" in out
     assert "trials evaluated" in out
+    assert "2-process global mesh OK" in out   # DCN-tier segment (r4)
+
+
+class TestBenchPreflight:
+    """bench.py's claim-free preflight (round-3 verdict ask #1): a wedged
+    tunnel must short-circuit to the CPU fallback WITHOUT the measurement
+    child ever claiming the chip."""
+
+    def _bench(self):
+        import importlib
+
+        return importlib.import_module("bench")
+
+    def test_preflight_reports_backend(self, monkeypatch):
+        bench = self._bench()
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "")
+        msgs = []
+        out = bench._preflight(msgs.append, deadline=180.0)
+        assert out == "cpu"
+        assert any("backend=cpu" in m for m in msgs)
+
+    def test_preflight_timeout_means_wedged(self, monkeypatch):
+        bench = self._bench()
+
+        def hang(*a, **kw):
+            raise bench.subprocess.TimeoutExpired(cmd=a, timeout=1)
+
+        monkeypatch.setattr(bench.subprocess, "run", hang)
+        msgs = []
+        assert bench._preflight(msgs.append, deadline=1.0) is None
+        assert any("wedged" in m for m in msgs)
+
+    def test_preflight_probe_crash_means_unreachable(self, monkeypatch):
+        bench = self._bench()
+
+        class Dead:
+            returncode = 1
+            stdout = "ImportError: boom"
+
+        monkeypatch.setattr(bench.subprocess, "run",
+                            lambda *a, **kw: Dead())
+        assert bench._preflight(lambda m: None, deadline=1.0) is None

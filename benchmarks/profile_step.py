@@ -190,6 +190,15 @@ def child():
     stage("full_icdf", ki._suggest_one, (key, hv, ha, hl, hok, gamma, pw))
     os.environ.pop("HYPEROPT_TPU_COMP_SAMPLER", None)
 
+    # Pallas candidate-tile sweep (default at this n_cap is 256).
+    if backend == "tpu":
+        for t in (128, 512, 1024):
+            os.environ["HYPEROPT_TPU_PALLAS_TILE"] = str(t)
+            kt = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
+            stage(f"full_tile{t}", kt._suggest_one,
+                  (key, hv, ha, hl, hok, gamma, pw))
+        os.environ.pop("HYPEROPT_TPU_PALLAS_TILE", None)
+
     # Derived attribution.
     st = result["stages"]
 

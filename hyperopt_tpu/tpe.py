@@ -111,6 +111,18 @@ def _pallas_mode() -> str:
     return "native" if on_tpu else "off"
 
 
+def _pallas_tile():
+    """Candidate-tile override for the Pallas EI kernel
+    (``HYPEROPT_TPU_PALLAS_TILE``, multiple of 128; 0/unset → the built-in
+    n_cap-based heuristic).  Read at kernel-construction/trace time, so it
+    participates in the kernel cache key like every other baked-in toggle."""
+    try:
+        t = int(os.environ.get("HYPEROPT_TPU_PALLAS_TILE", "0"))
+        return t if t > 0 and t % 128 == 0 else None
+    except ValueError:
+        return None
+
+
 def _cat_prior_default() -> str:
     """Default categorical prior-strength schedule (see ``_cat_scores``).
 
@@ -468,7 +480,7 @@ class _TpeKernel:
                 # folded in here.
                 from .ops.pallas_gmm import ei_scores
 
-                tile = 512 if self.n_cap <= 2048 else 256
+                tile = _pallas_tile() or (512 if self.n_cap <= 2048 else 256)
                 ei = ei_scores(zc, lwb, mub, sgb, lwa, mua, sga,
                                tile=tile,
                                interpret=self.pallas == "interpret")
@@ -741,7 +753,7 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
     # Env toggles baked into the traced program all key the cache —
     # a mid-process toggle must produce a fresh kernel, never a stale one.
     k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
-         _pallas_mode(), _comp_sampler())
+         _pallas_mode(), _comp_sampler(), _pallas_tile())
     if k not in cache:
         cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split, multivariate,
                               cat_prior)

@@ -568,23 +568,50 @@ def _emit(out, t0):
         # The tunnel was down for this run; surface the most recent COMMITTED
         # on-chip artifact (clearly labeled as such, with its own timestamped
         # file) so a wedged window doesn't erase recorded hardware evidence.
-        try:
-            here = os.path.dirname(os.path.abspath(__file__))
-            ref = "benchmarks/bench_tpu_20260731_steady.json"
-            with open(os.path.join(here, ref)) as f:
-                prior = json.load(f)
-            if prior.get("backend") == "tpu":
-                out["last_tpu_run"] = {
-                    "artifact": ref,
-                    "value_ms": prior.get("value"),
-                    "vs_baseline": prior.get("vs_baseline"),
-                    "mode": prior.get("mode"),
-                    "speedup_vs_cpu_ref": prior.get("speedup_vs_cpu_ref"),
-                }
-        except (OSError, ValueError):
-            pass
+        prior = _latest_tpu_artifact()
+        if prior is not None:
+            ref, doc = prior
+            out["last_tpu_run"] = {
+                "artifact": ref,
+                "value_ms": doc.get("value"),
+                "vs_baseline": doc.get("vs_baseline"),
+                "mode": doc.get("mode"),
+                "speedup_vs_cpu_ref": doc.get("speedup_vs_cpu_ref"),
+                "trials_per_sec_q8": doc.get("trials_per_sec_q8"),
+            }
     out["bench_wall_s"] = round(time.time() - t0, 1)
     print(json.dumps(out), flush=True)
+
+
+def _latest_tpu_artifact():
+    """Newest committed ``benchmarks/bench*.json`` with ``backend=="tpu"``
+    and a non-null headline value, by embedded timestamp then mtime — so a
+    fresh window's harvest automatically becomes the wedge-fallback
+    citation without anyone editing a hardcoded filename."""
+    here = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks")
+    best = None
+    try:
+        names = sorted(os.listdir(here))
+    except OSError:
+        return None
+    for name in names:
+        if not (name.startswith("bench") and name.endswith(".json")):
+            continue
+        path = os.path.join(here, name)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if doc.get("backend") != "tpu" or doc.get("value") is None:
+            continue
+        key = os.path.getmtime(path)
+        if best is None or key > best[0]:
+            best = (key, f"benchmarks/{name}", doc)
+    if best is None:
+        return None
+    return best[1], best[2]
 
 
 if __name__ == "__main__":

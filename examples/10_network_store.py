@@ -1,0 +1,51 @@
+"""Distributed evaluation over TCP — no shared filesystem required (the
+MongoTrials *wire protocol* topology: one store server, network clients).
+
+`StoreServer` hosts the experiment directory on its local disk and speaks
+JSON-HTTP; `NetTrials` (driver) and `NetWorker` (evaluators) need only a
+URL.  All the file store's guarantees — atomic claims, owner-fenced writes,
+heartbeats, automatic stale-job requeue — are enforced server-side, so
+racing workers still evaluate every trial exactly once.
+
+This script plays all three roles for demo purposes.  In production:
+
+    host A$ hyperopt-tpu-netstore --serve --root /data/exp --host 0.0.0.0
+    host B$ hyperopt-tpu-netstore --worker http://hostA:8417 --exp-key demo
+    host C$ python driver.py        # fmin(trials=NetTrials("http://hostA:8417"))
+
+Run: python examples/10_network_store.py
+"""
+
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+import hyperopt_tpu as ho
+from hyperopt_tpu import hp
+from hyperopt_tpu.parallel import NetTrials, StoreServer
+
+
+def objective(cfg):
+    return (cfg["x"] - 1.0) ** 2 + cfg["c"] * 0.1
+
+
+space = {"x": hp.uniform("x", -5, 5), "c": hp.choice("c", [0, 1, 2])}
+
+server = StoreServer(tempfile.mkdtemp(prefix="hyperopt-tpu-net-"))
+server.start()
+
+worker = subprocess.Popen([
+    sys.executable, "-m", "hyperopt_tpu.parallel.netstore",
+    "--worker", server.url, "--exp-key", "demo", "--reserve-timeout", "30",
+])
+
+trials = NetTrials(server.url, exp_key="demo")
+best = ho.fmin(objective, space, algo=ho.tpe.suggest, max_evals=40,
+               trials=trials, rstate=np.random.default_rng(0))
+worker.wait(timeout=60)
+
+print("best:", best, "loss:", trials.best_trial["result"]["loss"])
+print("evaluated by:", {t["owner"] for t in trials if t["owner"]})
+server.shutdown()

@@ -42,9 +42,9 @@ then
   say "stage 3: suite 3 5 5s"
   timeout 3000 python -m benchmarks.suite 3 5 5s >> "$LOG" 2>&1
   say "suite(3 5 5s) rc=$?"
-  say "stage 4: suite 2q 4 (batched e2e + multi-start fmin loops)"
-  timeout 3000 python -m benchmarks.suite 2q 4 >> "$LOG" 2>&1
-  say "suite(2q 4) rc=$?"
+  say "stage 4: suite 2q 4 4q (batched e2e + multi-start + sharded-batch fmin loops)"
+  timeout 3000 python -m benchmarks.suite 2q 4 4q >> "$LOG" 2>&1
+  say "suite(2q 4 4q) rc=$?"
   say "stage 5: suite 2 (e2e fmin — wedge risk, last)"
   timeout 1200 python -m benchmarks.suite 2 >> "$LOG" 2>&1
   say "suite(2) rc=$?"

@@ -255,12 +255,23 @@ def child():
         }
         _say("partial", result)
 
-    # Best-effort device trace of the full program (may be unsupported
-    # through the tunnel; the JSON breakdown above is the primary output).
+    # Best-effort device trace of the full program.  On the axon tunnel
+    # this is OPT-IN (HYPEROPT_TPU_PROFILE_TRACE=1): jax.profiler has
+    # never been exercised on that backend, and a hang here would end in
+    # the parent's SIGKILL of a mid-claim child — the documented
+    # multi-hour wedge — for a nice-to-have artifact.  The JSON breakdown
+    # above is the primary output.
     _say("phase", {"name": "trace"})
     stamp = os.environ.get("HYPEROPT_TPU_PROFILE_STAMP", "dev")
     here = os.path.dirname(os.path.abspath(__file__))
     trace_dir = os.path.join(here, f"trace_step_{backend}_{stamp}")
+    if (backend == "tpu"
+            and os.environ.get("HYPEROPT_TPU_PROFILE_TRACE") != "1"):
+        result["trace_skipped"] = "tpu: opt-in via HYPEROPT_TPU_PROFILE_TRACE=1"
+        _say("partial", result)
+        _say("phase", {"name": "result"})
+        _say("result", result)
+        return
     try:
         fn = jax.jit(kern._suggest_one)
         from benchmarks import fetch_sync

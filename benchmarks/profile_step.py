@@ -17,6 +17,10 @@ be attributed:
                  (attributes generator cost independent of the math around it)
   ``full``       the shipped program (pallas default) — equals bench.py value
   ``full_xla``   same with HYPEROPT_TPU_PALLAS=0
+  ``full_icdf``  same with HYPEROPT_TPU_COMP_SAMPLER=icdf (iCDF component +
+                 categorical draws, see ops/gmm.py::_comp_sampler)
+  ``split_sort`` / ``full_sortsplit``  the round-3 double-argsort γ-split
+                 (HYPEROPT_TPU_SPLIT_IMPL=sort) vs the shipped top-k split
 
 Attribution is by difference (stages overlap by construction); ``residual``
 = full − cont − cat − split is assembly/argmax/active-mask + anything not
@@ -49,6 +53,28 @@ K_STEADY = int(os.environ.get("HYPEROPT_TPU_PROFILE_K", 32))
 def _say(tag, payload=None):
     line = f"@{tag}" if payload is None else f"@{tag} {json.dumps(payload)}"
     print(line, flush=True)
+
+
+def _scalarize(fn):
+    """Wrap a stage so its jitted output is ONE f32 scalar.
+
+    ``fetch_sync`` pulls the first output leaf whole; stage outputs range
+    from a [P] row (~200 B) to [C, n_cand] candidate matrices (~MB), so
+    un-reduced stages would pay wildly different tunnel transfer times and
+    corrupt the stage *deltas* the attribution is built on (measured in
+    the 2026-07-31 19:12 artifact: the 'draw' delta was mostly fetch
+    size).  A sum depends on every element, so nothing is dead-code
+    eliminated, and every stage now fetches exactly 4 bytes.
+    """
+    import jax.numpy as jnp
+
+    def wrapped(*args):
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(fn(*args))
+        return sum(jnp.sum(x.astype(jnp.float32)) for x in leaves)
+
+    return wrapped
 
 
 def _steady(fn, args, reps=3, k=K_STEADY):
@@ -112,7 +138,7 @@ def child():
         if deadline_phase:
             _say("phase", {"name": name})
         try:
-            steady, oneshot = _steady(jax.jit(fn), args)
+            steady, oneshot = _steady(jax.jit(_scalarize(fn)), args)
             result["stages"][name] = {"steady_ms": round(steady, 3),
                                       "oneshot_ms": round(oneshot, 3)}
         except Exception as e:
@@ -130,16 +156,19 @@ def child():
 
     stage("fit", fit_all, (hv, ha, hl, hok))
 
-    # Fits + inverse-CDF draws.
-    def fit_draw(k_, v, a, l, o):
-        below, above = kern._split(l, o, gamma)
-        outs = []
-        for g, kg in zip(kern.groups, jax.random.split(k_, len(kern.groups))):
-            fits = kern._cont_fit(g, v, a, below, above, pw)
-            outs.append(kern._cont_draw(g, kg, *fits[:3]))
-        return tuple(outs)
+    # Fits + inverse-CDF draws (shared by the icdf A/B stage below).
+    def fit_draw_for(k):
+        def fit_draw(k_, v, a, l, o):
+            below, above = k._split(l, o, gamma)
+            outs = []
+            for g, kg in zip(k.groups, jax.random.split(k_, len(k.groups))):
+                fits = k._cont_fit(g, v, a, below, above, pw)
+                outs.append(k._cont_draw(g, kg, *fits[:3]))
+            return tuple(outs)
 
-    stage("fit_draw", fit_draw, (key, hv, ha, hl, hok))
+        return fit_draw
+
+    stage("fit_draw", fit_draw_for(kern), (key, hv, ha, hl, hok))
 
     # Full continuous path (fits + draws + EI).
     def cont_all(k_, v, a, l, o):
@@ -185,19 +214,47 @@ def child():
     # + logs).  Same distribution, different RNG stream — flipping the
     # default is a canary re-baselining decision; this stage records
     # whether it's worth it.
-    os.environ["HYPEROPT_TPU_COMP_SAMPLER"] = "icdf"
-    ki = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
-    stage("full_icdf", ki._suggest_one, (key, hv, ha, hl, hok, gamma, pw))
-    os.environ.pop("HYPEROPT_TPU_COMP_SAMPLER", None)
+    from contextlib import contextmanager
+
+    @contextmanager
+    def env_override(name, value):
+        """Set ``name=value`` for one A/B block, then RESTORE the prior
+        value (popping would clobber a user-preset toggle and silently mix
+        lowerings across the later stages)."""
+        saved = os.environ.get(name)
+        os.environ[name] = value
+        try:
+            yield
+        finally:
+            if saved is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = saved
+
+    with env_override("HYPEROPT_TPU_COMP_SAMPLER", "icdf"):
+        ki = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
+        stage("full_icdf", ki._suggest_one,
+              (key, hv, ha, hl, hok, gamma, pw))
+        stage("fit_draw_icdf", fit_draw_for(ki), (key, hv, ha, hl, hok))
+
+    # γ-split lowering A/B: the shipped top-k split (the `split`/`full`
+    # stages above) vs the round-3 double-argsort rank.  Outputs are
+    # bit-identical (tests/test_tpe.py::TestSplitImpl) so this is purely
+    # a latency comparison.
+    with env_override("HYPEROPT_TPU_SPLIT_IMPL", "sort"):
+        ksort = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
+        stage("split_sort", lambda l, o: ksort._split(l, o, gamma),
+              (hl, hok))
+        stage("full_sortsplit", ksort._suggest_one,
+              (key, hv, ha, hl, hok, gamma, pw))
 
     # Pallas candidate-tile sweep (default at this n_cap is 256).
     if backend == "tpu":
         for t in (128, 512, 1024):
-            os.environ["HYPEROPT_TPU_PALLAS_TILE"] = str(t)
-            kt = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
-            stage(f"full_tile{t}", kt._suggest_one,
-                  (key, hv, ha, hl, hok, gamma, pw))
-        os.environ.pop("HYPEROPT_TPU_PALLAS_TILE", None)
+            with env_override("HYPEROPT_TPU_PALLAS_TILE", str(t)):
+                kt = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
+                stage(f"full_tile{t}", kt._suggest_one,
+                      (key, hv, ha, hl, hok, gamma, pw))
 
     # k-sweep on the SAME compiled full program: per-step time vs the
     # number of back-to-back dispatches per fetch.  If time/step keeps
@@ -268,21 +325,18 @@ def child():
     if (backend == "tpu"
             and os.environ.get("HYPEROPT_TPU_PROFILE_TRACE") != "1"):
         result["trace_skipped"] = "tpu: opt-in via HYPEROPT_TPU_PROFILE_TRACE=1"
-        _say("partial", result)
-        _say("phase", {"name": "result"})
-        _say("result", result)
-        return
-    try:
-        fn = jax.jit(kern._suggest_one)
-        from benchmarks import fetch_sync
+    else:
+        try:
+            fn = jax.jit(kern._suggest_one)
+            from benchmarks import fetch_sync
 
-        with jax.profiler.trace(trace_dir):
-            for _ in range(8):
-                out = fn(key, hv, ha, hl, hok, gamma, pw)
-            fetch_sync(out)
-        result["trace_dir"] = os.path.relpath(trace_dir, here)
-    except Exception as e:
-        result["trace_error"] = f"{type(e).__name__}: {e}"
+            with jax.profiler.trace(trace_dir):
+                for _ in range(8):
+                    out = fn(key, hv, ha, hl, hok, gamma, pw)
+                fetch_sync(out)
+            result["trace_dir"] = os.path.relpath(trace_dir, here)
+        except Exception as e:
+            result["trace_error"] = f"{type(e).__name__}: {e}"
     _say("partial", result)
 
     _say("phase", {"name": "result"})

@@ -123,24 +123,47 @@ def _comp_sampler() -> str:
     return env if env in ("gumbel", "icdf") else "gumbel"
 
 
-def gmm_sample(key, logw, mu, sigma, trunc_lo, trunc_hi, n):
+def icdf_pick(u, cdf, last):
+    """Inverse-CDF index pick over the last axis, with the float32 pad guard.
+
+    ``u``: uniforms in [0, 1), shape ``[..., n]``; ``cdf``: inclusive cumsum
+    of (possibly zero-padded) probability masses, shape ``[..., K]``;
+    ``last``: highest pickable index (scalar or broadcastable) — the last
+    LIVE entry.  ``u`` is scaled by the total float32 mass ``cdf[..., -1]``
+    (not clamped near 1): a normalized cumsum can saturate just below a
+    near-1 uniform, which would otherwise pick a trailing zero-mass pad
+    entry.  The ``last`` clamp covers the remaining one-ULP case where
+    ``u·total`` rounds up to exactly ``total``.  Shared by
+    :func:`gmm_sample`'s component pick and the TPE categorical candidate
+    draw (``tpe._TpeKernel._cat_scores``).
+    """
+    u = u * cdf[..., -1:]
+    idx = jnp.sum(u[..., :, None] >= cdf[..., None, :-1],
+                  axis=-1).astype(jnp.int32)
+    return jnp.minimum(idx, last)
+
+
+def gmm_sample(key, logw, mu, sigma, trunc_lo, trunc_hi, n,
+               comp_sampler=None):
     """Draw ``n`` fit-space samples from a truncated GMM, inverse-CDF style.
 
     Replaces the reference's rejection loop (``tpe.py::GMM1``) with an exact
     fixed-shape equivalent: the component is drawn ∝ ``w_k · mass_k`` (what
     rejection induces), then the truncated normal is sampled via
     ``u ~ U[Φ(a), Φ(b)] → ndtri(u)``.
+
+    ``comp_sampler``: ``"gumbel"`` / ``"icdf"`` — pass a value snapshotted
+    at kernel construction so the lowering matches the caller's cache key;
+    ``None`` reads the env (callers outside a cached kernel).
     """
     kc, ku = jax.random.split(key)
     log_wmass, log_z = _log_trunc_mass(logw, mu, sigma, trunc_lo, trunc_hi)
-    if _comp_sampler() == "icdf":
-        # Padding components carry −inf log_wmass ⇒ zero CDF increments;
-        # clamping u below 1 keeps the pick off the trailing pad.
+    if (comp_sampler or _comp_sampler()) == "icdf":
+        # Padding components carry −inf log_wmass ⇒ zero CDF increments.
         cdf = jnp.cumsum(jnp.exp(log_wmass - log_z))
-        uc = jax.random.uniform(kc, (n,), dtype=jnp.float32,
-                                maxval=1.0 - 1e-7)
-        comp = jnp.sum(uc[:, None] >= cdf[None, :-1],
-                       axis=1).astype(jnp.int32)
+        uc = jax.random.uniform(kc, (n,), dtype=jnp.float32)
+        n_live = jnp.sum(log_wmass > -jnp.inf).astype(jnp.int32)
+        comp = icdf_pick(uc, cdf, n_live - 1)
     else:
         comp = jax.random.categorical(kc, log_wmass, shape=(n,))
     m = mu[comp]

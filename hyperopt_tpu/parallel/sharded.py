@@ -100,7 +100,12 @@ def _mesh_key(mesh):
 def _get_sharded_kernel(cs, n_cap, n_cand, lf, mesh, split,
                         multivariate=False, cat_prior=None):
     from ..ops.gmm import _comp_sampler
-    from ..tpe import _cat_prior_default, _pallas_mode, _pallas_tile
+    from ..tpe import (
+        _cat_prior_default,
+        _pallas_mode,
+        _pallas_tile,
+        _split_impl,
+    )
 
     cache = getattr(cs, "_sharded_tpe_kernels", None)
     if cache is None:
@@ -111,7 +116,8 @@ def _get_sharded_kernel(cs, n_cap, n_cand, lf, mesh, split,
     # so they MUST key the cache — otherwise an env toggle mid-process
     # hands back a stale kernel.
     k = (n_cap, n_cand, lf, _mesh_key(mesh), split, multivariate,
-         cat_prior, _pallas_mode(), _comp_sampler(), _pallas_tile())
+         cat_prior, _pallas_mode(), _comp_sampler(), _pallas_tile(),
+         _split_impl())
     if k not in cache:
         cache[k] = ShardedTpeKernel(cs, n_cap, n_cand, lf, mesh, split,
                                     multivariate=multivariate,

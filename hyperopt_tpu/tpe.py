@@ -123,6 +123,22 @@ def _pallas_tile():
         return None
 
 
+def _split_impl() -> str:
+    """γ-split lowering (``HYPEROPT_TPU_SPLIT_IMPL``).
+
+    ``topk`` (default) — membership in the below set needs only the
+    ``min(lf, n_cap)`` smallest losses, so one ``lax.top_k`` plus a
+    scatter replaces the double full-bucket ``argsort``.  ``sort`` —
+    the original rank-by-double-argsort lowering, kept for on-chip A/B
+    (``profile_step.py::full_sortsplit``).  Both produce bit-identical
+    below/above masks (ties break by trial index in both; pinned by
+    ``tests/test_tpe.py::TestSplitImpl``), so the default flip does not
+    move the cross-round quality canary.
+    """
+    env = os.environ.get("HYPEROPT_TPU_SPLIT_IMPL", "topk")
+    return env if env in ("topk", "sort") else "topk"
+
+
 def _cat_prior_default() -> str:
     """Default categorical prior-strength schedule (see ``_cat_scores``).
 
@@ -254,6 +270,13 @@ class _TpeKernel:
         # factorized per-parameter argmax (broadcast_best).
         self.multivariate = multivariate
         self.pallas = _pallas_mode()
+        self.split_impl = _split_impl()
+        # Snapshot at construction: the cache key records this value, and a
+        # lazily-traced program must bake in the SAME lowering even if the
+        # env toggle changed between get_kernel() and the first call.
+        from .ops.gmm import _comp_sampler
+
+        self.comp_sampler = _comp_sampler()
 
         cont_q, cont_n, cat = [], [], []
         for s in cs.params:
@@ -361,10 +384,25 @@ class _TpeKernel:
             n_below = jnp.ceil(gamma * n_f)
         n_below = jnp.minimum(n_below.astype(jnp.int32),
                               jnp.minimum(self.lf, n_ok))
-        # Stable rank by (loss, index): ok trials occupy ranks [0, n_ok).
-        rank = jnp.argsort(jnp.argsort(loss))
-        below = ok & (rank < n_below)
-        above = ok & (rank >= n_below)
+        # NaN losses sort with the +inf padding (tie-broken by index) in
+        # both lowerings; they can only matter when n_below reaches the
+        # non-finite tail, i.e. when nearly every ok loss is non-finite.
+        loss = jnp.where(jnp.isnan(loss), jnp.inf, loss)
+        if self.split_impl == "sort":
+            # Stable rank by (loss, index): ok trials occupy ranks [0, n_ok).
+            rank = jnp.argsort(jnp.argsort(loss))
+            below = ok & (rank < n_below)
+        else:
+            # n_below <= min(lf, n_ok), so only the k = min(lf, n_cap)
+            # smallest losses can ever enter the below set: top_k over the
+            # negated losses + a scatter of the first n_below picks replaces
+            # two full-bucket sorts.  lax.top_k prefers the lower index on
+            # ties — the same order argsort's stable rank gives.
+            k = min(self.lf, loss.shape[0])
+            _, idx = jax.lax.top_k(-loss, k)
+            below = jnp.zeros_like(ok).at[idx].set(
+                jnp.arange(k) < n_below) & ok
+        above = ok & ~below
         return below, above
 
     def _set_weights(self, set_mask, act):
@@ -419,7 +457,8 @@ class _TpeKernel:
         keys = jax.random.split(key, len(g))
         zc = jax.vmap(
             lambda k, lw, mu, sg, lo, hi:
-            gmm_sample(k, lw, mu, sg, lo, hi, self.n_cand)
+            gmm_sample(k, lw, mu, sg, lo, hi, self.n_cand,
+                       comp_sampler=self.comp_sampler)
         )(keys, lwb, mub, sgb, jnp.asarray(g.fit_lo),
           jnp.asarray(g.fit_hi))                            # [C, n_cand]
         return self._constrain_cand(zc)
@@ -531,10 +570,27 @@ class _TpeKernel:
 
         lpb = log_post(below)
         lpa = log_post(above)
-        g = self._constrain_cand(
-            jax.random.gumbel(key, (d, self.n_cand, kmax),
-                              dtype=jnp.float32), axis=1)
-        cand = jnp.argmax(lpb[:, None, :] + g, axis=-1)    # [D, n_cand]
+        if self.comp_sampler == "icdf":
+            # One uniform per candidate + a CDF-compare row instead of the
+            # Gumbel-argmax trick's [D, n_cand, kmax] draw — the same
+            # lowering choice (and env toggle, hence the same RNG-stream
+            # caveat) as gmm_sample's component pick.  icdf_pick handles
+            # the float32 pad guards (options past a column's n_options
+            # carry zero posterior mass).
+            from .ops.gmm import icdf_pick
+
+            cdf = jnp.cumsum(jnp.exp(lpb), axis=1)         # [D, kmax]
+            u = self._constrain_cand(
+                jax.random.uniform(key, (d, self.n_cand),
+                                   dtype=jnp.float32), axis=1)
+            cand = icdf_pick(
+                u, cdf,
+                jnp.asarray(self.cat_nopts, jnp.int32)[:, None] - 1)
+        else:
+            g = self._constrain_cand(
+                jax.random.gumbel(key, (d, self.n_cand, kmax),
+                                  dtype=jnp.float32), axis=1)
+            cand = jnp.argmax(lpb[:, None, :] + g, axis=-1)  # [D, n_cand]
         score = (jnp.take_along_axis(lpb, cand, axis=1)
                  - jnp.take_along_axis(lpa, cand, axis=1))
         return cand.astype(jnp.float32) + self.cat_offsets[:, None], score
@@ -753,7 +809,7 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
     # Env toggles baked into the traced program all key the cache —
     # a mid-process toggle must produce a fresh kernel, never a stale one.
     k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
-         _pallas_mode(), _comp_sampler(), _pallas_tile())
+         _pallas_mode(), _comp_sampler(), _pallas_tile(), _split_impl())
     if k not in cache:
         cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split, multivariate,
                               cat_prior)

@@ -271,6 +271,114 @@ class TestGmmSample:
         assert p > 0.01, (d, p)
 
 
+class TestSplitImpl:
+    """The top-k γ-split lowering is bit-identical to the double-argsort
+    rank lowering (ties break by trial index in both), so the default flip
+    (HYPEROPT_TPU_SPLIT_IMPL) cannot move the quality canary."""
+
+    @staticmethod
+    def _both(loss, ok, gamma, lf, split):
+        from types import SimpleNamespace
+
+        out = []
+        for impl in ("sort", "topk"):
+            k = SimpleNamespace(lf=lf, split=split, split_impl=impl)
+            below, above = tpe._TpeKernel._split(
+                k, jnp.asarray(loss, jnp.float32), jnp.asarray(ok), gamma)
+            out.append((np.asarray(below), np.asarray(above)))
+        return out
+
+    @pytest.mark.parametrize("split", ["sqrt", "quantile"])
+    @pytest.mark.parametrize("seed", range(4))
+    def test_parity_random_with_ties(self, split, seed):
+        rng = np.random.default_rng(seed)
+        n_cap = 64
+        n_ok = int(rng.integers(1, n_cap))
+        # Draws from a small integer set force heavy loss ties.
+        loss = np.full(n_cap, np.inf, np.float32)
+        loss[:n_ok] = rng.integers(0, 6, n_ok).astype(np.float32)
+        ok = np.zeros(n_cap, bool)
+        ok[:n_ok] = True
+        for gamma in (0.15, 0.25, 0.9):
+            for lf in (3, 25, 100):
+                (b0, a0), (b1, a1) = self._both(loss, ok, gamma, lf, split)
+                np.testing.assert_array_equal(b0, b1)
+                np.testing.assert_array_equal(a0, a1)
+                assert not np.any(b1 & a1)
+                assert np.array_equal(b1 | a1, ok)
+
+    def test_below_is_the_k_smallest(self):
+        loss = np.asarray([5, 1, 3, 2, 4, np.inf, np.inf], np.float32)
+        ok = np.asarray([1, 1, 1, 1, 1, 0, 0], bool)
+        # quantile split, gamma=0.5: n_below = ceil(0.5*5) = 3 -> {1,2,3}.
+        (b0, _), (b1, _) = self._both(loss, ok, 0.5, 25, "quantile")
+        np.testing.assert_array_equal(
+            b1, np.asarray([0, 1, 1, 1, 0, 0, 0], bool))
+        np.testing.assert_array_equal(b0, b1)
+
+
+class TestCatIcdfSampler:
+    def test_icdf_matches_gumbel_frequencies(self, monkeypatch):
+        """HYPEROPT_TPU_COMP_SAMPLER=icdf also lowers the categorical
+        candidate draw (one uniform + CDF compares instead of the
+        [D, n_cand, kmax] Gumbel trick); the induced candidate distribution
+        is unchanged (two-sample χ² across lowerings)."""
+        cs = compile_space({"c": hp.choice("c", list(range(5)))})
+        rng = np.random.default_rng(0)
+        n = 40
+        vals = rng.integers(0, 5, (n, 1)).astype(np.float32)
+        active = np.ones((n, 1), bool)
+        loss = (vals[:, 0] % 3).astype(np.float32)   # non-uniform posterior
+        ok = np.ones(n, bool)
+        args = (jnp.asarray(vals), jnp.asarray(active),
+                jnp.asarray(loss), jnp.asarray(ok))
+
+        def draws(impl):
+            monkeypatch.setenv("HYPEROPT_TPU_COMP_SAMPLER", impl)
+            kern = tpe._TpeKernel(cs, n_cap=64, n_cand=4000, lf=25)
+            below, above = kern._split(args[2], args[3], np.float32(0.25))
+            cv, _ = kern._cat_scores(jax.random.key(7), args[0], args[1],
+                                     below, above, np.float32(1.0))
+            return np.asarray(cv)[0].astype(int)
+
+        cg, ci = draws("gumbel"), draws("icdf")
+        assert ci.min() >= 0 and ci.max() <= 4
+        fg = np.bincount(cg, minlength=5)
+        fi = np.bincount(ci, minlength=5)
+        tab = np.stack([fg, fi])
+        tab = tab[:, tab.sum(axis=0) > 0]
+        _, p, _, _ = stats.chi2_contingency(tab)
+        assert p > 0.01, (fg, fi, p)
+
+    def test_icdf_never_picks_padded_options(self, monkeypatch):
+        """Mixed-cardinality space (kmax > n_options for one column): the
+        float32 CDF can saturate below 1, so an unscaled near-1 uniform
+        would land on a zero-mass padded option; the u·total scaling (and
+        one-ULP clamp) must keep every pick inside the column's range."""
+        monkeypatch.setenv("HYPEROPT_TPU_COMP_SAMPLER", "icdf")
+        cs = compile_space({"small": hp.choice("small", [0, 1]),
+                            "wide": hp.choice("wide", list(range(7)))})
+        rng = np.random.default_rng(3)
+        n = 48
+        vals = np.stack([rng.integers(0, 2, n),
+                         rng.integers(0, 7, n)], axis=1).astype(np.float32)
+        active = np.ones((n, 2), bool)
+        loss = rng.normal(size=n).astype(np.float32)
+        ok = np.ones(n, bool)
+        kern = tpe._TpeKernel(cs, n_cap=64, n_cand=8000, lf=25)
+        below, above = kern._split(jnp.asarray(loss), jnp.asarray(ok),
+                                   np.float32(0.25))
+        cv, score = kern._cat_scores(jax.random.key(11), jnp.asarray(vals),
+                                     jnp.asarray(active), below, above,
+                                     np.float32(1.0))
+        cv = np.asarray(cv)
+        # cat rows follow kern.cat_pids order; find the 'small' row.
+        si = [p.pid for p in cs.params if p.label == "small"][0]
+        row = list(kern.cat_pids).index(si)
+        assert cv[row].max() <= 1.0 and cv[row].min() >= 0.0
+        assert np.isfinite(np.asarray(score)).all()
+
+
 # ---------------------------------------------------------------------------
 # suggest API behavior
 # ---------------------------------------------------------------------------

@@ -66,6 +66,10 @@ def _algo_table(ho):
         # throughput win.  A table value may be {"algo": ..., "fmin": {...}}
         # to carry fmin kwargs.
         "tpe_q8": {"algo": ho.tpe.suggest, "fmin": {"max_queue_len": 8}},
+        # Deeper batch: 32 proposals per refit.  The throughput ceiling row
+        # (bench.py trials_per_sec_q32) is only honest if quality holds at
+        # the same trial budget under a 4x longer fantasy chain.
+        "tpe_q32": {"algo": ho.tpe.suggest, "fmin": {"max_queue_len": 32}},
     }
 
 

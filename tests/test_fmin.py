@@ -323,6 +323,27 @@ def test_max_queue_len_batched_tpe():
     assert q1(best) < 1.0
 
 
+def test_max_queue_len_deep_batch_q32():
+    """The bench's trials_per_sec_q32 path: a 32-deep liar scan (startup
+    routes the whole first 32-id enqueue through random draws, then full
+    m=32 batches).  Pins batch diversity and exact trial count at the
+    deeper queue — the 4x-throughput mode must not silently collapse."""
+    from functools import partial
+
+    trials = ht.Trials()
+    algo = partial(ht.tpe.suggest, n_startup_jobs=8, n_EI_candidates=32)
+    ht.fmin(q1, SPACE1, algo=algo, max_evals=96, max_queue_len=32,
+            trials=trials, rstate=np.random.default_rng(0),
+            show_progressbar=False)
+    assert len(trials) == 96
+    xs_all = [d["misc"]["vals"]["x"][0] for d in trials.trials]
+    # Post-startup batches: 32 distinct proposals spanning the domain.
+    for lo in (32, 64):
+        batch = xs_all[lo:lo + 32]
+        assert len(set(batch)) == 32
+        assert max(batch) - min(batch) > 2.0
+
+
 def test_max_queue_len_partial_final_batch():
     """max_evals not a multiple of max_queue_len: the final partial batch
     reuses the compiled full-batch program (rounded up + sliced) and the

@@ -17,8 +17,9 @@ be attributed:
                  (attributes generator cost independent of the math around it)
   ``full``       the shipped program (pallas default) — equals bench.py value
   ``full_xla``   same with HYPEROPT_TPU_PALLAS=0
-  ``full_icdf``  same with HYPEROPT_TPU_COMP_SAMPLER=icdf (iCDF component +
-                 categorical draws, see ops/gmm.py::_comp_sampler)
+  ``full_gumbel``  same with HYPEROPT_TPU_COMP_SAMPLER=gumbel (the pre-r4
+                 default; icdf component + categorical draws ship as the
+                 default, see ops/gmm.py::_comp_sampler)
   ``split_sort`` / ``full_sortsplit``  the round-3 double-argsort γ-split
                  (HYPEROPT_TPU_SPLIT_IMPL=sort) vs the shipped top-k split
 
@@ -192,12 +193,16 @@ def child():
     n_cont = sum(len(g) for g in kern.groups)
     d, kmax = len(kern.cat_pids), kern.cat_kmax
 
+    # Mirrors the SHIPPED (icdf-default) draw shapes: two uniforms per
+    # continuous candidate (component pick + truncated-normal u) and one
+    # per categorical candidate.  (The gumbel lowering would add a kmax
+    # factor on the categorical tensor.)
     def rng_bits(k_):
         ks = jax.random.split(k_, n_cont + 1)
         u = jax.vmap(lambda kk: jax.random.uniform(
             kk, (2, N_CAND), dtype=jnp.float32))(ks[:-1])
-        gmb = jax.random.gumbel(ks[-1], (d, N_CAND, kmax), dtype=jnp.float32)
-        return u.sum() + gmb.sum()
+        uc = jax.random.uniform(ks[-1], (d, N_CAND), dtype=jnp.float32)
+        return u.sum() + uc.sum()
 
     stage("rng_bits", rng_bits, (key,))
 
@@ -209,11 +214,10 @@ def child():
         stage("full_xla", kx._suggest_one, (key, hv, ha, hl, hok, gamma, pw))
         os.environ["HYPEROPT_TPU_PALLAS"] = "1"
 
-    # Candidate optimization A/B: inverse-CDF component pick in gmm_sample
-    # (one uniform per draw + CDF compares vs the gumbel trick's n*K draws
-    # + logs).  Same distribution, different RNG stream — flipping the
-    # default is a canary re-baselining decision; this stage records
-    # whether it's worth it.
+    # Sampler-lowering A/B: the shipped icdf default vs the pre-r4 gumbel
+    # lowering (n*K draws + logs per component pick).  Same distribution,
+    # different RNG stream; the flip decision is recorded in DESIGN.md §6
+    # and this stage keeps re-validating it per backend.
     from contextlib import contextmanager
 
     @contextmanager
@@ -231,11 +235,11 @@ def child():
             else:
                 os.environ[name] = saved
 
-    with env_override("HYPEROPT_TPU_COMP_SAMPLER", "icdf"):
+    with env_override("HYPEROPT_TPU_COMP_SAMPLER", "gumbel"):
         ki = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
-        stage("full_icdf", ki._suggest_one,
+        stage("full_gumbel", ki._suggest_one,
               (key, hv, ha, hl, hok, gamma, pw))
-        stage("fit_draw_icdf", fit_draw_for(ki), (key, hv, ha, hl, hok))
+        stage("fit_draw_gumbel", fit_draw_for(ki), (key, hv, ha, hl, hok))
 
     # γ-split lowering A/B: the shipped top-k split (the `split`/`full`
     # stages above) vs the round-3 double-argsort rank.  Outputs are

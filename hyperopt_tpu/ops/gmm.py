@@ -7,8 +7,10 @@ upstream).  Design differences, TPU-first:
 * The reference *samples* truncated mixtures by per-draw Python rejection
   loops (``GMM1``: redraw until in bounds).  Rejection is data-dependent
   control flow — hostile to XLA — so sampling here is **inverse-CDF**:
-  component via Gumbel-argmax, then ``u ~ U[Φ(a), Φ(b)]`` → ``ndtri(u)``.
-  Exact truncated sampling, fixed shapes, no loops.
+  component via a CDF compare on one uniform (``_comp_sampler``; the
+  Gumbel-argmax lowering remains selectable), then
+  ``u ~ U[Φ(a), Φ(b)]`` → ``ndtri(u)``.  Exact truncated sampling, fixed
+  shapes, no loops.
 
 * Scoring works on whole candidate batches: ``[n_cand]`` candidates ×
   ``[K]`` components broadcast to one ``[n_cand, K]`` logsumexp — the
@@ -105,22 +107,30 @@ def gmm_log_qmass(zl, zh, logw, mu, sigma, trunc_lo=-jnp.inf,
 
 
 def _comp_sampler() -> str:
-    """Component-selection lowering for :func:`gmm_sample`.
+    """Component-selection lowering for :func:`gmm_sample` and the TPE
+    categorical candidate draw.
 
-    ``HYPEROPT_TPU_COMP_SAMPLER``: ``gumbel`` (default) uses
-    ``jax.random.categorical`` — the Gumbel-argmax trick, which generates
-    ``n·K`` uniforms plus two logs each; ``icdf`` draws ONE uniform per
-    sample and picks the component by CDF comparison — ``O(n)`` generator
-    work plus ``n·K`` compares, an identical distribution lowered with
-    ~K× fewer transcendentals.  Opt-in until an on-chip A/B shows a win
-    (profile_step.py measures both): flipping it changes the RNG stream,
-    so proposals (and the cross-round `tpe` quality canary) shift —
-    that's a re-baselining decision, not a silent default change.
+    ``HYPEROPT_TPU_COMP_SAMPLER``: ``icdf`` (default) draws ONE uniform
+    per sample and picks the component by CDF comparison — ``O(n)``
+    generator work plus ``n·K`` compares; ``gumbel`` uses
+    ``jax.random.categorical`` — the Gumbel-argmax trick, ``n·K``
+    uniforms plus two logs each.  Identical distributions (KS/χ²-pinned,
+    ``tests/test_tpe.py``), different RNG streams.
+
+    Default flipped gumbel→icdf 2026-07-31 (round 4) on measured
+    evidence: on-chip neutral (15.43 vs 15.37 ms `full_icdf` vs `full`,
+    `profile_step_tpu_20260731_1912.json` — a valid comparison, both
+    stages fetch tiny outputs) and ~1.6× on the CPU step (15.0→9.2 ms at
+    128 cand; the CPU host-loop floor is compute-bound, so the flip
+    raises it directly).  The flip shifts every seeded proposal stream:
+    the cross-round ``tpe`` quality-table canary re-baselines at this
+    commit (documented in DESIGN.md §6; the r2/r3 bit-identical chain
+    ends here, ``gumbel`` remains selectable to reproduce it).
     """
     import os
 
-    env = os.environ.get("HYPEROPT_TPU_COMP_SAMPLER", "gumbel")
-    return env if env in ("gumbel", "icdf") else "gumbel"
+    env = os.environ.get("HYPEROPT_TPU_COMP_SAMPLER", "icdf")
+    return env if env in ("gumbel", "icdf") else "icdf"
 
 
 def icdf_pick(u, cdf, last):

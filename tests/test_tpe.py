@@ -242,10 +242,11 @@ class TestGmmSample:
         assert p > 0.01, (d, p)
 
     def test_icdf_component_sampler_same_distribution(self, monkeypatch):
-        """HYPEROPT_TPU_COMP_SAMPLER=icdf is a lowering change, not a
-        semantics change: component frequencies match the weights (incl.
-        zero-weight padding never picked) and the samples pass the same
-        truncated-mixture KS test as the default gumbel path."""
+        """HYPEROPT_TPU_COMP_SAMPLER=icdf (the default since r4) is a
+        lowering change, not a semantics change: component frequencies
+        match the weights (incl. zero-weight padding never picked) and
+        the samples pass the same truncated-mixture KS test as the
+        gumbel lowering."""
         monkeypatch.setenv("HYPEROPT_TPU_COMP_SAMPLER", "icdf")
         w = np.array([0.6, 0.4, 0.0], np.float32)       # padded component
         mu = np.array([-1.0, 2.0, 50.0], np.float32)

@@ -17,8 +17,14 @@ Why you'd use it:
   worker a genuinely different point to try.
 
 Quality holds at equal budgets: the recorded A/B
-(``benchmarks/quality_ab_latest.json``) has batched TPE tying or beating
-sequential on 3 of 4 zoo domains.
+(``benchmarks/quality_ab_tpe_vs_tpe_q8.json``) has batched TPE tying or
+beating sequential on 3 of 4 zoo domains, and on-chip the K=8 batch ran
+8.2× the unbatched trial rate through a high-RTT attachment
+(``benchmarks/bench_20260731_1904.json``).  Deeper batches trade quality
+for throughput: ``max_queue_len=32`` measured 1 better / 3 modestly
+worse of 4 domains (``quality_ab_tpe_vs_tpe_q8_vs_tpe_q32.json``) — use
+K=8 as the quality-neutral setting and K=32 when raw trials/sec through
+a slow link is the objective.
 
 Run: python examples/09_batched_suggest.py
 """

@@ -58,13 +58,9 @@ git add benchmarks/bench_${STAMP}.json benchmarks/profile_step_*.json \
 git commit -m "TPU window ${STAMP}: harvest bench + profile + suite rows" \
     >>"$LOG" 2>&1 || say "git commit failed (builder may hold the lock) — artifacts left staged"
 
-# Restart the PROBE loop only (track wedge recovery in the log) — never a
-# recursive harvest: chip time after a window should stay free so the
-# driver's round-end bench capture finds a healthy, unclaimed tunnel.
-say "restarting probe loop (probe-only, no auto-harvest)"
-nohup bash benchmarks/tpu_probe.sh /tmp/tpu_probe_post.log 300 140 \
-    > /dev/null 2>&1 &
-
+# Restart the PROBE loop only (track wedge recovery) — never a recursive
+# harvest: chip time after a window should stay free so the driver's
+# round-end bench capture finds a healthy, unclaimed tunnel.
 say "restarting probe loop"
 nohup bash benchmarks/tpu_probe.sh /tmp/tpu_probe_next.log 600 120 \
   > /dev/null 2>&1 &

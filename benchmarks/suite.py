@@ -352,6 +352,31 @@ def bench_5s_100k_sweep_sharded():
 def main(argv=None):
     which = set(argv or sys.argv[1:])
 
+    # Claim-free preflight (same contract as bench.py): when this process
+    # would attach to the axon TPU tunnel, probe it with a DISPOSABLE
+    # subprocess first and bail out cleanly if it's wedged — today's
+    # stage-3 run burned its entire 50-minute timeout blocked inside a
+    # wedged jax.devices() and then took a mid-claim SIGTERM, the
+    # documented wedge-extender.  CPU-forced runs (tests, dev loops) skip
+    # the probe entirely.
+    first_platform = (os.environ.get("JAX_PLATFORMS", "axon").lower()
+                      .split(",")[0].strip() or "axon")
+    if (first_platform not in ("cpu", "")
+            and os.environ.get("HYPEROPT_TPU_BENCH_PREFLIGHT") != "0"):
+        import bench
+
+        def _log(msg):
+            print(f"# preflight: {msg}", file=sys.stderr, flush=True)
+
+        if bench._preflight(_log) is None:
+            print(json.dumps({"metric": "suite_preflight",
+                              "error": "tpu_tunnel_wedged",
+                              "skipped": sorted(which) or ["all"]}),
+                  flush=True)
+            # Nonzero so automation can't mistake a no-op for a run
+            # (results_latest.json is left untouched).
+            sys.exit(3)
+
     def want(k):
         return not which or k in which
 

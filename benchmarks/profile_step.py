@@ -260,6 +260,40 @@ def child():
                 stage(f"full_tile{t}", kt._suggest_one,
                       (key, hv, ha, hl, hok, gamma, pw))
 
+    # Device-resident loop: 64 suggest steps inside ONE compiled program
+    # (lax.fori_loop, key folded per iteration, outputs reduced into the
+    # carry so nothing is dead-code-eliminated).  One dispatch + one
+    # 4-byte fetch — so per-step time here contains ZERO tunnel overhead
+    # of any kind.  This is the discriminating measurement the k-sweep
+    # cannot make: back-to-back dispatches amortize the per-FETCH sync
+    # but cannot rule out per-DISPATCH gaps the tunnel inserts between
+    # programs.  loop64 ≈ k-sweep intercept ⇒ the intercept is real
+    # device compute; loop64 ≪ intercept ⇒ the step is dispatch-bound
+    # through the tunnel and the kernel itself has that much headroom.
+    # Deliberately NOT a stage(): device_loop64 is a top-level result key
+    # with its own shape (ms_per_step, not steady/oneshot) because it is
+    # an overhead-free measurement, not another program variant — folding
+    # it into result["stages"] would invite apples-to-oranges reads.
+    _say("phase", {"name": "device_loop"})
+    try:
+        def loop64(k_, v, a, l, o):
+            def body(i, acc):
+                row, act = kern._suggest_one(
+                    jax.random.fold_in(k_, i), v, a, l, o, gamma, pw)
+                return acc + jnp.sum(row) + jnp.sum(act)
+
+            return jax.lax.fori_loop(0, 64, body, jnp.float32(0.0))
+
+        steady, oneshot = _steady(jax.jit(loop64),
+                                  (key, hv, ha, hl, hok), reps=1, k=2)
+        result["device_loop64"] = {
+            "ms_per_step": round(steady / 64, 3),   # ~F/128 fetch bias only
+            "total_oneshot_ms": round(oneshot, 2)}
+        _say("partial", result)
+    except Exception as e:
+        result["device_loop64"] = {"error": f"{type(e).__name__}: {e}"}
+        _say("partial", result)
+
     # k-sweep on the SAME compiled full program: per-step time vs the
     # number of back-to-back dispatches per fetch.  If time/step keeps
     # falling as k grows, the "steady state" at k=32 still carries

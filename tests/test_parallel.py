@@ -283,6 +283,46 @@ class TestNetStore:
         finally:
             srv.shutdown()
 
+    def test_net_server_restart_preserves_state(self, tmp_path):
+        """Durability across server restarts (the mongod-restart analog):
+        every document, attachment, and the published domain live on the
+        server's disk, so a NEW StoreServer on the same root — and a
+        fresh client against its (new) URL — sees the full experiment and
+        the queue keeps draining."""
+        from hyperopt_tpu.parallel import NetTrials, NetWorker
+
+        srv = self._server(tmp_path)
+        try:
+            dom = Domain(_quad, _quad_space())
+            nt = NetTrials(srv.url, exp_key="e1")
+            nt.save_domain(dom)
+            nt.attachments["meta"] = {"tag": 7}
+            docs = rand.suggest(nt.new_trial_ids(6), dom, nt, 0)
+            nt.insert_trial_docs(docs)
+            # Drain half before the "crash".
+            w = NetWorker(srv.url, exp_key="e1", domain=dom,
+                          poll_interval=0.01, reserve_timeout=0.2)
+            for _ in range(3):
+                assert w.run_one() is True
+        finally:
+            srv.shutdown()
+
+        srv2 = self._server(tmp_path)        # same root, fresh port
+        try:
+            nt2 = NetTrials(srv2.url, exp_key="e1")
+            assert len(nt2) == 6
+            done = [d for d in nt2 if d["state"] == JOB_STATE_DONE]
+            assert len(done) == 3
+            assert nt2.attachments["meta"] == {"tag": 7}
+            assert nt2.load_domain().cs.n_params == dom.cs.n_params
+            w2 = NetWorker(srv2.url, exp_key="e1", domain=dom,
+                           poll_interval=0.01, reserve_timeout=0.2)
+            w2.run()
+            nt2.refresh()
+            assert all(d["state"] == JOB_STATE_DONE for d in nt2)
+        finally:
+            srv2.shutdown()
+
     def test_net_domain_and_attachments(self, tmp_path):
         from hyperopt_tpu.parallel import NetTrials
 

@@ -64,6 +64,7 @@ PHASE_DEADLINES = {
     "xla_full": 900.0,
     "pallas_ab": 600.0,
     "trials_sec": 420.0,
+    "device_fmin": 600.0,
     "cpu_ref": 300.0,
     "result": 60.0,
 }
@@ -319,6 +320,41 @@ def child():
             _say("partial", partial)
     except Exception as e:
         partial["trials_sec_error"] = f"{type(e).__name__}: {e}"
+        _say("partial", partial)
+
+    # Device-resident fmin (hyperopt_tpu/device.py): the ENTIRE optimize
+    # loop — startup, every suggest, every (jax-traceable) objective
+    # eval, every insert — as one lax.fori_loop program.  One dispatch +
+    # one fetch per RUN, so this measures the loop with zero per-trial
+    # tunnel involvement: the rate local-attachment users get, and the
+    # framework's e2e ceiling.  First call compiles; the second is the
+    # steady-state number.
+    _say("phase", {"name": "device_fmin"})
+    try:
+        import jax.numpy as jnp
+        import hyperopt_tpu as ho_d   # self-contained: do not depend on
+                                      # names bound inside the trials_sec
+                                      # try block (it may have failed)
+
+        cs_dev = compile_space(_flagship_space(10))   # memoized
+
+        def dev_obj(p):
+            return p["u0"] ** 2 + jnp.abs(p["n0"]) + p["c0"] * 0.1
+
+        n_ev = 128 if fast else 512
+        n_cand_dev = 128 if fast else 1024
+        ho_d.fmin_device(dev_obj, cs_dev, max_evals=n_ev, seed=0,
+                         n_EI_candidates=n_cand_dev)      # compile + run
+        t0 = time.perf_counter()
+        _, dinfo = ho_d.fmin_device(dev_obj, cs_dev, max_evals=n_ev,
+                                    seed=1, n_EI_candidates=n_cand_dev)
+        dt = time.perf_counter() - t0
+        partial["device_fmin_trials_per_sec"] = round(n_ev / dt, 1)
+        partial["device_fmin_evals"] = n_ev
+        partial["device_fmin_best_loss"] = round(dinfo["best_loss"], 4)
+        _say("partial", partial)
+    except Exception as e:
+        partial["device_fmin_error"] = f"{type(e).__name__}: {e}"
         _say("partial", partial)
 
     # CPU reference (the >=100x denominator): the reference-architecture

@@ -72,6 +72,15 @@ def _variant_space(name):
     if name == "branin":
         return {"x": hp.uniform("x", -5.5, 10.5),
                 "y": hp.uniform("y", -0.5, 15.5)}
+    if name == "gauss_wave2":
+        # Shifted bounds + widened amp range: different fingerprint,
+        # near-identical structural features (1 uniform + a 2-way choice
+        # gating another uniform).
+        return {"x": hp.uniform("x", -5.5, 5.5),
+                "curve": hp.choice("curve", [
+                    {"kind": "plain"},
+                    {"kind": "cos", "amp": hp.uniform("amp", 0.4, 2.2)},
+                ])}
     if name == "many_dists":
         return {
             "a": hp.choice("a", [0, 1, 2]),
@@ -96,7 +105,8 @@ def _variant_space(name):
     raise KeyError(name)
 
 
-CROSS_DOMAINS = {"branin": 30, "many_dists": 20}   # starved exp2 budgets
+CROSS_DOMAINS = {"branin": 30, "many_dists": 20,   # starved exp2 budgets
+                 "gauss_wave2": 25}
 
 
 def cross_main():

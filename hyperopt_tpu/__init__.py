@@ -72,6 +72,7 @@ from .parallel import FileTrials, PoolTrials  # noqa: F401 — the reference
 # exports its distributed Trials at top level too (hyperopt.SparkTrials;
 # SURVEY.md §2 package/CLI row): PoolTrials ≙ SparkTrials (local parallel
 # evaluation), FileTrials ≙ MongoTrials (durable elastic workers).
+from .device import fmin_device  # noqa: F401 — device-resident loop
 from .space import Apply, CompiledSpace, compile_space  # noqa: F401
 from .utils import parameter_importance  # noqa: F401
 from .utils.early_stop import no_progress_loss  # noqa: F401
@@ -79,7 +80,8 @@ from .utils.early_stop import no_progress_loss  # noqa: F401
 __version__ = "0.1.0"
 
 __all__ = [
-    "fmin", "FMinIter", "fmin_pass_expr_memo_ctrl", "space_eval",
+    "fmin", "fmin_device", "FMinIter", "fmin_pass_expr_memo_ctrl",
+    "space_eval",
     "generate_trials_to_calculate",
     "partial", "hp", "tpe", "rand", "anneal", "mix", "atpe", "qmc",
     "criteria", "rdists", "plotting", "graphviz", "scope", "pyll",

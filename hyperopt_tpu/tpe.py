@@ -243,6 +243,20 @@ class _ContGroup:
         return len(self.pids)
 
 
+def _insert_row(hv, ha, hl, hok, idx, row, act, loss):
+    """Insert one trial at cursor ``idx`` of the padded history buffers.
+
+    Shared by the constant-liar scan (fantasy losses) and the
+    device-resident fmin loop (real losses) so the two fused paths
+    cannot drift in insertion semantics."""
+    hv = jax.lax.dynamic_update_slice(hv, row[None, :], (idx, 0))
+    ha = jax.lax.dynamic_update_slice(ha, act[None, :], (idx, 0))
+    hl = jax.lax.dynamic_update_slice(
+        hl, jnp.asarray(loss, hl.dtype).reshape((1,)), (idx,))
+    hok = jax.lax.dynamic_update_slice(hok, jnp.ones((1,), bool), (idx,))
+    return hv, ha, hl, hok
+
+
 class _TpeKernel:
     """One jitted TPE suggest step for a fixed (space, N-bucket, n_cand, LF).
 
@@ -691,12 +705,8 @@ class _TpeKernel:
             hv, ha, hl, hok, idx = carry
             row, act = self._suggest_one(key_i, hv, ha, hl, hok,
                                          gamma, prior_weight)
-            hv = jax.lax.dynamic_update_slice(hv, row[None, :], (idx, 0))
-            ha = jax.lax.dynamic_update_slice(ha, act[None, :], (idx, 0))
-            hl = jax.lax.dynamic_update_slice(
-                hl, jnp.full((1,), lie, hl.dtype), (idx,))
-            hok = jax.lax.dynamic_update_slice(
-                hok, jnp.ones((1,), bool), (idx,))
+            hv, ha, hl, hok = _insert_row(hv, ha, hl, hok, idx, row, act,
+                                          lie)
             return (hv, ha, hl, hok, idx + 1), (row, act)
 
         carry = (vals, active, loss, ok, n_rows.astype(jnp.int32))

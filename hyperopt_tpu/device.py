@@ -90,7 +90,8 @@ def fmin_device(fn, space, max_evals, seed=0,
                 gamma=_default_gamma,
                 prior_weight=_default_prior_weight,
                 linear_forgetting=_default_linear_forgetting,
-                split="sqrt", multivariate=False, cat_prior=None):
+                split="sqrt", multivariate=False, cat_prior=None,
+                mesh=None):
     """Run ``max_evals`` trials of TPE entirely on device; see module doc.
 
     Returns ``(best, info)`` where ``best`` is the reference-style
@@ -109,8 +110,24 @@ def fmin_device(fn, space, max_evals, seed=0,
         raise ValueError("max_evals must be >= 1")
     n0 = min(int(n_startup_jobs), max_evals)
     n_cap = _bucket(max_evals)
-    kern = get_kernel(cs, n_cap, int(n_EI_candidates),
-                      int(linear_forgetting), split, multivariate, cat_prior)
+    if mesh is not None:
+        # Candidate-axis sharding inside every suggest step: the same
+        # ShardedTpeKernel constraints parallel.sharded_suggest uses, with
+        # the loop still one program — per-step EI sweeps ride ICI, the
+        # argmax reduces across devices, and the sequential trial chain
+        # stays device-resident.
+        from .parallel.sharded import _get_sharded_kernel, _mesh_key
+
+        kern = _get_sharded_kernel(cs, n_cap, int(n_EI_candidates),
+                                   int(linear_forgetting), mesh, split,
+                                   multivariate=multivariate,
+                                   cat_prior=cat_prior)
+        mesh_k = _mesh_key(mesh)
+    else:
+        kern = get_kernel(cs, n_cap, int(n_EI_candidates),
+                          int(linear_forgetting), split, multivariate,
+                          cat_prior)
+        mesh_k = None
     eval_one = _wrap_objective(fn, cs)
 
     cache = getattr(cs, "_device_fmin_cache", None)
@@ -123,7 +140,7 @@ def fmin_device(fn, space, max_evals, seed=0,
     cache_key = (id(fn), max_evals, n0, n_cap, int(n_EI_candidates),
                  float(gamma), float(prior_weight), int(linear_forgetting),
                  split, multivariate, kern.cat_prior, kern.comp_sampler,
-                 kern.split_impl, kern.pallas)
+                 kern.split_impl, kern.pallas, mesh_k)
     run = cache.get(cache_key)
     if run is not None:
         cache.move_to_end(cache_key)

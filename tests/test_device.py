@@ -111,6 +111,27 @@ class TestFminDevice:
         assert info["losses"].shape == (10,)
         assert np.isfinite(info["losses"]).all()
 
+    def test_sharded_mesh_loop(self):
+        """fmin_device(mesh=): sharding is an execution-layout change,
+        not a semantics change — the mesh path must produce the
+        BIT-IDENTICAL trial sequence of the single-device path (same
+        seed, same candidate count), with the candidate axis merely
+        partitioned over the mesh's `sp` axis."""
+        from hyperopt_tpu.parallel.sharded import CAND_AXIS, default_mesh
+
+        mesh = default_mesh()
+        n_cand = 64 * mesh.shape[CAND_AXIS]
+        best_m, info_m = ho.fmin_device(_branin, BRANIN_SPACE,
+                                        max_evals=60, seed=1,
+                                        n_EI_candidates=n_cand, mesh=mesh)
+        best_s, info_s = ho.fmin_device(_branin, BRANIN_SPACE,
+                                        max_evals=60, seed=1,
+                                        n_EI_candidates=n_cand)
+        np.testing.assert_array_equal(info_m["losses"], info_s["losses"])
+        np.testing.assert_array_equal(info_m["vals"], info_s["vals"])
+        assert best_m == best_s
+        assert np.isfinite(info_m["losses"]).all()
+
     def test_matches_host_fmin_family(self):
         """Statistical parity with the host loop: same algorithm, same
         budget — medians of best-loss land in the same family (host TPE

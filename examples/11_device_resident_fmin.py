@@ -1,0 +1,73 @@
+"""Device-resident fmin: the whole optimize loop as ONE compiled program.
+
+The classic ``fmin`` loop is host-driven: every trial pays a host↔device
+round trip (suggest fetch + result insert).  Locally that costs
+~a millisecond; through a remote accelerator attachment it is the whole
+budget (~85 ms/trial measured through a tunneled TPU — the loop ceiling
+no kernel speedup can move).
+
+When the objective is JAX-traceable, ``fmin_device`` removes the loop
+from the host entirely: startup sampling, every TPE suggest, every
+objective evaluation, and every history insert compile into a single
+``lax.fori_loop`` program.  One dispatch, one fetch, ``max_evals``
+trials.  Measured on this repo's 1-core CPU backend: ~4700 trials/s vs
+~1600/s for the host loop at the same config — and on an accelerator the
+gap is the entire per-trial sync.
+
+The objective receives a FLAT ``{label: f32 scalar}`` dict (a second
+positional arg receives the activity mask for conditional spaces) and
+must branch with ``jnp.where`` / ``lax.cond``, not Python ``if``.
+
+Run: python examples/11_device_resident_fmin.py
+"""
+
+import math
+import time
+
+import jax.numpy as jnp
+
+import hyperopt_tpu as ho
+from hyperopt_tpu import hp
+
+
+def branin(p):
+    x, y = p["x"], p["y"]
+    return ((y - 5.1 / (4 * math.pi ** 2) * x ** 2 + 5 / math.pi * x - 6)
+            ** 2 + 10 * (1 - 1 / (8 * math.pi)) * jnp.cos(x) + 10)
+
+
+space = {"x": hp.uniform("x", -5, 10), "y": hp.uniform("y", 0, 15)}
+
+# First call compiles the whole run; the program is cached on the space.
+best, info = ho.fmin_device(branin, space, max_evals=150, seed=0,
+                            n_EI_candidates=64)
+t0 = time.perf_counter()
+best, info = ho.fmin_device(branin, space, max_evals=150, seed=1,
+                            n_EI_candidates=64)
+dt = time.perf_counter() - t0
+print(f"best loss {info['best_loss']:.4f} at "
+      f"x={best['x']:.3f}, y={best['y']:.3f} "
+      f"({150 / dt:.0f} trials/s steady-state)")
+
+# Conditional space: the mask argument makes gating explicit.
+cond_space = {"model": hp.choice("model", [
+    {"kind": 0},                                  # plain
+    {"kind": 1, "lr": hp.loguniform("lr", -6, 0)},  # tunable
+])}
+
+
+def cond_obj(p, active):
+    tuned = jnp.abs(jnp.log(p["lr"]) + 3.0) * 0.3
+    return jnp.where(active["lr"], tuned, 1.0)
+
+
+best_c, info_c = ho.fmin_device(cond_obj, cond_space, max_evals=120,
+                                seed=0)
+print(f"conditional best loss {info_c['best_loss']:.4f}: {best_c}")
+
+# On a multi-chip mesh, the candidate axis of every suggest step shards
+# over ICI inside the same single program:
+#   from hyperopt_tpu.parallel import default_mesh
+#   mesh = default_mesh()
+#   ho.fmin_device(branin, space, max_evals=500, mesh=mesh,
+#                  n_EI_candidates=128 * mesh.shape["sp"])

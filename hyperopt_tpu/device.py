@@ -91,7 +91,7 @@ def fmin_device(fn, space, max_evals, seed=0,
                 prior_weight=_default_prior_weight,
                 linear_forgetting=_default_linear_forgetting,
                 split="sqrt", multivariate=False, cat_prior=None,
-                mesh=None):
+                mesh=None, init=None):
     """Run ``max_evals`` trials of TPE entirely on device; see module doc.
 
     Returns ``(best, info)`` where ``best`` is the reference-style
@@ -99,6 +99,14 @@ def fmin_device(fn, space, max_evals, seed=0,
     and ``info`` carries the full run history as host arrays:
     ``losses f32[max_evals]`` (trial order), ``vals f32[max_evals, P]``,
     ``active bool[max_evals, P]``, ``best_loss`` and ``best_index``.
+
+    ``init`` resumes from a prior run (the host loop's ``trials=``
+    analog): pass a previous ``info`` dict (or any
+    ``{"vals", "active", "losses"}`` arrays); those trials seed the
+    history and the loop continues to ``max_evals`` TOTAL trials.  If the
+    prior run is shorter than ``n_startup_jobs``, the startup phase
+    samples only the remainder.  The resumed segment uses this call's
+    ``seed`` for its key stream.
 
     The compiled program is cached on the space per
     ``(max_evals, tuning-kwargs)`` — a second call with the same shape
@@ -108,7 +116,26 @@ def fmin_device(fn, space, max_evals, seed=0,
     max_evals = int(max_evals)
     if max_evals < 1:
         raise ValueError("max_evals must be >= 1")
-    n0 = min(int(n_startup_jobs), max_evals)
+    if init is not None:
+        pv = np.asarray(init["vals"], np.float32)
+        pa = np.asarray(init["active"], bool)
+        pl = np.asarray(init["losses"], np.float32)
+        if pl.ndim != 1:
+            raise ValueError(
+                f"init['losses'] must be 1-D (trial order), got {pl.shape}")
+        n_prev = pl.shape[0]
+        if pv.shape != (n_prev, cs.n_params) or pa.shape != pv.shape:
+            raise ValueError("init arrays have inconsistent shapes for "
+                             f"this space: vals {pv.shape}, active "
+                             f"{pa.shape}, losses {pl.shape}")
+        if max_evals <= n_prev:
+            raise ValueError(
+                f"max_evals={max_evals} must exceed the {n_prev} trials "
+                "already in init (max_evals is the TOTAL, as in fmin)")
+    else:
+        n_prev = 0
+    # Startup draws still owed after the resumed history (if any).
+    n0 = min(max(int(n_startup_jobs) - n_prev, 0), max_evals - n_prev)
     n_cap = _bucket(max_evals)
     if mesh is not None:
         # Candidate-axis sharding inside every suggest step: the same
@@ -137,7 +164,8 @@ def fmin_device(fn, space, max_evals, seed=0,
     # identical code but different captured values trace to DIFFERENT
     # programs.  The cache entry keeps fn alive, so its id cannot be
     # recycled while the entry exists; eviction (below) releases both.
-    cache_key = (id(fn), max_evals, n0, n_cap, int(n_EI_candidates),
+    cache_key = (id(fn), max_evals, n0, n_prev, n_cap,
+                 int(n_EI_candidates),
                  float(gamma), float(prior_weight), int(linear_forgetting),
                  split, multivariate, kern.cat_prior, kern.comp_sampler,
                  kern.split_impl, kern.pallas, mesh_k)
@@ -149,15 +177,22 @@ def fmin_device(fn, space, max_evals, seed=0,
         pw_f = jnp.float32(prior_weight)
         p_dim = cs.n_params
 
-        def _run(seed32):
+        n_seeded = n_prev + n0   # rows present before the TPE loop starts
+
+        def _run(seed32, pv_, pa_, pl_):
             key = jax.random.key(seed32)
             k_start, k_loop = jax.random.split(key)
-            sv, sa = cs.sample_traced(k_start, n0)
-            sl = jax.vmap(eval_one)(sv, sa)
-            hv = jnp.zeros((n_cap, p_dim), jnp.float32).at[:n0].set(sv)
-            ha = jnp.zeros((n_cap, p_dim), bool).at[:n0].set(sa)
-            hl = jnp.full((n_cap,), jnp.inf, jnp.float32).at[:n0].set(sl)
-            hok = (jnp.arange(n_cap) < n0)
+            hv = jnp.zeros((n_cap, p_dim), jnp.float32).at[:n_prev].set(pv_)
+            ha = jnp.zeros((n_cap, p_dim), bool).at[:n_prev].set(pa_)
+            hl = jnp.full((n_cap,), jnp.inf,
+                          jnp.float32).at[:n_prev].set(pl_)
+            if n0:
+                sv, sa = cs.sample_traced(k_start, n0)
+                sl = jax.vmap(eval_one)(sv, sa)
+                hv = hv.at[n_prev:n_seeded].set(sv)
+                ha = ha.at[n_prev:n_seeded].set(sa)
+                hl = hl.at[n_prev:n_seeded].set(sl)
+            hok = (jnp.arange(n_cap) < n_seeded)
 
             def body(i, carry):
                 hv, ha, hl, hok = carry
@@ -168,14 +203,18 @@ def fmin_device(fn, space, max_evals, seed=0,
                 return _insert_row(hv, ha, hl, hok, i, row, act, loss)
 
             hv, ha, hl, hok = jax.lax.fori_loop(
-                n0, max_evals, body, (hv, ha, hl, hok))
+                n_seeded, max_evals, body, (hv, ha, hl, hok))
             return hv[:max_evals], ha[:max_evals], hl[:max_evals]
 
         run = cache[cache_key] = jax.jit(_run)
         while len(cache) > _RUN_CACHE_CAP:
             cache.popitem(last=False)
 
-    vals, active, losses = run(np.uint32(int(seed) % (2 ** 32)))
+    if init is None:
+        pv = np.zeros((0, cs.n_params), np.float32)
+        pa = np.zeros((0, cs.n_params), bool)
+        pl = np.zeros((0,), np.float32)
+    vals, active, losses = run(np.uint32(int(seed) % (2 ** 32)), pv, pa, pl)
     # ONE host sync for the whole run.
     vals = np.asarray(vals)
     active = np.asarray(active)

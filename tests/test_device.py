@@ -132,6 +132,36 @@ class TestFminDevice:
         assert best_m == best_s
         assert np.isfinite(info_m["losses"]).all()
 
+    def test_resume_from_prior_info(self):
+        """init= continues a run to max_evals TOTAL (the trials= analog):
+        the resumed history is carried verbatim, the loop picks up after
+        it, and quality never regresses."""
+        _, info60 = ho.fmin_device(_branin, BRANIN_SPACE, max_evals=60,
+                                   seed=5)
+        best, info120 = ho.fmin_device(_branin, BRANIN_SPACE,
+                                       max_evals=120, seed=6, init=info60)
+        assert info120["losses"].shape == (120,)
+        np.testing.assert_array_equal(info120["losses"][:60],
+                                      info60["losses"])
+        np.testing.assert_array_equal(info120["vals"][:60], info60["vals"])
+        assert info120["best_loss"] <= info60["best_loss"] + 1e-6
+
+        with pytest.raises(ValueError):
+            ho.fmin_device(_branin, BRANIN_SPACE, max_evals=60, seed=0,
+                           init=info60)
+
+    def test_resume_shorter_than_startup(self):
+        """A resumed history shorter than n_startup_jobs owes only the
+        REMAINDER in startup draws."""
+        _, info5 = ho.fmin_device(_branin, BRANIN_SPACE, max_evals=5,
+                                  seed=0, n_startup_jobs=5)
+        _, info30 = ho.fmin_device(_branin, BRANIN_SPACE, max_evals=30,
+                                   seed=1, init=info5, n_startup_jobs=20)
+        assert info30["losses"].shape == (30,)
+        assert np.isfinite(info30["losses"]).all()
+        np.testing.assert_array_equal(info30["losses"][:5],
+                                      info5["losses"])
+
     def test_matches_host_fmin_family(self):
         """Statistical parity with the host loop: same algorithm, same
         budget — medians of best-loss land in the same family (host TPE

@@ -36,6 +36,7 @@ building block.
 from __future__ import annotations
 
 import inspect
+import logging
 from functools import partial
 
 import jax
@@ -55,6 +56,8 @@ from .tpe import (
     _insert_row,
     get_kernel,
 )
+
+logger = logging.getLogger(__name__)
 
 # Compiled runs retained per space (LRU): each entry pins its jitted
 # program AND the objective closure it traced, so the cache must be
@@ -91,7 +94,7 @@ def fmin_device(fn, space, max_evals, seed=0,
                 prior_weight=_default_prior_weight,
                 linear_forgetting=_default_linear_forgetting,
                 split="sqrt", multivariate=False, cat_prior=None,
-                mesh=None, init=None):
+                mesh=None, init=None, n_runs=1):
     """Run ``max_evals`` trials of TPE entirely on device; see module doc.
 
     Returns ``(best, info)`` where ``best`` is the reference-style
@@ -107,6 +110,16 @@ def fmin_device(fn, space, max_evals, seed=0,
     prior run is shorter than ``n_startup_jobs``, the startup phase
     samples only the remainder.  The resumed segment uses this call's
     ``seed`` for its key stream.
+
+    ``n_runs > 1`` vmaps K fully independent restarts (seeds
+    ``seed..seed+K-1``) into the same single program — runs are
+    embarrassingly parallel, so with a ``mesh`` whose ``dp`` axis divides
+    ``n_runs`` the restart axis shards across devices (per-run candidate
+    axes stay local; ``mesh``'s ``sp`` sharding applies only to
+    single-run calls).  ``best``/``best_loss`` are the best across ALL
+    runs; ``info["losses"]``/``vals``/``active`` gain a leading
+    ``[n_runs]`` axis and ``best_index`` becomes ``(run, trial)``.
+    ``init`` does not compose with ``n_runs > 1``.
 
     The compiled program is cached on the space per
     ``(max_evals, tuning-kwargs)`` — a second call with the same shape
@@ -137,24 +150,52 @@ def fmin_device(fn, space, max_evals, seed=0,
     # Startup draws still owed after the resumed history (if any).
     n0 = min(max(int(n_startup_jobs) - n_prev, 0), max_evals - n_prev)
     n_cap = _bucket(max_evals)
-    if mesh is not None:
+    n_runs = int(n_runs)
+    if n_runs < 1:
+        raise ValueError("n_runs must be >= 1")
+    if n_runs > 1 and init is not None:
+        raise ValueError("init= does not compose with n_runs > 1 "
+                         "(restarts are independent fresh runs)")
+    from .parallel.sharded import START_AXIS, _mesh_key
+
+    mesh_k = _mesh_key(mesh) if mesh is not None else None
+    if mesh is not None and n_runs > 1:
+        # The restart axis shards over dp (below); validate up front with
+        # the same explicit errors the sharded-kernel path gives.
+        if START_AXIS not in mesh.shape:
+            raise ValueError(
+                f"n_runs > 1 shards restarts over the mesh's "
+                f"'{START_AXIS}' axis, but this mesh has axes "
+                f"{tuple(mesh.shape)} — build it with "
+                "parallel.default_mesh(n_starts=...)")
+        n_dp = mesh.shape[START_AXIS]
+        if n_runs % n_dp:
+            raise ValueError(
+                f"n_runs={n_runs} not divisible by the {n_dp}-way "
+                f"'{START_AXIS}' mesh axis")
+        if n_dp == 1:
+            logger.warning(
+                "fmin_device: mesh has %s=1, so all %d restarts run on "
+                "one device — build parallel.default_mesh(n_starts=%d) "
+                "to distribute them", START_AXIS, n_runs, n_runs)
+    if mesh is not None and n_runs == 1:
         # Candidate-axis sharding inside every suggest step: the same
         # ShardedTpeKernel constraints parallel.sharded_suggest uses, with
         # the loop still one program — per-step EI sweeps ride ICI, the
         # argmax reduces across devices, and the sequential trial chain
         # stays device-resident.
-        from .parallel.sharded import _get_sharded_kernel, _mesh_key
+        from .parallel.sharded import _get_sharded_kernel
 
         kern = _get_sharded_kernel(cs, n_cap, int(n_EI_candidates),
                                    int(linear_forgetting), mesh, split,
                                    multivariate=multivariate,
                                    cat_prior=cat_prior)
-        mesh_k = _mesh_key(mesh)
     else:
+        # n_runs > 1 shards the RESTART axis instead; per-run suggests
+        # use the plain kernel so the two partitionings can't fight.
         kern = get_kernel(cs, n_cap, int(n_EI_candidates),
                           int(linear_forgetting), split, multivariate,
                           cat_prior)
-        mesh_k = None
     eval_one = _wrap_objective(fn, cs)
 
     cache = getattr(cs, "_device_fmin_cache", None)
@@ -168,7 +209,7 @@ def fmin_device(fn, space, max_evals, seed=0,
                  int(n_EI_candidates),
                  float(gamma), float(prior_weight), int(linear_forgetting),
                  split, multivariate, kern.cat_prior, kern.comp_sampler,
-                 kern.split_impl, kern.pallas, mesh_k)
+                 kern.split_impl, kern.pallas, mesh_k, n_runs)
     run = cache.get(cache_key)
     if run is not None:
         cache.move_to_end(cache_key)
@@ -206,7 +247,11 @@ def fmin_device(fn, space, max_evals, seed=0,
                 n_seeded, max_evals, body, (hv, ha, hl, hok))
             return hv[:max_evals], ha[:max_evals], hl[:max_evals]
 
-        run = cache[cache_key] = jax.jit(_run)
+        if n_runs > 1:
+            run = jax.jit(jax.vmap(_run, in_axes=(0, None, None, None)))
+        else:
+            run = jax.jit(_run)
+        cache[cache_key] = run
         while len(cache) > _RUN_CACHE_CAP:
             cache.popitem(last=False)
 
@@ -214,16 +259,34 @@ def fmin_device(fn, space, max_evals, seed=0,
         pv = np.zeros((0, cs.n_params), np.float32)
         pa = np.zeros((0, cs.n_params), bool)
         pl = np.zeros((0,), np.float32)
-    vals, active, losses = run(np.uint32(int(seed) % (2 ** 32)), pv, pa, pl)
+    if n_runs > 1:
+        seeds = (np.arange(n_runs, dtype=np.uint64)
+                 + (int(seed) % (2 ** 32))).astype(np.uint32)
+        if mesh is not None:
+            # Restarts are embarrassingly parallel: shard the run axis
+            # over the mesh's dp axis and let SPMD partition the whole
+            # vmapped program (per-run history/candidates stay local).
+            # Divisibility/axis presence validated above.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            seeds = jax.device_put(
+                seeds, NamedSharding(mesh, PartitionSpec(START_AXIS)))
+        vals, active, losses = run(seeds, pv, pa, pl)
+    else:
+        vals, active, losses = run(np.uint32(int(seed) % (2 ** 32)),
+                                   pv, pa, pl)
     # ONE host sync for the whole run.
     vals = np.asarray(vals)
     active = np.asarray(active)
     losses = np.asarray(losses)
     # NaN-safe best: non-finite losses lose to any finite one.
     order = np.where(np.isnan(losses), np.inf, losses)
-    bi = int(np.argmin(order))
-    best = {p.label: cs._param_value(p, vals[bi, p.pid])
-            for p in cs.params if active[bi, p.pid]}
+    bi = tuple(int(i) for i in
+               np.unravel_index(int(np.argmin(order)), order.shape))
+    best_row, best_act = vals[bi], active[bi]
+    best = {p.label: cs._param_value(p, best_row[p.pid])
+            for p in cs.params if best_act[p.pid]}
     info = {"losses": losses, "vals": vals, "active": active,
-            "best_loss": float(losses[bi]), "best_index": bi}
+            "best_loss": float(losses[bi]),
+            "best_index": bi if n_runs > 1 else bi[0]}
     return best, info

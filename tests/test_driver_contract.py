@@ -76,3 +76,49 @@ class TestBenchPreflight:
         monkeypatch.setattr(bench.subprocess, "run",
                             lambda *a, **kw: Dead())
         assert bench._preflight(lambda m: None, deadline=1.0) is None
+
+
+class TestLatestTpuArtifact:
+    """bench._latest_tpu_artifact keys on the filename-embedded run
+    timestamp BEFORE mtime, so annotating an old artifact in place can
+    never promote it over a newer run (round-4 honesty machinery)."""
+
+    def _bench(self):
+        import importlib
+
+        return importlib.import_module("bench")
+
+    def test_newer_stamp_wins_despite_older_mtime(self, tmp_path,
+                                                  monkeypatch):
+        import json as _json
+        import os as _os
+
+        bench = self._bench()
+        bdir = tmp_path / "benchmarks"
+        bdir.mkdir()
+        old = bdir / "bench_tpu_20260729.json"
+        new = bdir / "bench_20260731_1904.json"
+        old.write_text(_json.dumps(
+            {"backend": "tpu", "value": 87.4, "mode": "xla"}))
+        new.write_text(_json.dumps(
+            {"backend": "tpu", "value": 15.7, "mode": "pallas"}))
+        # Touch the OLD file so mtime alone would pick it.
+        _os.utime(old, (9e9, 9e9))
+        # Point the helper at the temp benchmarks dir.
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        ref, doc = bench._latest_tpu_artifact()
+        assert ref.endswith("bench_20260731_1904.json")
+        assert doc["value"] == 15.7
+
+    def test_cpu_label_and_nulls_skipped(self, tmp_path, monkeypatch):
+        import json as _json
+
+        bench = self._bench()
+        bdir = tmp_path / "benchmarks"
+        bdir.mkdir()
+        (bdir / "bench_20260731_1904.json").write_text(_json.dumps(
+            {"backend": "cpu", "value": 3000.0}))
+        (bdir / "bench_20260730_0100.json").write_text(_json.dumps(
+            {"backend": "tpu", "value": None}))
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        assert bench._latest_tpu_artifact() is None

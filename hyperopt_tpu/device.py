@@ -94,7 +94,8 @@ def fmin_device(fn, space, max_evals, seed=0,
                 prior_weight=_default_prior_weight,
                 linear_forgetting=_default_linear_forgetting,
                 split="sqrt", multivariate=False, cat_prior=None,
-                mesh=None, init=None, n_runs=1):
+                mesh=None, init=None, n_runs=1, patience=None,
+                min_improvement=0.0):
     """Run ``max_evals`` trials of TPE entirely on device; see module doc.
 
     Returns ``(best, info)`` where ``best`` is the reference-style
@@ -110,6 +111,14 @@ def fmin_device(fn, space, max_evals, seed=0,
     prior run is shorter than ``n_startup_jobs``, the startup phase
     samples only the remainder.  The resumed segment uses this call's
     ``seed`` for its key stream.
+
+    ``patience`` enables in-program early stopping (the device analog of
+    ``no_progress_loss(patience, min_improvement)``): the loop halts once
+    ``patience`` consecutive trials fail to improve the best loss by more
+    than ``min_improvement`` (relative, like the host helper's
+    ``percent_increase/100``).  Trials never run land as ``inf`` losses
+    with ``ok=False`` semantics; ``info["n_trials"]`` reports how many
+    actually ran.  Startup trials always run.
 
     ``n_runs > 1`` vmaps K fully independent restarts (seeds
     ``seed..seed+K-1``) into the same single program — runs are
@@ -205,11 +214,18 @@ def fmin_device(fn, space, max_evals, seed=0,
     # identical code but different captured values trace to DIFFERENT
     # programs.  The cache entry keeps fn alive, so its id cannot be
     # recycled while the entry exists; eviction (below) releases both.
+    patience = None if patience is None else int(patience)
+    if patience is not None and patience < 1:
+        raise ValueError(f"patience must be >= 1, got {patience}")
+    # Irrelevant without patience — normalize so it can't fragment the
+    # compile cache with byte-identical programs.
+    min_improvement = 0.0 if patience is None else float(min_improvement)
     cache_key = (id(fn), max_evals, n0, n_prev, n_cap,
                  int(n_EI_candidates),
                  float(gamma), float(prior_weight), int(linear_forgetting),
                  split, multivariate, kern.cat_prior, kern.comp_sampler,
-                 kern.split_impl, kern.pallas, mesh_k, n_runs)
+                 kern.split_impl, kern.pallas, mesh_k, n_runs,
+                 patience, float(min_improvement))
     run = cache.get(cache_key)
     if run is not None:
         cache.move_to_end(cache_key)
@@ -235,17 +251,56 @@ def fmin_device(fn, space, max_evals, seed=0,
                 hl = hl.at[n_prev:n_seeded].set(sl)
             hok = (jnp.arange(n_cap) < n_seeded)
 
-            def body(i, carry):
-                hv, ha, hl, hok = carry
+            def step(i, hv, ha, hl, hok):
                 row, act = kern._suggest_one(
                     jax.random.fold_in(k_loop, i), hv, ha, hl, hok,
                     gamma_f, pw_f)
                 loss = eval_one(row, act)
-                return _insert_row(hv, ha, hl, hok, i, row, act, loss)
+                return _insert_row(hv, ha, hl, hok, i, row, act, loss), loss
 
-            hv, ha, hl, hok = jax.lax.fori_loop(
-                n_seeded, max_evals, body, (hv, ha, hl, hok))
-            return hv[:max_evals], ha[:max_evals], hl[:max_evals]
+            if patience is None:
+                def body(i, carry):
+                    return step(i, *carry)[0]
+
+                hv, ha, hl, hok = jax.lax.fori_loop(
+                    n_seeded, max_evals, body, (hv, ha, hl, hok))
+                n_done = jnp.int32(max_evals)
+            else:
+                # In-program no-progress stop (host: no_progress_loss).
+                mi = float(min_improvement)
+
+                def wcond(st):
+                    i, since = st[4], st[6]
+                    return jnp.logical_and(i < max_evals,
+                                           since < patience)
+
+                def wbody(st):
+                    hv, ha, hl, hok, i, best, since = st
+                    (hv, ha, hl, hok), loss = step(i, hv, ha, hl, hok)
+                    if mi > 0:
+                        # inf - inf*mi would be NaN; an infinite best
+                        # means "anything finite improves".
+                        thresh = jnp.where(jnp.isfinite(best),
+                                           best - jnp.abs(best) * mi,
+                                           best)
+                    else:
+                        thresh = best
+                    improved = loss < thresh
+                    # NaN losses neither improve nor poison the tracker
+                    # (host analog filters to finite losses).
+                    best = jnp.where(jnp.isnan(loss), best,
+                                     jnp.minimum(best, loss))
+                    since = jnp.where(improved, 0, since + 1)
+                    return (hv, ha, hl, hok, i + 1, best, since)
+
+                best0 = jnp.min(jnp.where(
+                    hok & ~jnp.isnan(hl), hl, jnp.inf))
+                st = (hv, ha, hl, hok, jnp.int32(n_seeded), best0,
+                      jnp.int32(0))
+                hv, ha, hl, hok, n_done, _, _ = jax.lax.while_loop(
+                    wcond, wbody, st)
+            return (hv[:max_evals], ha[:max_evals], hl[:max_evals],
+                    n_done)
 
         if n_runs > 1:
             run = jax.jit(jax.vmap(_run, in_axes=(0, None, None, None)))
@@ -271,10 +326,10 @@ def fmin_device(fn, space, max_evals, seed=0,
 
             seeds = jax.device_put(
                 seeds, NamedSharding(mesh, PartitionSpec(START_AXIS)))
-        vals, active, losses = run(seeds, pv, pa, pl)
+        vals, active, losses, n_done = run(seeds, pv, pa, pl)
     else:
-        vals, active, losses = run(np.uint32(int(seed) % (2 ** 32)),
-                                   pv, pa, pl)
+        vals, active, losses, n_done = run(np.uint32(int(seed) % (2 ** 32)),
+                                           pv, pa, pl)
     # ONE host sync for the whole run.
     vals = np.asarray(vals)
     active = np.asarray(active)
@@ -286,7 +341,10 @@ def fmin_device(fn, space, max_evals, seed=0,
     best_row, best_act = vals[bi], active[bi]
     best = {p.label: cs._param_value(p, best_row[p.pid])
             for p in cs.params if best_act[p.pid]}
+    n_done = np.asarray(n_done)
     info = {"losses": losses, "vals": vals, "active": active,
             "best_loss": float(losses[bi]),
-            "best_index": bi if n_runs > 1 else bi[0]}
+            "best_index": bi if n_runs > 1 else bi[0],
+            "n_trials": (n_done.astype(int).tolist() if n_runs > 1
+                         else int(n_done))}
     return best, info

@@ -200,6 +200,28 @@ class TestFminDevice:
             ho.fmin_device(_branin, BRANIN_SPACE, max_evals=60, seed=0,
                            n_runs=2, init=info)
 
+    def test_patience_stops_early_on_flat_objective(self):
+        """patience= halts the in-program loop once no trial improves for
+        `patience` consecutive steps; never-run slots stay inf and
+        n_trials reports the actual count."""
+        space = {"x": hp.uniform("x", -1, 1)}
+
+        def flat(p):
+            return jnp.float32(1.0) + 0.0 * p["x"]
+
+        _, info = ho.fmin_device(flat, space, max_evals=200, seed=0,
+                                 n_startup_jobs=5, patience=6)
+        assert info["n_trials"] == 5 + 6
+        assert np.isfinite(info["losses"][:info["n_trials"]]).all()
+        assert np.isinf(info["losses"][info["n_trials"]:]).all()
+        assert info["best_loss"] == pytest.approx(1.0)
+
+    def test_patience_runs_full_budget_when_improving(self):
+        _, info = ho.fmin_device(_branin, BRANIN_SPACE, max_evals=50,
+                                 seed=1, patience=50)
+        assert info["n_trials"] == 50
+        assert np.isfinite(info["losses"]).all()
+
     def test_matches_host_fmin_family(self):
         """Statistical parity with the host loop: same algorithm, same
         budget — medians of best-loss land in the same family (host TPE

@@ -222,6 +222,30 @@ class TestFminDevice:
         assert info["n_trials"] == 50
         assert np.isfinite(info["losses"]).all()
 
+    def test_mixed_kind_space(self):
+        """Every distribution family (uniform/loguniform/quantized/
+        normal/choice + a conditional branch) through the fused loop —
+        the bench's device_fmin shape in miniature."""
+        import sys, os
+        sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        from __graft_entry__ import _flagship_space
+
+        cs = ho.compile_space(_flagship_space(5))
+
+        def obj(p):
+            return p["u0"] ** 2 + jnp.abs(p["n0"]) + p["c0"] * 0.1
+
+        best, info = ho.fmin_device(obj, cs, max_evals=40, seed=0,
+                                    n_startup_jobs=10,
+                                    n_EI_candidates=32)
+        assert info["losses"].shape == (40,)
+        assert np.isfinite(info["losses"]).all()
+        assert info["best_loss"] < 2.0
+        # Quantized/int kinds decode to native python types in best.
+        assert isinstance(best["c0"], int)
+        assert float(best["q0"]) % 2.0 == 0.0
+
     def test_matches_host_fmin_family(self):
         """Statistical parity with the host loop: same algorithm, same
         budget — medians of best-loss land in the same family (host TPE

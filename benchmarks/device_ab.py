@@ -1,0 +1,86 @@
+"""Quality A/B: device-resident fmin vs the host loop, same budgets.
+
+``fmin_device`` claims *exactly sequential TPE* semantics (real losses,
+same posterior update per trial) — the streams differ (different key
+derivation), so the check is statistical: per-seed best losses from both
+paths on the same domains must land in the same family.
+
+Run::
+
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python benchmarks/device_ab.py
+
+Writes ``benchmarks/quality_ab_fmin_vs_fmin_device.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+SEEDS = [0, 1, 2, 3, 4]
+
+
+def main():
+    import jax.numpy as jnp
+
+    import hyperopt_tpu as ho
+    from hyperopt_tpu import hp
+
+    def branin_host(p):
+        x, y = p["x"], p["y"]
+        return ((y - 5.1 / (4 * math.pi ** 2) * x ** 2 + 5 / math.pi * x
+                 - 6) ** 2 + 10 * (1 - 1 / (8 * math.pi)) * math.cos(x)
+                + 10)
+
+    def branin_dev(p):
+        x, y = p["x"], p["y"]
+        return ((y - 5.1 / (4 * math.pi ** 2) * x ** 2 + 5 / math.pi * x
+                 - 6) ** 2 + 10 * (1 - 1 / (8 * math.pi)) * jnp.cos(x)
+                + 10)
+
+    domains = [
+        ("branin", {"x": hp.uniform("x", -5, 10),
+                    "y": hp.uniform("y", 0, 15)},
+         branin_host, branin_dev, 150),
+        ("quadratic1", {"x": hp.uniform("x", -5, 5)},
+         lambda p: (p["x"] - 3.0) ** 2,
+         lambda p: (p["x"] - 3.0) ** 2, 80),
+    ]
+    rows = []
+    for name, space, fh, fd, budget in domains:
+        host, dev = [], []
+        t0 = time.perf_counter()
+        for s in SEEDS:
+            t = ho.Trials()
+            ho.fmin(fh, space, algo=ho.tpe.suggest, max_evals=budget,
+                    trials=t, rstate=np.random.default_rng(s),
+                    show_progressbar=False)
+            host.append(float(t.best_trial["result"]["loss"]))
+            _, info = ho.fmin_device(fd, space, max_evals=budget, seed=s)
+            dev.append(info["best_loss"])
+        rec = {"domain": name, "budget": budget,
+               "host_median": round(float(np.median(host)), 6),
+               "device_median": round(float(np.median(dev)), 6),
+               "host": [round(v, 6) for v in host],
+               "device": [round(v, 6) for v in dev],
+               "wall_s": round(time.perf_counter() - t0, 1)}
+        rows.append(rec)
+        print(json.dumps(rec), flush=True)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "quality_ab_fmin_vs_fmin_device.json")
+    with open(out, "w") as f:
+        json.dump({"seeds": SEEDS, "rows": rows}, f, indent=1)
+    print(f"# wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -246,6 +246,19 @@ class TestFminDevice:
         assert isinstance(best["c0"], int)
         assert float(best["q0"]) % 2.0 == 0.0
 
+    def test_tuning_kwargs_pass_through(self):
+        """The quality-winning tuning kwargs (multivariate joint-EI,
+        quantile split) flow into the fused loop's kernel unchanged."""
+        _, info = ho.fmin_device(_branin, BRANIN_SPACE, max_evals=60,
+                                 seed=0, n_EI_candidates=64,
+                                 multivariate=True, split="quantile")
+        assert np.isfinite(info["losses"]).all()
+        assert info["best_loss"] < 3.0
+        # Distinct tuning -> distinct compiled program -> distinct stream.
+        _, base = ho.fmin_device(_branin, BRANIN_SPACE, max_evals=60,
+                                 seed=0, n_EI_candidates=64)
+        assert not np.array_equal(info["losses"], base["losses"])
+
     def test_matches_host_fmin_family(self):
         """Statistical parity with the host loop: same algorithm, same
         budget — medians of best-loss land in the same family (host TPE

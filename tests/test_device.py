@@ -64,6 +64,7 @@ class TestFminDevice:
         # best (same seed family, 60 guided evals vs 60 random ones).
         assert info["best_loss"] <= rand_info["best_loss"] + 1e-6
 
+    @pytest.mark.slow
     def test_conditional_space_masks_inactive(self):
         space = {"branch": hp.choice("branch", [
             {"kind": 0},
@@ -111,6 +112,7 @@ class TestFminDevice:
         assert info["losses"].shape == (10,)
         assert np.isfinite(info["losses"]).all()
 
+    @pytest.mark.slow
     def test_sharded_mesh_loop(self):
         """fmin_device(mesh=): sharding is an execution-layout change,
         not a semantics change — the mesh path must produce the
@@ -132,6 +134,7 @@ class TestFminDevice:
         assert best_m == best_s
         assert np.isfinite(info_m["losses"]).all()
 
+    @pytest.mark.slow
     def test_resume_from_prior_info(self):
         """init= continues a run to max_evals TOTAL (the trials= analog):
         the resumed history is carried verbatim, the loop picks up after
@@ -179,6 +182,7 @@ class TestFminDevice:
         # trajectories).
         assert not np.array_equal(info["losses"][0], info["losses"][1])
 
+    @pytest.mark.slow
     def test_multi_run_sharded_over_dp(self):
         """n_runs over the mesh dp axis: the restart axis shards across
         devices; results equal the unsharded vmap (layout-only)."""
@@ -222,6 +226,7 @@ class TestFminDevice:
         assert info["n_trials"] == 50
         assert np.isfinite(info["losses"]).all()
 
+    @pytest.mark.slow
     def test_mixed_kind_space(self):
         """Every distribution family (uniform/loguniform/quantized/
         normal/choice + a conditional branch) through the fused loop —
@@ -259,6 +264,7 @@ class TestFminDevice:
                                  seed=0, n_EI_candidates=64)
         assert not np.array_equal(info["losses"], base["losses"])
 
+    @pytest.mark.slow
     def test_matches_host_fmin_family(self):
         """Statistical parity with the host loop: same algorithm, same
         budget — medians of best-loss land in the same family (host TPE

@@ -241,6 +241,30 @@ def child():
               (key, hv, ha, hl, hok, gamma, pw))
         stage("fit_draw_gumbel", fit_draw_for(ki), (key, hv, ha, hl, hok))
 
+    # PRNG-impl A/B (round-5): threefry (the JAX default every stage above
+    # uses) vs the TPU-native hardware RngBitGenerator.  The 08:36 window
+    # attributed ~3 ms of the ~11.6 ms true step compute to threefry bit
+    # generation alone (`rng_bits`); rbg does the same bit volume in
+    # hardware.  The key TYPE drives the lowering — the program is
+    # retraced for the rbg-typed key — so these stages measure the shipped
+    # kernel under `HYPEROPT_TPU_PRNG=rbg`, RNG stream differences and
+    # all (same distributions, KS-pinned in tests/test_space.py).
+    try:
+        from hyperopt_tpu.space import prng_key as _pk
+
+        with env_override("HYPEROPT_TPU_PRNG", "rbg"):
+            key_rbg = _pk(0)
+    except Exception as e:   # rbg unsupported on this backend/version
+        result["stages"]["rbg_key"] = {"error": f"{type(e).__name__}: {e}"}
+        _say("partial", result)
+    else:
+        # stage() has its own per-stage try, so a failure in one rbg
+        # stage records under ITS name and cannot clobber the other's
+        # successful measurement.
+        stage("full_rbg", kern._suggest_one,
+              (key_rbg, hv, ha, hl, hok, gamma, pw))
+        stage("rng_bits_rbg", rng_bits, (key_rbg,))
+
     # γ-split lowering A/B: the shipped top-k split (the `split`/`full`
     # stages above) vs the round-3 double-argsort rank.  Outputs are
     # bit-identical (tests/test_tpe.py::TestSplitImpl) so this is purely

@@ -30,6 +30,14 @@ sys.path.insert(0, os.path.join(_ROOT, "tests"))
 sys.path.insert(0, _ROOT)   # hyperopt_tpu importable when run as a script
 
 SEEDS = [0, 1, 2, 3, 4]
+# Round-5 ATPE-evaluation knobs (VERDICT r4 #4): more seeds + a second,
+# starved budget, without forking the harness.
+if os.environ.get("HYPEROPT_TPU_QUALITY_SEEDS"):
+    SEEDS = list(range(int(os.environ["HYPEROPT_TPU_QUALITY_SEEDS"])))
+# Multiplies every domain's budget (e.g. 0.5 = the starved half-budget
+# sweep); rows record the EFFECTIVE budget.
+BUDGET_SCALE = float(os.environ.get("HYPEROPT_TPU_QUALITY_BUDGET_SCALE",
+                                    "1.0"))
 
 
 def algos():
@@ -126,7 +134,8 @@ def _run_domains(names):
     base_cache = os.environ.get("HYPEROPT_TPU_CACHE_DIR", "/tmp")
     for name in names:
         z = ZOO[name]
-        rec = {"domain": name, "budget": z.budget,
+        budget = max(int(round(z.budget * BUDGET_SCALE)), 5)
+        rec = {"domain": name, "budget": budget,
                "best_known": z.best_loss}
         for aname, spec in algos().items():
             algo, fkw = ((spec["algo"], spec.get("fmin", {}))
@@ -139,11 +148,15 @@ def _run_domains(names):
                 os.environ["HYPEROPT_TPU_CACHE_DIR"] = os.path.join(
                     base_cache, f"{aname}_{s}")
                 t = ho.Trials()
-                ho.fmin(z.fn, z.space, algo=algo, max_evals=z.budget,
+                ho.fmin(z.fn, z.space, algo=algo, max_evals=budget,
                         trials=t, rstate=np.random.default_rng(s),
                         show_progressbar=False, **fkw)
                 finals.append(t.best_trial["result"]["loss"])
             rec[aname] = round(float(np.median(finals)), 6)
+            # Spread, not just center (VERDICT r4 #4): quartiles over the
+            # per-seed finals.
+            rec[f"{aname}_q25"] = round(float(np.quantile(finals, 0.25)), 6)
+            rec[f"{aname}_q75"] = round(float(np.quantile(finals, 0.75)), 6)
             rec[f"{aname}_s"] = round(time.perf_counter() - t0, 1)
         print(json.dumps(rec), flush=True)
 
@@ -155,13 +168,17 @@ def _finish(rows):
     # were lost to the batch-liar A/B this way).  The full table keeps its
     # canonical name.  ``HYPEROPT_TPU_QUALITY_OUT`` overrides.
     only = os.environ.get("HYPEROPT_TPU_QUALITY_ALGOS")
+    scale_tag = (f"_b{BUDGET_SCALE:g}".replace(".", "p")
+                 if BUDGET_SCALE != 1.0 else "")
     fname = os.environ.get("HYPEROPT_TPU_QUALITY_OUT") or (
         "quality_ab_" + "_vs_".join(
-            a.strip() for a in only.split(",") if a.strip()) + ".json"
-        if only else "quality_latest.json")
+            a.strip() for a in only.split(",") if a.strip())
+        + scale_tag + ".json"
+        if only else f"quality_latest{scale_tag}.json")
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), fname)
     with open(out, "w") as f:
-        json.dump({"seeds": SEEDS, "rows": rows}, f, indent=1)
+        json.dump({"seeds": SEEDS, "budget_scale": BUDGET_SCALE,
+                   "rows": rows}, f, indent=1)
 
     names = list(algos())
     print("\n| domain | budget | " + " | ".join(names) + " |")

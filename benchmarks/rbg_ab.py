@@ -1,0 +1,51 @@
+"""Targeted on-chip A/B: threefry vs rbg PRNG lowering on the full TPE step."""
+import json, os, sys, time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+import jax
+
+from __graft_entry__ import _flagship_space, _history
+from hyperopt_tpu.space import compile_space, prng_key
+from hyperopt_tpu.tpe import _bucket, _padded_history, get_kernel
+
+N_CAND, N_HISTORY, N_DIMS = 10000, 1000, 50
+backend = jax.default_backend()
+cs = compile_space(_flagship_space(N_DIMS))
+n_cap = _bucket(N_HISTORY)
+hv, ha, hl, hok = _padded_history(_history(cs, N_HISTORY), n_cap)
+hv, ha = jax.device_put(hv), jax.device_put(ha)
+hl, hok = jax.device_put(hl), jax.device_put(hok)
+gamma, pw = np.float32(0.25), np.float32(1.0)
+os.environ["HYPEROPT_TPU_PALLAS"] = "1" if backend == "tpu" else "0"
+kern = get_kernel(cs, n_cap=n_cap, n_cand=N_CAND, lf=25)
+
+
+def steady(fn, key, k=32):
+    out = fn(key, hv, ha, hl, hok, gamma, pw)
+    np.asarray(out[0])  # compile + sync
+    for i in range(4):
+        out = fn(jax.random.fold_in(key, 1000 + i), hv, ha, hl, hok, gamma, pw)
+    np.asarray(out[0])
+    t0 = time.perf_counter()
+    for i in range(k):
+        out = fn(jax.random.fold_in(key, i), hv, ha, hl, hok, gamma, pw)
+    np.asarray(out[0])
+    return (time.perf_counter() - t0) * 1e3 / k
+
+
+fn = jax.jit(kern._suggest_one)
+res = {"backend": backend, "n_cand": N_CAND, "n_dims": N_DIMS}
+k_tf = prng_key(0)
+os.environ["HYPEROPT_TPU_PRNG"] = "rbg"
+k_rbg = prng_key(0)
+os.environ.pop("HYPEROPT_TPU_PRNG")
+# interleave A/B twice to cancel drift
+res["threefry_ms_1"] = round(steady(fn, k_tf), 3)
+res["rbg_ms_1"] = round(steady(fn, k_rbg), 3)
+res["threefry_ms_2"] = round(steady(fn, k_tf), 3)
+res["rbg_ms_2"] = round(steady(fn, k_rbg), 3)
+print(json.dumps(res))

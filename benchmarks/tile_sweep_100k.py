@@ -1,0 +1,85 @@
+"""Config-5 (100k cand x 100 dim) Pallas tile sweep, on-chip.
+
+The 10k x 50 tile sweep in profile_step.py showed 512/1024 ~ equal and
+128 worse; this measures the same sweep at the long-axis shape that
+actually stresses VMEM streaming, to let data pick the default for
+large n_cand (round-5 verdict ask #7: cut config-5 latency).
+"""
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import numpy as np
+import jax
+
+from __graft_entry__ import _flagship_space, _history
+from hyperopt_tpu.space import compile_space
+from hyperopt_tpu.tpe import _bucket, _padded_history, get_kernel
+
+N_CAND, N_HIST, N_DIMS = 100_000, 1000, 100
+
+
+def main():
+    backend = jax.default_backend()
+    os.environ["HYPEROPT_TPU_PALLAS"] = "1" if backend == "tpu" else "0"
+    cs = compile_space(_flagship_space(N_DIMS))
+    n_cap = _bucket(N_HIST)
+    hv, ha, hl, hok = _padded_history(_history(cs, N_HIST), n_cap)
+    hv, ha = jax.device_put(hv), jax.device_put(ha)
+    hl, hok = jax.device_put(hl), jax.device_put(hok)
+    key = jax.random.key(0)
+    res = {"metric": "config5_tile_sweep", "backend": backend,
+           "n_cand": N_CAND, "n_dims": N_DIMS, "tiles": {}}
+
+    def steady(kern, k=8):
+        out = kern(key, hv, ha, hl, hok, 0.25, 1.0)
+        np.asarray(out[0])
+        t0 = time.perf_counter()
+        for i in range(k):
+            out = kern(jax.random.fold_in(key, i), hv, ha, hl, hok,
+                       0.25, 1.0)
+        np.asarray(out[0])
+        return (time.perf_counter() - t0) * 1e3 / k
+
+    variants = [("default", None), ("256", "256"), ("512", "512"),
+                ("1024", "1024"), ("2048", "2048")]
+    if backend != "tpu":
+        variants = variants[:2]
+    for name, tile in variants:
+        saved = os.environ.pop("HYPEROPT_TPU_PALLAS_TILE", None)
+        if tile is not None:
+            os.environ["HYPEROPT_TPU_PALLAS_TILE"] = tile
+        try:
+            kern = get_kernel(cs, n_cap, N_CAND, 25)
+            res["tiles"][name] = round(steady(kern), 3)
+        except Exception as e:
+            res["tiles"][name] = f"{type(e).__name__}: {e}"
+        finally:
+            if saved is not None:
+                os.environ["HYPEROPT_TPU_PALLAS_TILE"] = saved
+            else:
+                os.environ.pop("HYPEROPT_TPU_PALLAS_TILE", None)
+        print(json.dumps({name: res["tiles"][name]}), flush=True)
+
+    # XLA (no Pallas) comparison at this shape.
+    os.environ["HYPEROPT_TPU_PALLAS"] = "0"
+    try:
+        kx = get_kernel(cs, n_cap, N_CAND, 25)
+        res["xla_ms"] = round(steady(kx), 3)
+    except Exception as e:
+        res["xla_ms"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(res), flush=True)
+    stamp = time.strftime("%Y%m%d_%H%M", time.gmtime())
+    out_path = os.path.join(_ROOT, "benchmarks",
+                            f"tile_sweep_100k_{backend}_{stamp}.json")
+    with open(out_path, "w") as f:
+        json.dump(res, f, indent=1)
+    print(f"# wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

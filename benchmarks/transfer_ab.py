@@ -81,6 +81,19 @@ def _variant_space(name):
                     {"kind": "plain"},
                     {"kind": "cos", "amp": hp.uniform("amp", 0.4, 2.2)},
                 ])}
+    if name == "quadratic1":
+        # One uniform, shifted/widened bounds: the simplest structural
+        # match — transfer has the least surface to work with here, so
+        # this space keeps the evaluation honest at the low end.
+        return {"x": hp.uniform("x", -5.5, 6.0)}
+    if name == "q1_choice":
+        # A 2-way choice gating two uniforms, bounds nudged: exercises
+        # transfer across conditional structure (arm statistics learned
+        # under a different fingerprint with the same gating shape).
+        return {"p": hp.choice("p", [
+            {"kind": "flat", "x": hp.uniform("x_flat", -5.5, 5.5)},
+            {"kind": "centered", "x": hp.uniform("x_centered", -5.5, 5.5)},
+        ])}
     if name == "many_dists":
         return {
             "a": hp.choice("a", [0, 1, 2]),
@@ -105,8 +118,12 @@ def _variant_space(name):
     raise KeyError(name)
 
 
-CROSS_DOMAINS = {"branin": 30, "many_dists": 20,   # starved exp2 budgets
-                 "gauss_wave2": 25}
+# Starved exp2 budgets over FIVE structurally distinct spaces (round-5
+# verdict ask: 1 uniform / 2 uniforms / conditional choice+uniforms /
+# uniform+choice-gated-uniform / 15-param all-kinds): transfer must show
+# value across structure, not on one lucky domain.
+CROSS_DOMAINS = {"branin": 30, "many_dists": 20,
+                 "gauss_wave2": 25, "quadratic1": 25, "q1_choice": 30}
 
 
 def cross_main():

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import base, rand
+from .space import prng_key
 
 _default_avg_best_idx = 2.0
 _default_shrink_coef = 0.1
@@ -143,7 +144,7 @@ def suggest(new_ids, domain, trials, seed,
     t_obs = h["active"][ok_rows].sum(axis=0).astype(np.float32)
     shrink = 1.0 / (1.0 + t_obs * shrink_coef)
 
-    key = jax.random.key(int(seed) % (2 ** 32))
+    key = prng_key(int(seed) % (2 ** 32))
     # Incumbent picks (geometric over the loss ranking) are host-side;
     # the neighborhood draws batch into one device program + one fetch.
     gis = np.minimum(rng.geometric(1.0 / avg_best_idx, size=n) - 1,

@@ -45,7 +45,7 @@ import numpy as np
 
 from collections import OrderedDict
 
-from .space import CompiledSpace, compile_space
+from .space import CompiledSpace, compile_space, prng_impl, prng_key
 from .tpe import (
     _bucket,
     _default_gamma,
@@ -67,11 +67,22 @@ _RUN_CACHE_CAP = 8
 
 
 def _wrap_objective(fn, cs: CompiledSpace):
-    """Adapt ``fn`` to ``(row f32[P], act bool[P]) -> f32[]``."""
+    """Adapt ``fn`` to ``(row f32[P], act bool[P]) -> f32[]``.
+
+    The activity-mask dict is passed only when the objective declares a
+    SECOND required positional parameter.  Parameters with defaults are
+    excluded from the count on purpose: ``def obj(p, scale=1.0)`` is a
+    one-argument objective with a config knob, and silently feeding the
+    mask dict into ``scale`` would corrupt every loss with no error
+    (round-4 advisor finding).  Config knobs with defaults therefore stay
+    untouched; an objective that wants the mask declares it default-less
+    (conventionally named ``active``).
+    """
     try:
         n_pos = len([p for p in inspect.signature(fn).parameters.values()
                      if p.kind in (p.POSITIONAL_ONLY,
-                                   p.POSITIONAL_OR_KEYWORD)])
+                                   p.POSITIONAL_OR_KEYWORD)
+                     and p.default is p.empty])
     except (TypeError, ValueError):   # builtins / partials without sigs
         n_pos = 1
 
@@ -193,8 +204,21 @@ def fmin_device(fn, space, max_evals, seed=0,
         # the loop still one program — per-step EI sweeps ride ICI, the
         # argmax reduces across devices, and the sequential trial chain
         # stays device-resident.
-        from .parallel.sharded import _get_sharded_kernel
+        from .parallel.sharded import CAND_AXIS, _get_sharded_kernel
 
+        # Validate at THIS boundary (round-4 advisor finding): the default
+        # n_EI_candidates is rarely divisible by a mesh's candidate axis,
+        # and the equivalent raise from deep inside ShardedTpeKernel names
+        # neither the kwarg the caller should change nor a workable value.
+        if CAND_AXIS in mesh.shape:
+            n_sp = mesh.shape[CAND_AXIS]
+            if int(n_EI_candidates) % n_sp:
+                fixed = -(-int(n_EI_candidates) // n_sp) * n_sp
+                raise ValueError(
+                    f"fmin_device: n_EI_candidates={n_EI_candidates} is not "
+                    f"divisible by the {n_sp}-way '{CAND_AXIS}' mesh axis; "
+                    f"pass n_EI_candidates={fixed} (next multiple) or a "
+                    f"mesh whose '{CAND_AXIS}' axis divides it")
         kern = _get_sharded_kernel(cs, n_cap, int(n_EI_candidates),
                                    int(linear_forgetting), mesh, split,
                                    multivariate=multivariate,
@@ -225,7 +249,7 @@ def fmin_device(fn, space, max_evals, seed=0,
                  float(gamma), float(prior_weight), int(linear_forgetting),
                  split, multivariate, kern.cat_prior, kern.comp_sampler,
                  kern.split_impl, kern.pallas, mesh_k, n_runs,
-                 patience, float(min_improvement))
+                 patience, float(min_improvement), prng_impl())
     run = cache.get(cache_key)
     if run is not None:
         cache.move_to_end(cache_key)
@@ -237,7 +261,7 @@ def fmin_device(fn, space, max_evals, seed=0,
         n_seeded = n_prev + n0   # rows present before the TPE loop starts
 
         def _run(seed32, pv_, pa_, pl_):
-            key = jax.random.key(seed32)
+            key = prng_key(seed32)
             k_start, k_loop = jax.random.split(key)
             hv = jnp.zeros((n_cap, p_dim), jnp.float32).at[:n_prev].set(pv_)
             ha = jnp.zeros((n_cap, p_dim), bool).at[:n_prev].set(pa_)

@@ -34,6 +34,54 @@ from jax.scipy.special import log_ndtr, ndtri
 from jax.scipy.stats import norm
 
 _TINY = 1e-12
+# Widest option/component axis for which index lookups lower as one-hot
+# MXU matmuls (serialized TPU gathers avoided) rather than gathers: the
+# [n, K<=256] f32 operand stays ~100 MB even at 100k candidates, while
+# wider axes would trade a slow gather for an HBM-exhausting matmul.
+_ONEHOT_MAX = 256
+# ...and a cap on the WHOLE materialized one-hot operand (batch x n x K
+# elements) including vmap batch dims the helper cannot see (callers
+# pass `batch`; round-5 review finding).  2**28 elements = 1 GB f32:
+# the measured config-5 sweet spot sits well inside it (~70 columns x
+# 100k cand x 26 comps = 182M elements ran at 32 ms / no memory
+# pressure on a 16 GB v5e), while the pathological small-history x
+# wide-K x many-column shapes (650M+) fall back to the gather.
+_ONEHOT_BUDGET = 1 << 28
+
+
+def onehot_lookup(idx, table, fill=0.0, batch=1):
+    """``table[..., idx]`` along the last axis, TPU-first.
+
+    Dynamic gathers lower to serialized gather loops on TPU — the
+    config-5 on-chip profile attributed 64% of the 100k-candidate
+    suggest step to gather-bound stages
+    (``profile_step_tpu_20260801_0904.json``) and this one-hot-matmul
+    rewrite cut the step ~7x (229 -> 32 ms).  The [..., n, K] one-hot is
+    built from compares (VPU-trivial) and the lookup rides the MXU; when
+    that operand would be large (wide K or many batched columns) the
+    plain gather is kept — its cost is then amortized over genuinely
+    large work.
+
+    Non-finite ``table`` entries are replaced by ``fill`` BEFORE the
+    matmul (0 * inf would poison it with NaN).  ``fill`` is what a
+    selected non-finite entry decodes to, so callers choose it to
+    preserve their semantics: padding that is never selected can use any
+    finite value; log-scores whose -inf means "never pick" use a large
+    negative finite stand-in (argmax-equivalent).
+
+    ``idx``: int [..., n]; ``table``: [K] or [..., K] with batch dims
+    broadcast-compatible with ``idx``'s.  ``batch``: multiplier for
+    leading dims added OUTSIDE this call (``jax.vmap`` hides them from
+    ``idx.size``) so the budget sees the true operand.
+    """
+    k = table.shape[-1]
+    if k <= _ONEHOT_MAX and idx.size * k * batch <= _ONEHOT_BUDGET:
+        oh = (idx[..., None] == jnp.arange(k)).astype(table.dtype)
+        tab = jnp.where(jnp.isfinite(table), table, fill)
+        return jnp.einsum("...nk,...k->...n", oh, tab)
+    if table.ndim == 1:
+        return table[idx]
+    return jnp.take_along_axis(table, idx, axis=-1)
 
 
 def log_ndtr_diff(a, b):
@@ -154,7 +202,7 @@ def icdf_pick(u, cdf, last):
 
 
 def gmm_sample(key, logw, mu, sigma, trunc_lo, trunc_hi, n,
-               comp_sampler=None):
+               comp_sampler=None, onehot_batch=1):
     """Draw ``n`` fit-space samples from a truncated GMM, inverse-CDF style.
 
     Replaces the reference's rejection loop (``tpe.py::GMM1``) with an exact
@@ -165,6 +213,9 @@ def gmm_sample(key, logw, mu, sigma, trunc_lo, trunc_hi, n,
     ``comp_sampler``: ``"gumbel"`` / ``"icdf"`` — pass a value snapshotted
     at kernel construction so the lowering matches the caller's cache key;
     ``None`` reads the env (callers outside a cached kernel).
+    ``onehot_batch``: vmap batch multiplier forwarded to
+    :func:`onehot_lookup`'s operand budget (a vmapped caller's leading
+    axis is invisible to shapes here).
     """
     kc, ku = jax.random.split(key)
     log_wmass, log_z = _log_trunc_mass(logw, mu, sigma, trunc_lo, trunc_hi)
@@ -172,12 +223,22 @@ def gmm_sample(key, logw, mu, sigma, trunc_lo, trunc_hi, n,
         # Padding components carry −inf log_wmass ⇒ zero CDF increments.
         cdf = jnp.cumsum(jnp.exp(log_wmass - log_z))
         uc = jax.random.uniform(kc, (n,), dtype=jnp.float32)
-        n_live = jnp.sum(log_wmass > -jnp.inf).astype(jnp.int32)
-        comp = icdf_pick(uc, cdf, n_live - 1)
+        # Clamp to the highest live INDEX, not the live count: components
+        # are mu-sorted, so counting would assume zero-mass entries are
+        # all trailing — an interior underflowed component would then
+        # redirect the top CDF segment onto a dead entry's mu/sigma
+        # (round-4 advisor finding; position-safe either way).
+        k_idx = jnp.arange(log_wmass.shape[-1], dtype=jnp.int32)
+        last_live = jnp.max(jnp.where(log_wmass > -jnp.inf, k_idx, -1))
+        comp = icdf_pick(uc, cdf, last_live)
     else:
         comp = jax.random.categorical(kc, log_wmass, shape=(n,))
-    m = mu[comp]
-    s = sigma[comp]
+    # MXU lookups (see onehot_lookup): fit_parzen pads mu with +inf
+    # (sort-to-tail) and such components are never selected, so the
+    # fills are arbitrary finite stand-ins (1.0 for sigma keeps the
+    # divisions below NaN-free even transiently).
+    m = onehot_lookup(comp, mu, 0.0, batch=onehot_batch)
+    s = onehot_lookup(comp, sigma, 1.0, batch=onehot_batch)
     pa = jax.scipy.special.ndtr((trunc_lo - m) / s)
     pb = jax.scipy.special.ndtr((trunc_hi - m) / s)
     u = jax.random.uniform(ku, (n,), dtype=jnp.float32)

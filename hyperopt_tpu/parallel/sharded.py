@@ -30,7 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import base
-from ..space import CompiledSpace
+from ..space import CompiledSpace, prng_key
 from ..tpe import (
     _TpeKernel,
     _batch_size_for,
@@ -281,7 +281,7 @@ def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
     fn = cache[ck]
 
     hv, ha, hl, hok = _padded_history(h, kern.n_cap)
-    keys = jax.random.split(jax.random.key(int(seed) % (2 ** 32)), n_starts)
+    keys = jax.random.split(prng_key(int(seed) % (2 ** 32)), n_starts)
     with mesh:
         rows, _ = fn(keys, _gamma_spread(gamma, n_starts), hv, ha, hl, hok,
                      np.float32(prior_weight))

@@ -45,6 +45,7 @@ from .space import (
     Expr,
     Param,
     compile_space,
+    prng_key,
 )
 
 
@@ -286,5 +287,5 @@ class stochastic:
             else:  # legacy RandomState
                 seed = rng.randint(2 ** 31 - 1)
         cs = compile_space(space)
-        vals, active = cs.sample(jax.random.key(int(seed)), 1)
+        vals, active = cs.sample(prng_key(int(seed)), 1)
         return cs.decode_row(np.asarray(vals)[0], np.asarray(active)[0])

@@ -14,6 +14,7 @@ import jax
 import numpy as np
 
 from . import base
+from .space import prng_key
 
 
 def suggest(new_ids, domain, trials, seed):
@@ -21,7 +22,7 @@ def suggest(new_ids, domain, trials, seed):
     n = len(new_ids)
     if n == 0:
         return []
-    key = jax.random.key(int(seed) % (2 ** 32))
+    key = prng_key(int(seed) % (2 ** 32))
     vals, _ = domain.cs.sample(key, n)
     # Fetch only the values (one device sync); the mask is a pure host
     # function of them (space.py::active_mask_host).
@@ -33,5 +34,5 @@ def suggest(new_ids, domain, trials, seed):
 
 def suggest_batch(new_ids, domain, trials, seed):
     """Return raw (vals, active) arrays for ``new_ids`` without packaging."""
-    key = jax.random.key(int(seed) % (2 ** 32))
+    key = prng_key(int(seed) % (2 ** 32))
     return domain.cs.sample(key, len(new_ids))

@@ -78,6 +78,34 @@ _Q_KINDS = (QUNIFORM, QLOGUNIFORM, QNORMAL, QLOGNORMAL)
 
 # Widest hp.randint range representable exactly in the f32 vals matrix.
 _MAX_RANDINT_RANGE = 2 ** 24
+
+
+def prng_impl() -> str:
+    """PRNG lowering for every key this package creates.
+
+    ``HYPEROPT_TPU_PRNG``: ``threefry2x32`` (default — JAX's default
+    counter-based generator, identical streams on every backend) or
+    ``rbg`` (XLA RngBitGenerator: the TPU's hardware generator for the
+    bit draws, threefry only for ``split``/``fold_in``).  Motivation:
+    the round-5 on-chip profile attributes ~3 ms of the ~11.6 ms true
+    step compute to threefry bit generation alone
+    (``profile_step_tpu_20260801_0836.json`` ``rng_bits``) — ALU work
+    the hardware generator does nearly for free.  Different impls are
+    different RNG STREAMS (seeded runs re-baseline), same
+    distributions (the KS/χ² suite passes under either).
+    """
+    import os
+
+    env = os.environ.get("HYPEROPT_TPU_PRNG", "threefry2x32")
+    return env if env in ("threefry2x32", "rbg", "unsafe_rbg") \
+        else "threefry2x32"
+
+
+def prng_key(seed):
+    """``jax.random.key`` under the :func:`prng_impl` lowering — the one
+    key-construction entry every suggest/sample path uses (traceable:
+    ``seed`` may be a traced uint32, as in the seeded-jit entries)."""
+    return jax.random.key(seed, impl=prng_impl())
 # Above this many options a randint is sampled by integer draw instead of
 # materialized per-option logits (dense logits are what TPE's categorical
 # posterior consumes; wide randints use the quantized-continuous posterior).
@@ -398,15 +426,20 @@ class CompiledSpace:
             if n <= 0:
                 raise ValueError(
                     f"hp.randint({node.label!r}): empty range [{low}, {high})")
-            if n > _MAX_RANDINT_RANGE:
+            if n > _MAX_RANDINT_RANGE or (
+                    max(abs(low), abs(high)) > _MAX_RANDINT_RANGE):
                 # Values are stored in an f32 SoA matrix on device; integers
-                # above 2**24 would silently lose precision.  Ranges this wide
-                # are seed-search idioms where model-based suggest carries no
-                # information anyway — reject loudly rather than corrupt.
+                # above 2**24 would silently lose precision — both for wide
+                # ranges AND for narrow ranges placed far from zero
+                # (randint(1e9, 1e9+10): every value collides in f32).
+                # Ranges this wide are seed-search idioms where model-based
+                # suggest carries no information anyway — reject loudly
+                # rather than corrupt.
                 raise ValueError(
-                    f"hp.randint({node.label!r}): range {n} exceeds "
-                    f"{_MAX_RANDINT_RANGE} (f32-exact integer limit); use "
-                    f"hp.quniform or shrink the range")
+                    f"hp.randint({node.label!r}): range [{low}, {high}) "
+                    f"needs integers beyond {_MAX_RANDINT_RANGE} (f32-exact "
+                    f"integer limit); shrink/rescale the range (e.g. search "
+                    f"an offset or exponent instead)")
             probs = tuple([1.0 / n] * n) if n <= _DENSE_CAT_MAX else None
             kw.update(low=float(low), high=float(high), probs=probs,
                       n_options=n)
@@ -426,8 +459,55 @@ class CompiledSpace:
                 if q <= 0:
                     raise ValueError(f"hp.{node.kind}({node.label!r}): q must be > 0")
                 kw.update(q=q)
+                self._check_exact_lattice(node, kw, q)
         self._mutable_specs.append(ParamSpec(**kw))
         return pid
+
+    @staticmethod
+    def _check_exact_lattice(node: Param, kw: dict, q: float) -> None:
+        """Integer-exactness guard for every quantized kind.
+
+        Sampled values are lattice points ``k*q`` held in the f32 ``vals``
+        matrix; once ``|k|`` exceeds 2**24 adjacent lattice points collide
+        and decode silently returns corrupted integers — e.g.
+        ``hp.quniform("x", 0, 1e9, 1)`` above ~1.6e7.  The ``hp.randint``
+        path already rejected such ranges; this extends the same guard to
+        quniform/qloguniform/qnormal/qlognormal/uniformint (corruption here
+        is silent, so a compile-time raise is strictly better).  Bounded
+        kinds get a hard reject; the unbounded normal family rejects on a
+        2-sigma core envelope, with the residual tail made SAFE rather
+        than illegal — sample_traced clips q-lattice normal draws to the
+        +/-2**24*q exactly-representable edge.
+        """
+        limit = float(_MAX_RANDINT_RANGE)
+        if node.kind in (QUNIFORM, UNIFORMINT):
+            bad = max(abs(kw["low"]), abs(kw["high"])) / q > limit
+            reach = "the bound furthest from zero"
+        elif node.kind == QNORMAL:
+            # Unbounded support: reject only when the 2-sigma CORE of the
+            # distribution corrupts (most draws would collide); rarer tail
+            # draws SATURATE at the +/-2**24*q lattice edge instead of
+            # corrupting (sample_traced clips them) — e.g. the reference
+            # test space qlognormal(0, 2, 1) stays legal, its beyond-limit
+            # mass being ~4e-17.
+            bad = (abs(kw["mu"]) + 2.0 * kw["sigma"]) / q > limit
+            reach = "|mu| + 2*sigma"
+        elif node.kind == QLOGUNIFORM:
+            bad = kw["high"] > math.log(limit) + math.log(q)
+            reach = "exp(high)"
+        elif node.kind == QLOGNORMAL:
+            bad = kw["mu"] + 2.0 * kw["sigma"] > math.log(limit) + math.log(q)
+            reach = "exp(mu + 2*sigma)"
+        else:
+            return
+        if bad:
+            raise ValueError(
+                f"hp.{node.kind}({node.label!r}): lattice indices up to "
+                f"{reach} / q exceed {_MAX_RANDINT_RANGE}, the f32-exact "
+                f"integer limit of the on-device values matrix; values this "
+                f"far from zero would silently collide on the q={q} lattice. "
+                f"Shrink the range, increase q, or rescale the parameter "
+                f"(e.g. search an exponent instead)")
 
     def _build(self, node, conditions):
         """Walk the nested structure, returning a template tree."""
@@ -529,6 +609,12 @@ class CompiledSpace:
         self._nf_sigma = f32([p.sigma for p in nf])
         self._nf_log = np.asarray([p.is_log for p in nf], dtype=bool)
         self._nf_q = f32([p.q if p.q else 0.0 for p in nf])
+        # Quantized normal-family tails saturate at the last f32-exact
+        # lattice point (+/-2**24*q) instead of silently colliding — the
+        # compile-time guard rejects only distributions whose 2-sigma core
+        # crosses this edge (see _check_exact_lattice).
+        self._nf_clip = f32([_MAX_RANDINT_RANGE * p.q if p.q else np.inf
+                             for p in nf])
 
         kmax = max([p.n_options for p in cat], default=1)
         self.cat_kmax = kmax
@@ -573,6 +659,7 @@ class CompiledSpace:
             x = jnp.where(self._nf_q > 0,
                           jnp.round(x / jnp.where(self._nf_q > 0, self._nf_q, 1.0))
                           * self._nf_q, x)
+            x = jnp.clip(x, -self._nf_clip, self._nf_clip)
             cols.append(x)
         if self._cat:
             g = jax.random.gumbel(

@@ -56,6 +56,7 @@ from .ops import (
     gmm_logpdf,
     gmm_sample,
 )
+from .ops.gmm import onehot_lookup
 from .space import (
     CATEGORICAL,
     LOGNORMAL,
@@ -68,6 +69,8 @@ from .space import (
     UNIFORM,
     UNIFORMINT,
     CompiledSpace,
+    prng_impl,
+    prng_key,
 )
 
 _default_prior_weight = 1.0
@@ -232,6 +235,18 @@ class _ContGroup:
                 # (reference: ap_normal_sampler and log/q variants).
                 self.prior_mu[i] = s.mu
                 self.prior_sigma[i] = s.sigma
+                if s.q:
+                    # Same integer-exactness invariant as sample_traced
+                    # (space.py::_build_groups _nf_clip): quantized normal
+                    # tails saturate at the last f32-exact lattice point
+                    # instead of silently colliding — the compile-time
+                    # guard only rejects distributions whose 2-sigma CORE
+                    # crosses the edge, so posterior draws must clip too.
+                    from .space import _MAX_RANDINT_RANGE
+
+                    self.clip_hi[i] = _MAX_RANDINT_RANGE * s.q
+                    self.clip_lo[i] = (0.0 if s.kind == QLOGNORMAL
+                                       else -self.clip_hi[i])
                 continue
             self.fit_lo[i], self.fit_hi[i] = lo, hi
             # Reference ap_uniform_sampler prior: mid-point mean, full-width
@@ -472,7 +487,8 @@ class _TpeKernel:
         zc = jax.vmap(
             lambda k, lw, mu, sg, lo, hi:
             gmm_sample(k, lw, mu, sg, lo, hi, self.n_cand,
-                       comp_sampler=self.comp_sampler)
+                       comp_sampler=self.comp_sampler,
+                       onehot_batch=len(g))   # vmap axis, for the budget
         )(keys, lwb, mub, sgb, jnp.asarray(g.fit_lo),
           jnp.asarray(g.fit_hi))                            # [C, n_cand]
         return self._constrain_cand(zc)
@@ -521,7 +537,11 @@ class _TpeKernel:
                 idx = jnp.round(v / q).astype(jnp.int32) \
                     - jnp.asarray(g.lat_k0, jnp.int32)[:, None]
                 idx = jnp.clip(idx, 0, lat_v.shape[1] - 1)
-                ei = jnp.take_along_axis(ei_lat, idx, axis=1)
+                # MXU lookup (ops/gmm.py::onehot_lookup).  ei_lat can
+                # legitimately hold -inf at SELECTABLE far-tail lattice
+                # points (zero below-mass) — the -3e38 fill preserves
+                # "never wins the argmax" exactly.
+                ei = onehot_lookup(idx, ei_lat, -3e38)
             else:
                 ei = self._chunked_score(ei_q, q_edges(v))
         else:
@@ -533,7 +553,13 @@ class _TpeKernel:
                 # folded in here.
                 from .ops.pallas_gmm import ei_scores
 
-                tile = _pallas_tile() or (512 if self.n_cap <= 2048 else 256)
+                # Default tile: 1024 measured best or tied at both the
+                # 10k x 50 and 100k x 100 shapes post one-hot rewrite
+                # (benchmarks/tile_sweep_100k_tpu_20260801_0918.json:
+                # 32.4 ms vs 35.5 at 512; profile full_tile1024 ties
+                # full_tile512 at 10k).  Larger histories shrink the
+                # tile to keep the mixture block + candidate tile in VMEM.
+                tile = _pallas_tile() or (1024 if self.n_cap <= 2048 else 256)
                 ei = ei_scores(zc, lwb, mub, sgb, lwa, mua, sga,
                                tile=tile,
                                interpret=self.pallas == "interpret")
@@ -605,8 +631,13 @@ class _TpeKernel:
                 jax.random.gumbel(key, (d, self.n_cand, kmax),
                                   dtype=jnp.float32), axis=1)
             cand = jnp.argmax(lpb[:, None, :] + g, axis=-1)  # [D, n_cand]
-        score = (jnp.take_along_axis(lpb, cand, axis=1)
-                 - jnp.take_along_axis(lpa, cand, axis=1))
+        # MXU lookup (ops/gmm.py::onehot_lookup) of the score diff:
+        # padded options carry -inf in BOTH lpb and lpa (NaN under
+        # subtraction), so each side is made finite first — padded
+        # indices are never selected, the stand-in value is irrelevant.
+        diff = (jnp.where(jnp.isfinite(lpb), lpb, 0.0)
+                - jnp.where(jnp.isfinite(lpa), lpa, 0.0))  # [D, kmax]
+        score = onehot_lookup(cand, diff)
         return cand.astype(jnp.float32) + self.cat_offsets[:, None], score
 
     # -- the step ------------------------------------------------------------
@@ -672,7 +703,7 @@ class _TpeKernel:
     # per-step host milliseconds).
 
     def _seeded_one(self, seed, vals, active, loss, ok, gamma, prior_weight):
-        return self._suggest_one(jax.random.key(seed), vals, active, loss,
+        return self._suggest_one(prng_key(seed), vals, active, loss,
                                  ok, gamma, prior_weight)
 
     def suggest_seeded(self, seed, vals, active, loss, ok, gamma,
@@ -732,7 +763,7 @@ class _TpeKernel:
         if fn is None:
             def run(seed, n_rows, vals, active, loss, ok, gamma,
                     prior_weight):
-                keys = jax.random.split(jax.random.key(seed), n)
+                keys = jax.random.split(prng_key(seed), n)
                 return self._liar_scan(keys, n_rows, vals, active, loss,
                                        ok, gamma, prior_weight)
 
@@ -819,7 +850,8 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
     # Env toggles baked into the traced program all key the cache —
     # a mid-process toggle must produce a fresh kernel, never a stale one.
     k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
-         _pallas_mode(), _comp_sampler(), _pallas_tile(), _split_impl())
+         _pallas_mode(), _comp_sampler(), _pallas_tile(), _split_impl(),
+         prng_impl())
     if k not in cache:
         cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split, multivariate,
                               cat_prior)

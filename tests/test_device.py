@@ -112,6 +112,36 @@ class TestFminDevice:
         assert info["losses"].shape == (10,)
         assert np.isfinite(info["losses"]).all()
 
+    def test_defaulted_kwarg_not_mistaken_for_mask(self):
+        # Round-4 advisor finding: an objective with a config knob
+        # (second positional param WITH a default) must be treated as
+        # one-argument — feeding the activity dict into `scale` would
+        # corrupt every loss with no error.
+        space = {"x": hp.uniform("x", -5, 5)}
+        seen = {}
+
+        def obj(p, scale=2.0):
+            seen["scale"] = scale
+            return (p["x"] - 1.0) ** 2 * scale
+
+        _, info = ho.fmin_device(obj, space, max_evals=30, seed=0)
+        assert seen["scale"] == 2.0          # default preserved, not a dict
+        assert np.isfinite(info["losses"]).all()
+
+    def test_mesh_indivisible_candidates_fails_at_boundary(self):
+        # Round-4 advisor finding: the simplest mesh call used to raise
+        # from deep inside ShardedTpeKernel; now fmin_device itself names
+        # the kwarg and the next workable value.
+        from hyperopt_tpu import parallel
+
+        mesh = parallel.default_mesh()
+        n_sp = mesh.shape["sp"]
+        if n_sp <= 1:
+            pytest.skip("single-device mesh: everything divides")
+        with pytest.raises(ValueError, match="n_EI_candidates"):
+            ho.fmin_device(_branin, BRANIN_SPACE, max_evals=30, mesh=mesh,
+                           n_EI_candidates=n_sp * 3 + 1)
+
     @pytest.mark.slow
     def test_sharded_mesh_loop(self):
         """fmin_device(mesh=): sharding is an execution-layout change,

@@ -110,6 +110,47 @@ class TestLatestTpuArtifact:
         assert ref.endswith("bench_20260731_1904.json")
         assert doc["value"] == 15.7
 
+    def test_nontimestamp_digit_run_cannot_outrank(self, tmp_path,
+                                                   monkeypatch):
+        # Round-4 advisor finding: an unanchored digit-run match let a
+        # name like bench_v99999999.json rank as a far-future date and
+        # permanently beat every real run.  Anchored stems ignore it
+        # (it falls back to mtime-only, below every stamped artifact).
+        import json as _json
+        import os as _os
+
+        bench = self._bench()
+        bdir = tmp_path / "benchmarks"
+        bdir.mkdir()
+        fake = bdir / "bench_v99999999.json"
+        real = bdir / "bench_20260731_1904.json"
+        fake.write_text(_json.dumps({"backend": "tpu", "value": 1.0}))
+        real.write_text(_json.dumps({"backend": "tpu", "value": 15.7}))
+        _os.utime(fake, (9e9, 9e9))   # newer mtime too
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        ref, doc = bench._latest_tpu_artifact()
+        assert ref.endswith("bench_20260731_1904.json")
+
+    def test_legacy_suffix_after_date_keeps_its_stamp(self, tmp_path,
+                                                      monkeypatch):
+        # Round-5 review finding: the repo's own legacy artifacts put the
+        # suffix AFTER the date (bench_tpu_20260731_full.json); anchoring
+        # must not demote them to stamp "0" below older dated runs.
+        import json as _json
+        import os as _os
+
+        bench = self._bench()
+        bdir = tmp_path / "benchmarks"
+        bdir.mkdir()
+        old = bdir / "bench_tpu_20260729.json"
+        legacy = bdir / "bench_tpu_20260731_full.json"
+        old.write_text(_json.dumps({"backend": "tpu", "value": 87.4}))
+        legacy.write_text(_json.dumps({"backend": "tpu", "value": 15.5}))
+        _os.utime(old, (9e9, 9e9))   # mtime must not decide
+        monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+        ref, doc = bench._latest_tpu_artifact()
+        assert ref.endswith("bench_tpu_20260731_full.json")
+
     def test_cpu_label_and_nulls_skipped(self, tmp_path, monkeypatch):
         import json as _json
 

@@ -271,6 +271,50 @@ class TestGmmSample:
         d, p = stats.kstest(s, cdf)
         assert p > 0.01, (d, p)
 
+    def test_icdf_interior_dead_component_never_sampled(self, monkeypatch):
+        """Round-4 advisor finding: the icdf clamp used the live COUNT,
+        which assumed zero-mass components are all trailing; a dead
+        INTERIOR component (mu-sorted mixtures can underflow one in the
+        middle) would then receive the entire top CDF segment.  The clamp
+        now targets the highest live index, so top-of-CDF uniforms land on
+        the last LIVE component."""
+        monkeypatch.setenv("HYPEROPT_TPU_COMP_SAMPLER", "icdf")
+        w = np.array([0.5, 0.0, 0.5], np.float32)       # interior dead
+        mu = np.array([-2.0, 0.0, 2.0], np.float32)
+        sg = np.array([0.05, 0.05, 0.05], np.float32)
+        s = np.asarray(gmm_sample(jax.random.key(0),
+                                  jnp.log(jnp.asarray(w)),
+                                  jnp.asarray(mu), jnp.asarray(sg),
+                                  -jnp.inf, jnp.inf, 4000))
+        # No sample may come from the dead middle component (|s| < 1),
+        # and both live components must be hit roughly evenly.
+        assert (np.abs(s) > 1.0).all()
+        frac_hi = (s > 0).mean()
+        assert 0.4 < frac_hi < 0.6
+
+
+def test_qnormal_posterior_clips_at_f32_lattice_edge():
+    """The sample_traced integer-exactness invariant (q-lattice normal
+    tails saturate at +/-2**24*q) must hold for TPE posterior draws too:
+    the group setup mirrors space.py's _nf_clip (round-5 review
+    finding — the guard only rejects distributions whose 2-sigma core
+    crosses the edge, so candidate draws past it must clip, not
+    collide)."""
+    from hyperopt_tpu import hp as hp_
+    from hyperopt_tpu.space import _MAX_RANDINT_RANGE
+
+    cs = compile_space({"x": hp_.qnormal("x", 16_000_000, 300_000, 1.0),
+                        "y": hp_.qlognormal("y", 14.0, 1.0, 1.0)})
+    kern = tpe.get_kernel(cs, 64, 32, 25)
+    g = [g for g in kern.groups if g.is_q][0]
+    by = {int(p): i for i, p in enumerate(g.pids)}
+    xi = by[cs.by_label["x"].pid]
+    yi = by[cs.by_label["y"].pid]
+    assert g.clip_hi[xi] == _MAX_RANDINT_RANGE
+    assert g.clip_lo[xi] == -float(_MAX_RANDINT_RANGE)
+    assert g.clip_hi[yi] == _MAX_RANDINT_RANGE
+    assert g.clip_lo[yi] == 0.0
+
 
 class TestSplitImpl:
     """The top-k γ-split lowering is bit-identical to the double-argsort

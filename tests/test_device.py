@@ -183,6 +183,7 @@ class TestFminDevice:
             ho.fmin_device(_branin, BRANIN_SPACE, max_evals=60, seed=0,
                            init=info60)
 
+    @pytest.mark.slow
     def test_resume_shorter_than_startup(self):
         """A resumed history shorter than n_startup_jobs owes only the
         REMAINDER in startup draws."""
@@ -195,6 +196,7 @@ class TestFminDevice:
         np.testing.assert_array_equal(info30["losses"][:5],
                                       info5["losses"])
 
+    @pytest.mark.slow
     def test_multi_run_restarts(self):
         """n_runs=K: K independent restarts vmapped into one program;
         best is the best across runs and the info arrays gain the run
@@ -250,6 +252,7 @@ class TestFminDevice:
         assert np.isinf(info["losses"][info["n_trials"]:]).all()
         assert info["best_loss"] == pytest.approx(1.0)
 
+    @pytest.mark.slow
     def test_patience_runs_full_budget_when_improving(self):
         _, info = ho.fmin_device(_branin, BRANIN_SPACE, max_evals=50,
                                  seed=1, patience=50)
@@ -281,6 +284,7 @@ class TestFminDevice:
         assert isinstance(best["c0"], int)
         assert float(best["q0"]) % 2.0 == 0.0
 
+    @pytest.mark.slow
     def test_tuning_kwargs_pass_through(self):
         """The quality-winning tuning kwargs (multivariate joint-EI,
         quantile split) flow into the fused loop's kernel unchanged."""

@@ -13,12 +13,24 @@ import sys
 
 import jax
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))
 
 import __graft_entry__ as graft  # noqa: E402
 
 
+def test_entry_surface_smoke():
+    # Quick-loop safety net (the jit-executing versions below are slow):
+    # the driver-contract entry points must exist and build their
+    # arguments without compiling anything.
+    fn, args = graft.entry()
+    assert callable(fn)
+    assert isinstance(args, tuple) and len(args) >= 1
+    assert callable(graft.dryrun_multichip)
+
+
+@pytest.mark.slow
 def test_entry_jits_and_runs():
     fn, args = graft.entry()
     row, act = jax.jit(fn)(*args)
@@ -28,6 +40,7 @@ def test_entry_jits_and_runs():
     assert np.isfinite(row[act]).all()
 
 
+@pytest.mark.slow
 def test_dryrun_multichip_8(capsys):
     graft.dryrun_multichip(8)
     out = capsys.readouterr().out

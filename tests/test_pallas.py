@@ -55,6 +55,7 @@ class TestPallasEiKernel:
         (2, 1000, 26, 1026, 256),    # n % tile != 0 AND k % 128 != 0 pads
         (1, 128, 1, 1, 128),         # single-component mixtures
     ])
+    @pytest.mark.slow
     def test_bench_shapes_match_xla(self, rng, c, n, kb, ka, tile):
         # The exact tile/K/N shapes bench.py's pallas_ab phase runs on the
         # real chip — validated in interpret mode so a native failure at
@@ -90,6 +91,7 @@ class TestPallasEiKernel:
         # identical below/above mixtures → EI identically ~0
         np.testing.assert_allclose(out, 0.0, atol=1e-3)
 
+    @pytest.mark.slow
     def test_end_to_end_interpret_mode(self, monkeypatch):
         # A whole TPE run through the Pallas (interpret) path converges the
         # same way the XLA path does.

@@ -41,6 +41,7 @@ def _quad(d):
 
 
 class TestShardedSuggest:
+    @pytest.mark.slow
     def test_8way_candidate_sharding(self):
         assert len(jax.devices()) == 8, "conftest should force 8 CPU devices"
         mesh = default_mesh(n_starts=1)
@@ -109,6 +110,7 @@ class TestSuggestKwargParity:
             missing = self.TUNING - params
             assert not missing, f"{fn.__name__} missing {missing}"
 
+    @pytest.mark.slow
     def test_sharded_multivariate_quality(self):
         """multivariate=True on the mesh: the quality-winning joint-EI
         config (README table) now runs sharded; conditional + categorical
@@ -161,6 +163,7 @@ class TestSuggestKwargParity:
 
 
 class TestMultiStart:
+    @pytest.mark.slow
     def test_k_distinct_proposals_one_call(self):
         mesh = Mesh(np.asarray(jax.devices()), (START_AXIS,))
         from functools import partial
@@ -190,6 +193,7 @@ class TestNetStore:
         srv.start()
         return srv
 
+    @pytest.mark.slow
     def test_net_workers_drain_queue(self, tmp_path):
         from hyperopt_tpu.parallel import NetTrials, NetWorker
 
@@ -393,6 +397,7 @@ class TestNetStore:
 
 
 class TestFileStore:
+    @pytest.mark.slow
     def test_workers_drain_queue(self, tmp_path):
         root = str(tmp_path)
         dom = Domain(_quad, _quad_space())
@@ -447,6 +452,7 @@ class TestFileStore:
         assert sorted(counts) == list(range(10))
         assert all(c == 1 for c in counts.values()), counts
 
+    @pytest.mark.slow
     def test_atomic_claim_across_processes(self, tmp_path):
         # The exclusive-create claim must hold across real OS processes
         # (threads share the interpreter; this is the MongoDB-grade
@@ -525,6 +531,7 @@ class TestFileStore:
         from hyperopt_tpu import JOB_STATE_ERROR
         assert sum(1 for d in ft if d["state"] == JOB_STATE_ERROR) == 3
 
+    @pytest.mark.slow
     def test_cli_worker_subprocess(self, tmp_path):
         # The console entry point evaluates jobs from a pickled domain
         # (mongoexp's hyperopt-mongo-worker path, SURVEY.md §3.4).

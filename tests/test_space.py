@@ -150,6 +150,7 @@ class TestPrngImpl:
         p = st.chisquare(counts, 8192 * np.array([0.2, 0.3, 0.5])).pvalue
         assert p > 1e-3, counts
 
+    @pytest.mark.slow
     def test_rbg_fmin_runs_and_converges(self, monkeypatch):
         monkeypatch.setenv("HYPEROPT_TPU_PRNG", "rbg")
         t = ht.Trials()
@@ -309,6 +310,7 @@ def test_space_eval_int_coercion():
     assert out == {"n": 4} and isinstance(out["n"], int)
 
 
+@pytest.mark.slow
 def test_zoo_spaces_compile_and_decode():
     for z in ZOO.values():
         cs, v, a = _sample(z.space, n=32, seed=7)
@@ -463,6 +465,7 @@ def _random_space(rng, depth=0, counter=None):
     return (42, _random_space(rng, depth + 1, counter))
 
 
+@pytest.mark.slow
 def test_fuzz_compile_sample_decode_roundtrip():
     rng = np.random.default_rng(12345)
     for trial in range(25):
@@ -584,6 +587,7 @@ def test_persistent_cache_knob(tmp_path, monkeypatch):
         sp._persistent_cache_checked = True
 
 
+@pytest.mark.slow
 def test_concurrent_fmin_share_compiled_space():
     # Memoization makes concurrent fmin runs over equal spaces share ONE
     # CompiledSpace (and its kernel caches); jit dispatch is thread-safe

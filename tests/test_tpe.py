@@ -464,6 +464,7 @@ class TestSuggestApi:
                 assert len(vals["amp"]) == 1
                 assert 0.5 <= vals["amp"][0] <= 2.0
 
+    @pytest.mark.slow
     def test_multi_id_batch(self):
         z = ZOO["quadratic1"]
         from hyperopt_tpu.base import Domain
@@ -474,6 +475,7 @@ class TestSuggestApi:
         xs = [doc["misc"]["vals"]["x"][0] for doc in docs]
         assert len(set(xs)) == 3  # distinct draws per id
 
+    @pytest.mark.slow
     def test_int_params_are_ints(self):
         t = _run("many_dists", tpe.suggest, 0, max_evals=30)
         for doc in t:
@@ -492,6 +494,7 @@ class TestSuggestApi:
                 assert abs(vals["f"][0] - round(vals["f"][0])) < 1e-5
 
 
+    @pytest.mark.slow
     def test_bucket_prewarm_matches_call_signature(self, monkeypatch):
         # The background AOT compile must land in the same jit-cache entry
         # the real (seeded) hot path uses — a signature mismatch would
@@ -580,6 +583,7 @@ class TestSuggestApi:
         docs = tpe.suggest([500], d, t, 9)   # and still proposes after
         assert np.isfinite(docs[0]["misc"]["vals"]["x"][0])
 
+    @pytest.mark.slow
     def test_pchoice_posterior_concentrates_on_good_option(self):
         # A loss gradient favoring the LOWEST-prior option must dominate
         # the pchoice prior once history accumulates: TPE's below-model
@@ -708,6 +712,7 @@ class TestQuantizedScoringEdges:
         expect = stats.norm.cdf((np.log(0.5) - 0.5) / 1.1)
         assert np.isclose(float(jnp.exp(lm[0])), expect, atol=1e-5)
 
+    @pytest.mark.slow
     def test_suggest_handles_zero_heavy_qlognormal(self):
         # History concentrated at v=0 (the zero bin): the suggest step must
         # stay finite and keep proposing lattice values.
@@ -878,6 +883,7 @@ class TestMultivariate:
     """Joint-vector EI (multivariate=True): the winner is one coherent
     candidate vector, not per-column argmaxes that may never co-occur."""
 
+    @pytest.mark.slow
     def test_docs_valid_on_conditional_space(self):
         from hyperopt_tpu.base import Domain
         z = ZOO["gauss_wave2"]
@@ -905,6 +911,7 @@ class TestMultivariate:
             for s in SEEDS])
         assert best <= ZOO["branin"].tpe_thresh, best
 
+    @pytest.mark.slow
     def test_multivariate_batch_and_overlap(self):
         from hyperopt_tpu import Trials as T, fmin as fm
         t = T()

@@ -1,0 +1,87 @@
+"""Standalone A/B: VPU vs MXU (quadratic-expansion matmul) EI kernel.
+
+Correctness (allclose vs the XLA scorer) + steady-state latency at the
+bench shapes.  Run on-chip; decides whether the mxu flag becomes a
+default (round-5 'spend the headroom' follow-on).
+"""
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    from hyperopt_tpu.ops import gmm_logpdf
+    from hyperopt_tpu.ops.pallas_gmm import ei_scores
+
+    backend = jax.default_backend()
+    interpret = backend != "tpu"
+    rng = np.random.default_rng(0)
+    res = {"metric": "ei_vpu_vs_mxu", "backend": backend, "shapes": {}}
+
+    for name, (c, n, kb, ka) in {
+        "bench_10k": (10, 4096, 32, 1032),
+        "cfg5_100k": (4, 100_000, 32, 1032),
+    }.items():
+        z = jnp.asarray(rng.normal(0, 2, (c, n)), jnp.float32)
+
+        def mix(k):
+            w = rng.dirichlet(np.ones(k), c).astype(np.float32)
+            mu = rng.normal(0, 2, (c, k)).astype(np.float32)
+            sg = rng.uniform(0.05, 2.0, (c, k)).astype(np.float32)
+            # pad one component per column to exercise the -inf path
+            w[:, -1] = 0.0
+            return jnp.log(jnp.asarray(w)), jnp.asarray(mu), jnp.asarray(sg)
+
+        lwb, mub, sgb = mix(kb)
+        lwa, mua, sga = mix(ka)
+
+        def xla_ref():
+            def one(zz, lw, mu, sg):
+                return gmm_logpdf(zz, lw, mu, sg)
+            sb = jax.vmap(one)(z, lwb, mub, sgb)
+            sa = jax.vmap(one)(z, lwa, mua, sga)
+            return sb - sa
+
+        ref = np.asarray(jax.jit(xla_ref)())
+        rec = {}
+        for label, mxu in (("vpu", False), ("mxu", True)):
+            try:
+                fn = lambda: ei_scores(z, lwb, mub, sgb, lwa, mua, sga,
+                                       tile=1024, interpret=interpret,
+                                       mxu=mxu)
+                got = np.asarray(fn())
+                ok = np.allclose(got, ref, rtol=2e-3, atol=2e-3)
+                rec[f"{label}_allclose"] = bool(ok)
+                if not ok:
+                    rec[f"{label}_maxerr"] = float(np.max(np.abs(got - ref)))
+                k = 16
+                fn()  # warm
+                t0 = time.perf_counter()
+                for _ in range(k):
+                    out = fn()
+                np.asarray(out[0, 0])
+                rec[f"{label}_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3 / k, 3)
+            except Exception as e:
+                rec[f"{label}_error"] = f"{type(e).__name__}: {e}"
+        res["shapes"][name] = rec
+        print(json.dumps({name: rec}), flush=True)
+
+    stamp = time.strftime("%Y%m%d_%H%M", time.gmtime())
+    out_path = os.path.join(_ROOT, "benchmarks",
+                            f"ei_mxu_ab_{backend}_{stamp}.json")
+    with open(out_path, "w") as f:
+        json.dump(res, f, indent=1)
+    print(f"# wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

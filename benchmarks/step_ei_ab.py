@@ -1,0 +1,70 @@
+"""Full-step A/B: HYPEROPT_TPU_PALLAS_EI=vpu vs mxu at both bench shapes."""
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+import numpy as np
+import jax
+
+
+def main():
+    from __graft_entry__ import _flagship_space, _history
+    from hyperopt_tpu.space import compile_space
+    from hyperopt_tpu.tpe import _bucket, _padded_history, get_kernel
+
+    backend = jax.default_backend()
+    os.environ["HYPEROPT_TPU_PALLAS"] = "1" if backend == "tpu" else "0"
+    res = {"metric": "step_ei_vpu_vs_mxu", "backend": backend, "shapes": {}}
+
+    for name, (n_dims, n_cand, k_steady) in {
+        "10k_50": (50, 10_000, 32),
+        "100k_100": (100, 100_000, 8),
+    }.items():
+        cs = compile_space(_flagship_space(n_dims))
+        n_cap = _bucket(1000)
+        hv, ha, hl, hok = _padded_history(_history(cs, 1000), n_cap)
+        hv, ha = jax.device_put(hv), jax.device_put(ha)
+        hl, hok = jax.device_put(hl), jax.device_put(hok)
+        key = jax.random.key(0)
+        rec = {}
+        rows = {}
+        for impl in ("vpu", "mxu"):
+            os.environ["HYPEROPT_TPU_PALLAS_EI"] = impl
+            try:
+                kern = get_kernel(cs, n_cap, n_cand, 25)
+                fn = jax.jit(kern._suggest_one)
+                out = fn(key, hv, ha, hl, hok, np.float32(0.25),
+                         np.float32(1.0))
+                rows[impl] = np.asarray(out[0])
+                t0 = time.perf_counter()
+                for i in range(k_steady):
+                    out = fn(jax.random.fold_in(key, i), hv, ha, hl, hok,
+                             np.float32(0.25), np.float32(1.0))
+                np.asarray(out[0])
+                rec[f"{impl}_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3 / k_steady, 3)
+            except Exception as e:
+                rec[f"{impl}_error"] = f"{type(e).__name__}: {e}"
+        os.environ.pop("HYPEROPT_TPU_PALLAS_EI", None)
+        if "vpu" in rows and "mxu" in rows:
+            # Same seed: proposals should agree except where the two
+            # lowerings' float noise flips a near-tie argmax.
+            rec["proposal_max_absdiff"] = float(
+                np.max(np.abs(rows["vpu"] - rows["mxu"])))
+        res["shapes"][name] = rec
+        print(json.dumps({name: rec}), flush=True)
+
+    stamp = time.strftime("%Y%m%d_%H%M", time.gmtime())
+    out_path = os.path.join(_ROOT, "benchmarks",
+                            f"step_ei_ab_{backend}_{stamp}.json")
+    with open(out_path, "w") as f:
+        json.dump(res, f, indent=1)
+    print(f"# wrote {out_path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -54,6 +54,7 @@ from .tpe import (
     _default_n_startup_jobs,
     _default_prior_weight,
     _insert_row,
+    _pallas_tile,
     get_kernel,
 )
 
@@ -248,8 +249,9 @@ def fmin_device(fn, space, max_evals, seed=0,
                  int(n_EI_candidates),
                  float(gamma), float(prior_weight), int(linear_forgetting),
                  split, multivariate, kern.cat_prior, kern.comp_sampler,
-                 kern.split_impl, kern.pallas, mesh_k, n_runs,
-                 patience, float(min_improvement), prng_impl())
+                 kern.split_impl, kern.pallas, kern.pallas_ei,
+                 _pallas_tile(), mesh_k,
+                 n_runs, patience, float(min_improvement), prng_impl())
     run = cache.get(cache_key)
     if run is not None:
         cache.move_to_end(cache_key)

@@ -54,9 +54,45 @@ def _ei_kernel(z_ref, cbb_ref, mub_ref, sgb_ref, cba_ref, mua_ref, sga_ref,
         - lse(cba_ref, mua_ref, sga_ref)
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def _ei_kernel_mxu(z_ref, wb_ref, wa_ref, out_ref):
+    """MXU variant: the exponent block as a [T, 3] @ [3, K] matmul.
+
+    ``-(z-mu)^2 / (2 sg^2) + cb  ==  a2 z^2 + a1 z + a0`` with per-component
+    coefficients ``a2 = -1/(2 sg^2), a1 = mu/sg^2, a0 = cb - mu^2/(2 sg^2)``
+    folded on the host into ``w [3, K]``.  The feature matrix
+    ``F = [z^2, z, 1]`` turns the per-element quadratic (4 VPU ops per
+    ``[T, K]`` cell in the kernel above) into one systolic-array pass; only
+    exp/max/sum remain on the VPU.  Padding components carry finite a0 of
+    -1e30 (not -inf: the MXU contraction computes ``1 * a0``, and a
+    finite floor keeps the pass NaN-safe while still never winning the
+    max or contributing to the sum).
+    """
+    z = z_ref[0, 0, :]                                 # [T]
+    ones = jnp.ones_like(z)
+    f = jnp.stack([z * z, z, ones], axis=-1)           # [T, 3]
+
+    def lse(w_ref):
+        w = w_ref[0, :, :]                             # [3, K]
+        # HIGHEST precision (3-pass bf16 ~ f32) is load-bearing: the
+        # expanded terms are O(mu^2/sg^2) large and cancel to the small
+        # true exponent — single-pass bf16 loses ~6 absolute in log space
+        # for narrow components (measured maxerr 37), HIGHEST brings it
+        # to ~1e-3.  The extra MXU passes are cheap: the array is
+        # otherwise idle in this kernel.
+        term = jax.lax.dot_general(
+            f, w, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32)        # [T, K] on the MXU
+        m = jnp.max(term, axis=-1, keepdims=True)
+        s = jnp.sum(jnp.exp(term - m), axis=-1)
+        return m[:, 0] + jnp.log(s)
+
+    out_ref[0, 0, :] = lse(wb_ref) - lse(wa_ref)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret", "mxu"))
 def ei_scores(z, logw_b, mu_b, sg_b, logw_a, mu_a, sg_a,
-              tile=512, interpret=False):
+              tile=512, interpret=False, mxu=False):
     """Fused EI scores for a group of columns.
 
     Args:
@@ -64,6 +100,8 @@ def ei_scores(z, logw_b, mu_b, sg_b, logw_a, mu_a, sg_a,
       logw_*/mu_*/sg_*: f32[C, K*] below/above mixtures (−inf logw padding).
       tile: candidate-tile length (multiple of 128).
       interpret: run the Pallas interpreter (CPU/debug).
+      mxu: lower the exponent block as a quadratic-expansion matmul on the
+        systolic array (``_ei_kernel_mxu``) instead of VPU elementwise ops.
 
     Returns f32[C, n]:
       ``logsumexp_k N(z|below) − logsumexp_k N(z|above)`` (un-normalized by
@@ -94,6 +132,30 @@ def ei_scores(z, logw_b, mu_b, sg_b, logw_a, mu_a, sg_a,
     to3 = lambda x: x[:, None, :]  # noqa: E731
     grid = (c, np_ // tile)
     col = lambda i, j: (i, 0, 0)  # noqa: E731 — one column's mixtures/step
+    if mxu:
+        def coeffs(cb, mu, sg):
+            inv2 = 1.0 / (sg * sg)                     # [C, K]
+            a2 = -0.5 * inv2
+            a1 = mu * inv2
+            a0 = cb - 0.5 * mu * mu * inv2
+            # Finite floor for padding (cb = -inf): the MXU pass must stay
+            # NaN-safe, and -1e30 still never wins max nor adds to the sum.
+            a0 = jnp.maximum(a0, -1e30)
+            return jnp.stack([a2, a1, a0], axis=1)     # [C, 3, K]
+
+        out = pl.pallas_call(
+            _ei_kernel_mxu,
+            out_shape=jax.ShapeDtypeStruct((c, 1, np_), jnp.float32),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, tile), lambda i, j: (i, 0, j)),
+                pl.BlockSpec((1, 3, kb), col),
+                pl.BlockSpec((1, 3, ka), col),
+            ],
+            out_specs=pl.BlockSpec((1, 1, tile), lambda i, j: (i, 0, j)),
+            interpret=interpret,
+        )(to3(z_p), coeffs(cb_b, mu_b, sg_b), coeffs(cb_a, mu_a, sg_a))
+        return out[:, 0, :n]
     out = pl.pallas_call(
         _ei_kernel,
         out_shape=jax.ShapeDtypeStruct((c, 1, np_), jnp.float32),

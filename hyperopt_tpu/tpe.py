@@ -126,6 +126,25 @@ def _pallas_tile():
         return None
 
 
+def _pallas_ei_impl() -> str:
+    """EI-kernel exponent lowering (``HYPEROPT_TPU_PALLAS_EI``).
+
+    ``vpu`` (default) — elementwise ``(z-mu)/sg`` ops; ``mxu`` — the
+    quadratic-expansion matmul (``pallas_gmm._ei_kernel_mxu``): the
+    ``[T, K]`` exponent block becomes ``[T, 3] @ [3, K]`` on the
+    systolic array, numerically equivalent ONLY at
+    ``Precision.HIGHEST`` (which the kernel hardcodes; measured
+    identical deviation vs the XLA scorer, ``benchmarks/ei_mxu_ab.py``).
+    The full-step on-chip A/B is DONE and decided vpu: mxu ties at
+    10k×50 but loses 2.7× at 100k×100 where per-program MXU pass
+    latency dominates the ~3.4k-program grid
+    (``step_ei_ab_tpu_20260801_1226.json``; DESIGN.md §6).  The toggle
+    stays for future chips where the trade may flip.
+    """
+    env = os.environ.get("HYPEROPT_TPU_PALLAS_EI", "vpu")
+    return env if env in ("vpu", "mxu") else "vpu"
+
+
 def _split_impl() -> str:
     """γ-split lowering (``HYPEROPT_TPU_SPLIT_IMPL``).
 
@@ -299,6 +318,7 @@ class _TpeKernel:
         # factorized per-parameter argmax (broadcast_best).
         self.multivariate = multivariate
         self.pallas = _pallas_mode()
+        self.pallas_ei = _pallas_ei_impl()
         self.split_impl = _split_impl()
         # Snapshot at construction: the cache key records this value, and a
         # lazily-traced program must bake in the SAME lowering even if the
@@ -562,7 +582,8 @@ class _TpeKernel:
                 tile = _pallas_tile() or (1024 if self.n_cap <= 2048 else 256)
                 ei = ei_scores(zc, lwb, mub, sgb, lwa, mua, sga,
                                tile=tile,
-                               interpret=self.pallas == "interpret")
+                               interpret=self.pallas == "interpret",
+                               mxu=self.pallas_ei == "mxu")
             else:
                 def ei_n(z_):
                     sb = jax.vmap(gmm_logpdf, in_axes=(0,) * 6)
@@ -851,7 +872,7 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
     # a mid-process toggle must produce a fresh kernel, never a stale one.
     k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
          _pallas_mode(), _comp_sampler(), _pallas_tile(), _split_impl(),
-         prng_impl())
+         prng_impl(), _pallas_ei_impl())
     if k not in cache:
         cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split, multivariate,
                               cat_prior)

@@ -49,6 +49,21 @@ class TestPallasEiKernel:
         # constant shift leaves the winner unchanged
         np.testing.assert_array_equal(np.argmax(got, 1), np.argmax(want, 1))
 
+    @pytest.mark.parametrize("c,n,kb,ka", [(3, 300, 8, 40), (2, 500, 26, 130)])
+    def test_mxu_variant_matches_vpu(self, rng, c, n, kb, ka):
+        """The quadratic-expansion MXU lowering (HYPEROPT_TPU_PALLAS_EI=mxu,
+        r5 opt-in) is numerically equivalent to the VPU kernel: same scores
+        to float tolerance, same per-column winners."""
+        below = _random_mixture(rng, c, kb, kb - 1)
+        above = _random_mixture(rng, c, ka, ka - 3)
+        z = jnp.asarray(rng.normal(0, 3, (c, n)).astype(np.float32))
+        vpu = np.asarray(ei_scores(z, *below, *above, tile=128,
+                                   interpret=True))
+        mxu = np.asarray(ei_scores(z, *below, *above, tile=128,
+                                   interpret=True, mxu=True))
+        np.testing.assert_allclose(mxu, vpu, rtol=2e-3, atol=2e-3)
+        np.testing.assert_array_equal(np.argmax(mxu, 1), np.argmax(vpu, 1))
+
     @pytest.mark.parametrize("c,n,kb,ka,tile", [
         (8, 2048, 32, 128, 512),     # bench pallas_allclose shape
         (10, 4096, 32, 1032, 256),   # flagship-bench-like: big above model

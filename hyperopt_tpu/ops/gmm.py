@@ -78,10 +78,25 @@ def onehot_lookup(idx, table, fill=0.0, batch=1):
     if k <= _ONEHOT_MAX and idx.size * k * batch <= _ONEHOT_BUDGET:
         oh = (idx[..., None] == jnp.arange(k)).astype(table.dtype)
         tab = jnp.where(jnp.isfinite(table), table, fill)
-        return jnp.einsum("...nk,...k->...n", oh, tab)
+        # HIGHEST precision is the EXACTNESS guarantee, not a tuning
+        # knob: at the TPU default the f32 operands round to bf16 inside
+        # the matmul, so the looked-up values themselves would come back
+        # bf16-rounded — the whole point of this helper is that a 0/1
+        # one-hot times an f32 table reproduces the gathered value
+        # bit-for-bit.  (The CPU-run parity test
+        # tests/test_tpe.py::test_onehot_and_gather_lowerings_propose_identically
+        # pins the selection semantics; CPU einsum is exact either way,
+        # so THIS line is what carries the guarantee on TPU.)
+        return jnp.einsum("...nk,...k->...n", oh, tab,
+                          precision=jax.lax.Precision.HIGHEST)
+    # Fallback gathers apply the SAME sanitization: without it a
+    # selected non-finite entry would decode to raw inf here but to
+    # ``fill`` under the one-hot path, and the two lowerings would
+    # diverge across problem sizes.
+    tab = jnp.where(jnp.isfinite(table), table, fill)
     if table.ndim == 1:
-        return table[idx]
-    return jnp.take_along_axis(table, idx, axis=-1)
+        return tab[idx]
+    return jnp.take_along_axis(tab, idx, axis=-1)
 
 
 def log_ndtr_diff(a, b):

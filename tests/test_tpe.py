@@ -293,6 +293,45 @@ class TestGmmSample:
         assert 0.4 < frac_hi < 0.6
 
 
+@pytest.mark.slow
+def test_onehot_and_gather_lowerings_propose_identically(monkeypatch):
+    """ops/gmm.py::onehot_lookup picks one-hot-matmul vs gather by operand
+    size; both must select the SAME table entries — a whole suggest step
+    under the forced-gather lowering reproduces the default's proposal
+    bit-for-bit (exact selection, not approximate; the helper pins
+    Precision.HIGHEST for exactly this reason)."""
+    from hyperopt_tpu.ops import gmm
+    from hyperopt_tpu.tpe import _TpeKernel, _padded_history
+
+    space = {"x": hp.uniform("x", -5, 5),
+             "q": hp.quniform("q", 0, 30, 1),
+             "c": hp.choice("c", list(range(12)))}
+    cs = compile_space(space)
+    rng = np.random.default_rng(0)
+    n = 48
+    vals = np.zeros((n, 3), np.float32)
+    vals[:, cs.by_label["x"].pid] = rng.uniform(-5, 5, n)
+    vals[:, cs.by_label["q"].pid] = rng.integers(0, 31, n)
+    vals[:, cs.by_label["c"].pid] = rng.integers(0, 12, n)
+    h = {"vals": vals, "active": np.ones((n, 3), bool),
+         "loss": (vals[:, 0] ** 2).astype(np.float32),
+         "ok": np.ones(n, bool)}
+    hv, ha, hl, hok = _padded_history(h, 64)
+    key = jax.random.key(3)
+
+    def propose():
+        kern = _TpeKernel(cs, 64, 32, 25, "sqrt", False, "sqrt")
+        row, act = kern._suggest_one(key, jnp.asarray(hv), jnp.asarray(ha),
+                                     jnp.asarray(hl), jnp.asarray(hok),
+                                     jnp.float32(0.25), jnp.float32(1.0))
+        return np.asarray(row)
+
+    default = propose()
+    monkeypatch.setattr(gmm, "_ONEHOT_MAX", 0)      # force gather path
+    gathered = propose()
+    np.testing.assert_array_equal(default, gathered)
+
+
 def test_qnormal_posterior_clips_at_f32_lattice_edge():
     """The sample_traced integer-exactness invariant (q-lattice normal
     tails saturate at +/-2**24*q) must hold for TPE posterior draws too:

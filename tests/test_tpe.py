@@ -332,6 +332,36 @@ def test_onehot_and_gather_lowerings_propose_identically(monkeypatch):
     np.testing.assert_array_equal(default, gathered)
 
 
+def test_wide_dense_categorical_takes_gather_path():
+    """A dense randint with > _ONEHOT_MAX options routes the categorical
+    score lookup through the take_along_axis fallback; the step must
+    still propose valid in-range integers."""
+    from hyperopt_tpu.ops.gmm import _ONEHOT_MAX
+    from hyperopt_tpu.tpe import _TpeKernel, _padded_history
+
+    n_opt = _ONEHOT_MAX + 44                      # dense (< _DENSE_CAT_MAX)
+    space = {"r": hp.randint("r", n_opt), "x": hp.uniform("x", -1, 1)}
+    cs = compile_space(space)
+    assert cs.by_label["r"].n_options == n_opt    # dense-logits path
+    rng = np.random.default_rng(0)
+    n = 48
+    vals = np.zeros((n, 2), np.float32)
+    vals[:, cs.by_label["r"].pid] = rng.integers(0, n_opt, n)
+    vals[:, cs.by_label["x"].pid] = rng.uniform(-1, 1, n)
+    h = {"vals": vals, "active": np.ones((n, 2), bool),
+         "loss": (vals[:, cs.by_label["x"].pid] ** 2).astype(np.float32),
+         "ok": np.ones(n, bool)}
+    hv, ha, hl, hok = _padded_history(h, 64)
+    kern = _TpeKernel(cs, 64, 16, 25, "sqrt", False, "sqrt")
+    row, act = kern._suggest_one(jax.random.key(0), jnp.asarray(hv),
+                                 jnp.asarray(ha), jnp.asarray(hl),
+                                 jnp.asarray(hok), jnp.float32(0.25),
+                                 jnp.float32(1.0))
+    r = float(np.asarray(row)[cs.by_label["r"].pid])
+    assert r == int(r) and 0 <= r < n_opt
+    assert np.asarray(act).all()
+
+
 def test_qnormal_posterior_clips_at_f32_lattice_edge():
     """The sample_traced integer-exactness invariant (q-lattice normal
     tails saturate at +/-2**24*q) must hold for TPE posterior draws too:

@@ -1,0 +1,110 @@
+"""ATPE arm-shape profile: compile counts, cache hits, and wall time vs TPE.
+
+Answers two questions the ATPE canonicalization work is judged on:
+
+1. How many distinct XLA programs (kernel-cache MISSES) does an ATPE run
+   compile, per arm-shape key, with arm tiering ON vs OFF
+   (``HYPEROPT_TPU_ATPE_TIERS``)?  Counters come from
+   ``hyperopt_tpu.utils.tracing.kernel_cache_stats`` — a miss is a fresh
+   ``_TpeKernel`` (one trace + compile).
+2. What is the resulting wall-time ratio ``atpe_s / tpe_s`` on an
+   identical run?  Target: <= 1.5x; if the residual gap is irreducible
+   (each remaining shape is a distinct program REQUIRED by arm
+   semantics: linear_forgetting and n_EI_candidates size arrays, split/
+   multivariate change program structure), DESIGN.md §6 records why.
+
+Each configuration runs in its own subprocess so compile caches and the
+bandit transfer store never bleed between configurations (transfer is
+disabled outright).  Artifact: ``benchmarks/atpe_profile_<backend>_<stamp>.json``.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+N_TRIALS = 60
+
+
+def _child(algo_name):
+    import numpy as np
+
+    from hyperopt_tpu import Trials, atpe, fmin, hp, tpe
+    from hyperopt_tpu.utils.tracing import kernel_cache_stats
+
+    space = {
+        "x": hp.uniform("x", -5, 5),
+        "y": hp.normal("y", 0, 2),
+        "lr": hp.loguniform("lr", -6, 0),
+        "units": hp.quniform("units", 16, 256, 16),
+        "act": hp.choice("act", ["relu", "tanh", "gelu"]),
+    }
+
+    def objective(p):
+        return ((p["x"] - 1.0) ** 2 + p["y"] ** 2
+                + (np.log(p["lr"]) + 3.0) ** 2
+                + abs(p["units"] - 96.0) / 64.0
+                + {"relu": 0.0, "tanh": 0.3, "gelu": 0.1}[p["act"]])
+
+    algo = atpe.suggest if algo_name == "atpe" else tpe.suggest
+    trials = Trials()
+    t0 = time.perf_counter()
+    fmin(objective, space, algo=algo, max_evals=N_TRIALS, trials=trials,
+         rstate=np.random.default_rng(0), verbose=False)
+    wall_s = time.perf_counter() - t0
+    best = min(t["result"]["loss"] for t in trials
+               if t["result"].get("loss") is not None)
+    print(json.dumps({"wall_s": round(wall_s, 3), "best": best,
+                      "cache": kernel_cache_stats()}))
+
+
+def _run(algo_name, tiers):
+    env = dict(os.environ,
+               HYPEROPT_TPU_ATPE_TRANSFER="0",
+               HYPEROPT_TPU_ATPE_TIERS="1" if tiers else "0")
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", algo_name],
+        env=env, capture_output=True, text=True, timeout=1200)
+    if out.returncode != 0:
+        return {"error": out.stderr[-2000:]}
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    res = {"metric": "atpe_arm_profile", "backend": backend,
+           "n_trials": N_TRIALS, "configs": {}}
+    for name, (algo, tiers) in {
+        "tpe": ("tpe", True),
+        "atpe_tiered": ("atpe", True),
+        "atpe_untiered": ("atpe", False),
+    }.items():
+        rec = _run(algo, tiers)
+        if "cache" in rec:
+            rec["compiled_shapes"] = rec["cache"]["misses"]
+        res["configs"][name] = rec
+        print(json.dumps({name: {k: v for k, v in rec.items()
+                                 if k != "cache"}}), flush=True)
+    tpe_s = res["configs"].get("tpe", {}).get("wall_s")
+    atpe_s = res["configs"].get("atpe_tiered", {}).get("wall_s")
+    if tpe_s and atpe_s:
+        res["atpe_over_tpe"] = round(atpe_s / tpe_s, 3)
+        print(f"# atpe/tpe wall ratio: {res['atpe_over_tpe']}")
+    stamp = time.strftime("%Y%m%d_%H%M", time.gmtime())
+    out_path = os.path.join(_ROOT, "benchmarks",
+                            f"atpe_profile_{backend}_{stamp}.json")
+    with open(out_path, "w") as f:
+        json.dump(res, f, indent=1)
+    print(f"# wrote {out_path}")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+    else:
+        main()

@@ -1,4 +1,15 @@
-"""Full-step A/B: HYPEROPT_TPU_PALLAS_EI=vpu vs mxu at both bench shapes."""
+"""Full-step A/Bs on the EI block, one JSON artifact per run:
+
+* ``shapes`` — HYPEROPT_TPU_PALLAS_EI=vpu vs mxu (the original A/B).
+* ``toggles`` — HYPEROPT_TPU_EI_PRECISION=bf16 and HYPEROPT_TPU_EI_TOPM
+  vs the f32/full baseline, each with the ARGMAX-PARITY CANARY: the
+  toggles may only change defaults if their proposals are bit-identical
+  to the baseline's (``proposals_identical``), so the artifact records
+  both the speed and the parity verdict.
+
+On a CPU backend the 100k×100 shape is skipped (hours, not ms) and the
+artifact says so — TPU numbers must come from a TPU run.
+"""
 import json
 import os
 import sys
@@ -11,42 +22,96 @@ import numpy as np
 import jax
 
 
-def main():
+def _bench_shapes(backend):
+    if backend == "tpu":
+        return {"10k_50": (50, 10_000, 32), "100k_100": (100, 100_000, 8)}
+    # CPU: small stand-ins so the parity canary still runs everywhere.
+    return {"1k_10": (10, 1_000, 8), "4k_20": (20, 4_000, 3)}
+
+
+def _step_fixture(name, n_dims, n_cand):
     from __graft_entry__ import _flagship_space, _history
     from hyperopt_tpu.space import compile_space
-    from hyperopt_tpu.tpe import _bucket, _padded_history, get_kernel
+    from hyperopt_tpu.tpe import _bucket, _padded_history
+
+    cs = compile_space(_flagship_space(n_dims))
+    n_cap = _bucket(1000)
+    hv, ha, hl, hok = _padded_history(_history(cs, 1000), n_cap)
+    return cs, n_cap, (jax.device_put(hv), jax.device_put(ha),
+                       jax.device_put(hl), jax.device_put(hok))
+
+
+def _timed_steps(kern, hist, k_steady):
+    key = jax.random.key(0)
+    fn = jax.jit(kern._suggest_one)
+    out = fn(key, *hist, np.float32(0.25), np.float32(1.0))
+    row = np.asarray(out[0])
+    t0 = time.perf_counter()
+    for i in range(k_steady):
+        out = fn(jax.random.fold_in(key, i), *hist,
+                 np.float32(0.25), np.float32(1.0))
+    np.asarray(out[0])
+    ms = (time.perf_counter() - t0) * 1e3 / k_steady
+    return row, round(ms, 3)
+
+
+def toggle_ab(res, backend):
+    """EI precision / top-M A/B with the argmax-parity canary."""
+    from hyperopt_tpu.tpe import get_kernel
+
+    configs = {
+        "baseline": {},
+        "bf16": {"HYPEROPT_TPU_EI_PRECISION": "bf16"},
+        "topm16": {"HYPEROPT_TPU_EI_TOPM": "16"},
+    }
+    out = {"note": ("defaults may flip only on a bit-identical canary "
+                    "(proposals_identical) plus a speed win")}
+    if backend != "tpu":
+        out["tpu_unavailable"] = (
+            "CPU backend: 100k_100 (acceptance config 5) not measurable "
+            "here; shapes below are CPU stand-ins")
+    for name, (n_dims, n_cand, k_steady) in _bench_shapes(backend).items():
+        cs, n_cap, hist = _step_fixture(name, n_dims, n_cand)
+        rec, rows = {}, {}
+        for cfg, env in configs.items():
+            for k, v in env.items():
+                os.environ[k] = v
+            try:
+                kern = get_kernel(cs, n_cap, n_cand, 25)
+                rows[cfg], rec[f"{cfg}_ms"] = _timed_steps(
+                    kern, hist, k_steady)
+            except Exception as e:
+                rec[f"{cfg}_error"] = f"{type(e).__name__}: {e}"
+            for k in env:
+                os.environ.pop(k, None)
+        for cfg in ("bf16", "topm16"):
+            if cfg in rows and "baseline" in rows:
+                rec[f"{cfg}_proposals_identical"] = bool(
+                    (rows[cfg] == rows["baseline"]).all())
+                rec[f"{cfg}_proposal_max_absdiff"] = float(
+                    np.max(np.abs(rows[cfg] - rows["baseline"])))
+        out[name] = rec
+        print(json.dumps({name: rec}), flush=True)
+    res["toggles"] = out
+
+
+def main():
+    from hyperopt_tpu.tpe import get_kernel
 
     backend = jax.default_backend()
     os.environ["HYPEROPT_TPU_PALLAS"] = "1" if backend == "tpu" else "0"
     res = {"metric": "step_ei_vpu_vs_mxu", "backend": backend, "shapes": {}}
 
-    for name, (n_dims, n_cand, k_steady) in {
-        "10k_50": (50, 10_000, 32),
-        "100k_100": (100, 100_000, 8),
-    }.items():
-        cs = compile_space(_flagship_space(n_dims))
-        n_cap = _bucket(1000)
-        hv, ha, hl, hok = _padded_history(_history(cs, 1000), n_cap)
-        hv, ha = jax.device_put(hv), jax.device_put(ha)
-        hl, hok = jax.device_put(hl), jax.device_put(hok)
-        key = jax.random.key(0)
+    for name, (n_dims, n_cand, k_steady) in _bench_shapes(backend).items():
+        cs, n_cap, hist = _step_fixture(name, n_dims, n_cand)
         rec = {}
         rows = {}
         for impl in ("vpu", "mxu"):
             os.environ["HYPEROPT_TPU_PALLAS_EI"] = impl
             try:
                 kern = get_kernel(cs, n_cap, n_cand, 25)
-                fn = jax.jit(kern._suggest_one)
-                out = fn(key, hv, ha, hl, hok, np.float32(0.25),
-                         np.float32(1.0))
-                rows[impl] = np.asarray(out[0])
-                t0 = time.perf_counter()
-                for i in range(k_steady):
-                    out = fn(jax.random.fold_in(key, i), hv, ha, hl, hok,
-                             np.float32(0.25), np.float32(1.0))
-                np.asarray(out[0])
-                rec[f"{impl}_ms"] = round(
-                    (time.perf_counter() - t0) * 1e3 / k_steady, 3)
+                rows[impl], rec[f"{impl}_ms"] = _timed_steps(
+                    kern, hist, k_steady)
             except Exception as e:
                 rec[f"{impl}_error"] = f"{type(e).__name__}: {e}"
         os.environ.pop("HYPEROPT_TPU_PALLAS_EI", None)
@@ -57,6 +122,8 @@ def main():
                 np.max(np.abs(rows["vpu"] - rows["mxu"])))
         res["shapes"][name] = rec
         print(json.dumps({name: rec}), flush=True)
+
+    toggle_ab(res, backend)
 
     stamp = time.strftime("%Y%m%d_%H%M", time.gmtime())
     out_path = os.path.join(_ROOT, "benchmarks",

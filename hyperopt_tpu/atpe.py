@@ -63,6 +63,36 @@ from .space import CATEGORICAL, RANDINT, UNIFORMINT
 logger = logging.getLogger(__name__)
 
 
+def _tiers_on() -> bool:
+    """Arm-shape canonicalization toggle (``HYPEROPT_TPU_ATPE_TIERS``).
+
+    On (default): every arm's ``n_EI_candidates`` snaps UP to a
+    power-of-two tier before reaching ``tpe.get_kernel``.  The candidate
+    count is a compile-shape axis (it sizes the EI broadcast), and the
+    un-tiered portfolio derived it continuously from dimensionality
+    (``24·√P``), so every distinct space compiled its own arm-shape
+    family.  Tiered, all spaces with √P in a ×2 band share one family,
+    and an arm pair like (base, max(base, 128)) collapses onto ONE shape
+    whenever the base tier reaches 128 — fewer distinct XLA programs per
+    process, and a stable shape vocabulary for :func:`_prewarm_arms`.
+    ``0`` restores the continuous shapes (A/B:
+    ``benchmarks/atpe_profile.py``).  Never changes which γ/split/
+    forgetting semantics an arm carries — only how many EI candidates it
+    scores, which the bandit treats as part of the arm's identity either
+    way.
+    """
+    return os.environ.get("HYPEROPT_TPU_ATPE_TIERS", "1") != "0"
+
+
+def _tier(n: int) -> int:
+    """Snap a candidate count UP to the next power of two (min 32).
+
+    Rounding up never shrinks an arm's exploration breadth; the extra
+    candidates cost a partial tile the EI kernel was padding to anyway.
+    """
+    return max(32, 1 << (max(int(n), 1) - 1).bit_length())
+
+
 def _portfolio(cs):
     """TPE-configuration arms, scaled by problem features.
 
@@ -75,6 +105,8 @@ def _portfolio(cs):
     # Wider spaces benefit from more EI candidates; heavily categorical
     # spaces from stronger priors (smoothing).
     base_cand = int(np.clip(24 * np.sqrt(n_params), 24, 512))
+    if _tiers_on():
+        base_cand = _tier(base_cand)
     pw = 1.0 + cat_frac
     arms = [
         dict(gamma=0.25, split="sqrt", n_EI_candidates=base_cand,
@@ -414,6 +446,16 @@ class _BanditState:
     start from the store's record for this space and every settled outcome
     is flushed back as a delta."""
 
+    # Outcomes accumulated in memory before a store flush: each flush is
+    # a whole-file JSON read-modify-write (+ atomic replace), and doing
+    # one per resolved trial put ~N file rewrites on the suggest path of
+    # an N-trial run (measured as part of the atpe_s wall-time gap,
+    # benchmarks/atpe_profile.py).  Batching trades at most
+    # FLUSH_EVERY-1 un-flushed outcomes on a hard kill — the in-process
+    # posterior is unaffected, and ``atexit`` drains the remainder on
+    # any normal exit (EVIDENCE_CAP=30 makes the loss negligible anyway).
+    FLUSH_EVERY = 8
+
     def __init__(self, n_arms, store=None, fp=None, features=None):
         self.store = store
         self.fp = fp
@@ -426,9 +468,30 @@ class _BanditState:
             self.wins = np.ones(n_arms)    # Beta(1,1) priors
             self.losses = np.ones(n_arms)
         self.pending = {}              # tid -> (arm, best_loss_at_suggest)
+        self._d_wins = np.zeros(n_arms)     # un-flushed store deltas
+        self._d_losses = np.zeros(n_arms)
+        if store is not None and fp is not None:
+            import atexit
+            import weakref
+
+            # weakref: an atexit-held strong ref would pin every Trials
+            # (via _atpe_state) for the process lifetime.
+            ref = weakref.ref(self)
+            atexit.register(lambda: (lambda s: s and s.flush_deltas())(ref()))
 
     def pick(self, rng):
         return int(np.argmax(rng.beta(self.wins, self.losses)))
+
+    def flush_deltas(self):
+        """Drain accumulated outcome deltas to the transfer store."""
+        if self.store is None or self.fp is None:
+            return
+        d_w, d_l = self._d_wins, self._d_losses
+        if not (d_w.any() or d_l.any()):
+            return
+        self._d_wins = np.zeros(len(self.wins))
+        self._d_losses = np.zeros(len(self.losses))
+        self.store.flush(self.fp, d_w, d_l)
 
     def settle(self, trials):
         """Score resolved suggestions: did the trial beat the best loss
@@ -451,8 +514,40 @@ class _BanditState:
                 d_losses[arm] += 1.0
         self.wins += d_wins
         self.losses += d_losses
-        if self.store is not None and self.fp is not None:
-            self.store.flush(self.fp, d_wins, d_losses)
+        self._d_wins += d_wins
+        self._d_losses += d_losses
+        if self._d_wins.sum() + self._d_losses.sum() >= self.FLUSH_EVERY:
+            self.flush_deltas()
+
+
+def _prewarm_arms(cs, arms, st, n_trials, linear_forgetting):
+    """Background-compile every arm's suggest program for the current
+    history bucket — the arm analog of ``tpe._prewarm_async``'s bucket
+    prewarm.
+
+    Thompson sampling hops between arms, and each arm whose shape tuple
+    (n_EI_candidates tier, linear_forgetting, split, multivariate) differs
+    compiles its own XLA program; un-prewarmed, every first hop onto an
+    arm stalls a suggest behind that compile.  This kicks all arms'
+    single-proposal programs (ATPE suggests are per-trial) into
+    ``_prewarm_async``'s daemon threads once per bucket, so hops land on
+    warm programs.  Inherits that helper's guards: no-op on 1-core CPU
+    hosts (the compile would fight the objective for the core), and
+    per-kernel done-marks make re-walks cheap.  Best-effort throughout.
+    """
+    bucket = tpe._bucket(n_trials)
+    if getattr(st, "_prewarmed_bucket", 0) == bucket:
+        return
+    st._prewarmed_bucket = bucket
+    for cfg in arms:
+        try:
+            kern = tpe.get_kernel(
+                cs, bucket, int(cfg["n_EI_candidates"]),
+                int(cfg.get("linear_forgetting", linear_forgetting)),
+                cfg.get("split", "sqrt"), cfg.get("multivariate", False))
+            tpe._prewarm_async(kern, n=1)
+        except Exception:   # pragma: no cover - purely opportunistic
+            logger.debug("atpe arm prewarm failed", exc_info=True)
 
 
 def _state(trials, cs, n_arms) -> _BanditState:
@@ -485,6 +580,8 @@ def suggest(new_ids, domain, trials, seed,
         best = None
     rows, acts = tpe.suggest_batch(new_ids, domain, trials, seed,
                                    n_startup_jobs=n_startup_jobs, **cfg)
+    if best is not None and len(trials) >= n_startup_jobs:
+        _prewarm_arms(cs, arms, st, len(trials), linear_forgetting)
     if lockout is not None and best is not None:
         h = trials.history(cs)
         if int(h["ok"].sum()) >= n_startup_jobs:

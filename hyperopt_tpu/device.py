@@ -250,6 +250,7 @@ def fmin_device(fn, space, max_evals, seed=0,
                  float(gamma), float(prior_weight), int(linear_forgetting),
                  split, multivariate, kern.cat_prior, kern.comp_sampler,
                  kern.split_impl, kern.pallas, kern.pallas_ei,
+                 kern.ei_precision, kern.ei_topm,
                  _pallas_tile(), mesh_k,
                  n_runs, patience, float(min_improvement), prng_impl())
     run = cache.get(cache_key)

@@ -28,12 +28,15 @@ parameter axis.  Mixtures use zero-weight padding (``fit_parzen``).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 from jax.scipy.special import log_ndtr, ndtri
 from jax.scipy.stats import norm
 
 _TINY = 1e-12
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
 # Widest option/component axis for which index lookups lower as one-hot
 # MXU matmuls (serialized TPU gathers avoided) rather than gathers: the
 # [n, K<=256] f32 operand stays ~100 MB even at 100k candidates, while
@@ -73,8 +76,17 @@ def onehot_lookup(idx, table, fill=0.0, batch=1):
     broadcast-compatible with ``idx``'s.  ``batch``: multiplier for
     leading dims added OUTSIDE this call (``jax.vmap`` hides them from
     ``idx.size``) so the budget sees the true operand.
+
+    Out-of-range indices are clipped to ``[0, k-1]`` in BOTH lowerings.
+    Without the clip the paths diverged: the one-hot compare matched no
+    lane (all-zero row → 0.0) while the gather clamped to the edge
+    value, so the size-dependent path switch silently broke the
+    "identical across lowerings" contract for any caller that forgot to
+    clip (round-5 advisor finding).  Clamping here makes the contract
+    hold unconditionally.
     """
     k = table.shape[-1]
+    idx = jnp.clip(idx, 0, k - 1)
     if k <= _ONEHOT_MAX and idx.size * k * batch <= _ONEHOT_BUDGET:
         oh = (idx[..., None] == jnp.arange(k)).astype(table.dtype)
         tab = jnp.where(jnp.isfinite(table), table, fill)
@@ -133,7 +145,8 @@ def _log_trunc_mass(logw, mu, sigma, trunc_lo, trunc_hi):
     return log_wmass, jax.scipy.special.logsumexp(log_wmass)
 
 
-def gmm_logpdf(z, logw, mu, sigma, trunc_lo=-jnp.inf, trunc_hi=jnp.inf):
+def gmm_logpdf(z, logw, mu, sigma, trunc_lo=-jnp.inf, trunc_hi=jnp.inf,
+               exp_dtype=None):
     """Log-density of a truncated GMM at fit-space points ``z``.
 
     ``z``: f32[n]; ``logw/mu/sigma``: f32[K] (−inf logw on padding).
@@ -141,12 +154,57 @@ def gmm_logpdf(z, logw, mu, sigma, trunc_lo=-jnp.inf, trunc_hi=jnp.inf):
     Σ_k w_k mass_k`` — matching the distribution of the reference's
     rejection sampler and its ``GMM1_lpdf`` ``p_accept`` normalizer.
     Returns f32[n] (−inf outside the truncation bounds).
+
+    ``exp_dtype``: when set (``jnp.bfloat16``), the ``(z−mu)/sigma``
+    standardization and its square — the ``[n, K]`` broadcast that
+    dominates the EI block at large ``n`` — run in that dtype, while the
+    ``log(sigma)`` term, the logsumexp accumulate, and the normalizer
+    stay f32 (``HYPEROPT_TPU_EI_PRECISION=bf16``).  ``None`` keeps the
+    exact f32 ``norm.logpdf`` formulation, bit-identical to the
+    pre-toggle code.
     """
     _, log_z = _log_trunc_mass(logw, mu, sigma, trunc_lo, trunc_hi)
-    lp = norm.logpdf(z[:, None], mu[None, :], sigma[None, :])     # [n, K]
+    if exp_dtype is None:
+        lp = norm.logpdf(z[:, None], mu[None, :], sigma[None, :])  # [n, K]
+    else:
+        t = ((z.astype(exp_dtype)[:, None] - mu.astype(exp_dtype)[None, :])
+             / sigma.astype(exp_dtype)[None, :])
+        lp = (-0.5 * (t * t).astype(jnp.float32)
+              - jnp.log(sigma)[None, :] - _HALF_LOG_2PI)           # [n, K]
     out = jax.scipy.special.logsumexp(lp + logw[None, :], axis=-1) - log_z
     in_bounds = (z >= trunc_lo) & (z <= trunc_hi)
     return jnp.where(in_bounds, out, -jnp.inf)
+
+
+def truncate_mixture(logw, mu, sigma, m):
+    """Keep only the top-``m``-by-weight components of a (batched) mixture.
+
+    ``logw/mu/sigma``: f32[..., K] → f32[..., m] (no-op when ``m >= K``).
+    Static-shape prefilter for the EI above-model: a Parzen component
+    whose weight is ≲2⁻²⁴ of the dominant one contributes below f32
+    epsilon to the density logsumexp near the modes that decide the
+    argmax, so dropping the weight tail shrinks the ``[n_cand, K]``
+    broadcast without (usually) moving proposals
+    (``HYPEROPT_TPU_EI_TOPM``).  This is a heuristic, not an identity —
+    far from the kept modes a dropped component can dominate — so the
+    toggle is judged by the proposal-parity canary in
+    ``benchmarks/step_ei_ab.py`` and stays off by default.
+
+    Uses ``top_k`` + ``take_along_axis``: the gathered operand is
+    ``[..., m]`` (mixture-sized, not candidate-sized), so the serialized
+    TPU gather cost is noise next to the broadcast it removes.  Padding
+    slots (−inf logw) sort last and are kept only when fewer than ``m``
+    live components exist — same dead-slot semantics as ``fit_parzen``.
+    Component mu-order is NOT preserved (scoring sums over k; do not
+    feed the result to order-sensitive samplers).
+    """
+    k = logw.shape[-1]
+    if m >= k:
+        return logw, mu, sigma
+    lw, idx = jax.lax.top_k(logw, m)
+    return (lw,
+            jnp.take_along_axis(mu, idx, axis=-1),
+            jnp.take_along_axis(sigma, idx, axis=-1))
 
 
 def gmm_log_qmass(zl, zh, logw, mu, sigma, trunc_lo=-jnp.inf,
@@ -248,10 +306,12 @@ def gmm_sample(key, logw, mu, sigma, trunc_lo, trunc_hi, n,
         comp = icdf_pick(uc, cdf, last_live)
     else:
         comp = jax.random.categorical(kc, log_wmass, shape=(n,))
-    # MXU lookups (see onehot_lookup): fit_parzen pads mu with +inf
-    # (sort-to-tail) and such components are never selected, so the
-    # fills are arbitrary finite stand-ins (1.0 for sigma keeps the
-    # divisions below NaN-free even transiently).
+    # MXU lookups (see onehot_lookup): fit_parzen pads its OUTPUT slots
+    # with mu=0, sigma=1, weight=0 (ops/parzen.py — the +inf padding
+    # exists only on its input x), so padded components carry -inf
+    # log_wmass and are never selected; the fills are arbitrary finite
+    # stand-ins (1.0 for sigma keeps the divisions below NaN-free even
+    # transiently).
     m = onehot_lookup(comp, mu, 0.0, batch=onehot_batch)
     s = onehot_lookup(comp, sigma, 1.0, batch=onehot_batch)
     pa = jax.scipy.special.ndtr((trunc_lo - m) / s)

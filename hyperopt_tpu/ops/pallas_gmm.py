@@ -36,15 +36,26 @@ _HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
 
 
 def _ei_kernel(z_ref, cbb_ref, mub_ref, sgb_ref, cba_ref, mua_ref, sga_ref,
-               out_ref):
+               out_ref, *, bf16=False):
     z = z_ref[0, 0, :]                                 # [T]
 
     def lse(cb_ref, mu_ref, sg_ref):
         cb = cb_ref[0, 0, :]                           # [K]
         mu = mu_ref[0, 0, :]
         sg = sg_ref[0, 0, :]
-        t = (z[:, None] - mu[None, :]) / sg[None, :]   # [T, K]
-        term = cb[None, :] - 0.5 * t * t
+        if bf16:
+            # Mixed precision (HYPEROPT_TPU_EI_PRECISION=bf16): the [T, K]
+            # standardize-and-square broadcast runs at bf16 lane width
+            # (2x VPU throughput per pass), the max/exp/sum accumulate
+            # stays f32.  Refs remain f32 — casts are VREG-local, so the
+            # (8, 128) f32 block tiling above is untouched.
+            zb = z.astype(jnp.bfloat16)
+            t = ((zb[:, None] - mu.astype(jnp.bfloat16)[None, :])
+                 / sg.astype(jnp.bfloat16)[None, :])   # [T, K] bf16
+            term = cb[None, :] + (-0.5 * t * t).astype(jnp.float32)
+        else:
+            t = (z[:, None] - mu[None, :]) / sg[None, :]   # [T, K]
+            term = cb[None, :] - 0.5 * t * t
         m = jnp.max(term, axis=-1, keepdims=True)      # [T, 1]
         # padding components carry cb = -inf -> exp(-inf - m) = 0
         s = jnp.sum(jnp.exp(term - m), axis=-1)        # [T]
@@ -90,9 +101,10 @@ def _ei_kernel_mxu(z_ref, wb_ref, wa_ref, out_ref):
     out_ref[0, 0, :] = lse(wb_ref) - lse(wa_ref)
 
 
-@functools.partial(jax.jit, static_argnames=("tile", "interpret", "mxu"))
+@functools.partial(jax.jit,
+                   static_argnames=("tile", "interpret", "mxu", "bf16"))
 def ei_scores(z, logw_b, mu_b, sg_b, logw_a, mu_a, sg_a,
-              tile=512, interpret=False, mxu=False):
+              tile=512, interpret=False, mxu=False, bf16=False):
     """Fused EI scores for a group of columns.
 
     Args:
@@ -102,6 +114,9 @@ def ei_scores(z, logw_b, mu_b, sg_b, logw_a, mu_a, sg_a,
       interpret: run the Pallas interpreter (CPU/debug).
       mxu: lower the exponent block as a quadratic-expansion matmul on the
         systolic array (``_ei_kernel_mxu``) instead of VPU elementwise ops.
+      bf16: run the VPU kernel's [T, K] exponent broadcast in bfloat16
+        with f32 accumulate (``_ei_kernel``; no effect under ``mxu`` —
+        that path has its own precision story, see its HIGHEST note).
 
     Returns f32[C, n]:
       ``logsumexp_k N(z|below) − logsumexp_k N(z|above)`` (un-normalized by
@@ -157,7 +172,7 @@ def ei_scores(z, logw_b, mu_b, sg_b, logw_a, mu_a, sg_a,
         )(to3(z_p), coeffs(cb_b, mu_b, sg_b), coeffs(cb_a, mu_a, sg_a))
         return out[:, 0, :n]
     out = pl.pallas_call(
-        _ei_kernel,
+        functools.partial(_ei_kernel, bf16=bf16),
         out_shape=jax.ShapeDtypeStruct((c, 1, np_), jnp.float32),
         grid=grid,
         in_specs=[

@@ -29,6 +29,17 @@ cloudpickle, like the reference ships objectives through GridFS — which
 means the SAME trust model as the reference: only run a StoreServer for
 workers you trust (unpickling is code execution).
 
+Authentication: pass ``token=`` (or ``--token`` / the
+``HYPEROPT_TPU_NETSTORE_TOKEN`` environment variable) to both server and
+clients and every verb requires the shared secret in the
+``X-Netstore-Token`` header, compared constant-time
+(``hmac.compare_digest``) BEFORE dispatch — an unauthenticated peer can
+neither read documents nor claim/write trials (it gets a 401 and no verb
+executes).  Without a token the server remains open, preserving the
+localhost-trusted default; set one whenever the socket is reachable
+beyond the machines you trust.  The token authenticates the transport —
+it does not change the unpickling trust model above.
+
 Reference anchors: ``MongoJobs.reserve`` (find_and_modify ≙ server-side
 exclusive claim), ``MongoTrials.refresh`` (cursor fetch ≙ ``docs`` verb),
 ``hyperopt-mongo-worker`` CLI (≙ ``python -m hyperopt_tpu.parallel.netstore
@@ -38,6 +49,7 @@ exclusive claim), ``MongoTrials.refresh`` (cursor fetch ≙ ``docs`` verb),
 from __future__ import annotations
 
 import base64
+import hmac
 import json
 import logging
 import os
@@ -46,12 +58,28 @@ import threading
 import time
 from collections.abc import MutableMapping
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.error import HTTPError
 from urllib.request import Request, urlopen
 
 from .filestore import FileTrials, FileWorker, _pickler
 from ..base import Trials
 
 logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# auth
+# ---------------------------------------------------------------------------
+
+
+def _resolve_token(token: str | None) -> str | None:
+    """Effective shared secret: the explicit argument wins, else the
+    ``HYPEROPT_TPU_NETSTORE_TOKEN`` environment variable; empty/unset →
+    no auth (open server, localhost-trusted default).  Shared by server
+    and clients so one env var secures a whole deployment."""
+    if token is None:
+        token = os.environ.get("HYPEROPT_TPU_NETSTORE_TOKEN") or None
+    return token or None
 
 
 # ---------------------------------------------------------------------------
@@ -69,10 +97,12 @@ class StoreServer:
     evaluations — the actual work — happen client-side in the workers).
     """
 
-    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
+                 token: str | None = None):
         self.root = os.path.abspath(root)
         self._trials: dict = {}          # exp_key -> FileTrials
         self._lock = threading.Lock()
+        self._token = _resolve_token(token)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -80,6 +110,27 @@ class StoreServer:
                 logger.debug("netstore: " + fmt, *args)
 
             def do_POST(self):
+                # Auth gate BEFORE the body is parsed or any verb runs:
+                # constant-time compare so the secret can't be recovered
+                # byte-by-byte from response timing.  The request body is
+                # still drained (keep-alive correctness) but never
+                # dispatched.
+                if server._token is not None:
+                    got = self.headers.get("X-Netstore-Token", "")
+                    if not hmac.compare_digest(got.encode(),
+                                               server._token.encode()):
+                        self.rfile.read(
+                            int(self.headers.get("Content-Length", "0")))
+                        body = json.dumps(
+                            {"error": "AuthError: missing or bad "
+                             "X-Netstore-Token"}).encode()
+                        self.send_response(401)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(n) or b"{}")
@@ -195,17 +246,30 @@ class _Rpc:
     """One-POST-per-call JSON client (stdlib urllib; connection reuse is not
     worth a dependency at this call volume)."""
 
-    def __init__(self, url: str, exp_key: str, timeout: float = 30.0):
+    def __init__(self, url: str, exp_key: str, timeout: float = 30.0,
+                 token: str | None = None):
         self.url = url.rstrip("/")
         self.exp_key = exp_key
         self.timeout = timeout
+        self.token = _resolve_token(token)
 
     def __call__(self, verb: str, **kw) -> dict:
         kw.update(verb=verb, exp_key=self.exp_key)
+        headers = {"Content-Type": "application/json"}
+        if self.token is not None:
+            headers["X-Netstore-Token"] = self.token
         req = Request(self.url, data=json.dumps(kw).encode(),
-                      headers={"Content-Type": "application/json"})
-        with urlopen(req, timeout=self.timeout) as resp:
-            out = json.loads(resp.read())
+                      headers=headers)
+        try:
+            with urlopen(req, timeout=self.timeout) as resp:
+                out = json.loads(resp.read())
+        except HTTPError as e:
+            # Non-2xx (500 server fault, 401 auth) carries the JSON error
+            # body; surface it as the RuntimeError the callers expect.
+            try:
+                out = json.loads(e.read())
+            except Exception:
+                out = {"error": f"HTTP {e.code}"}
         if "error" in out:
             raise RuntimeError(f"netstore server: {out['error']}")
         return out
@@ -246,8 +310,8 @@ class NetTrials(Trials):
     asynchronous = True
 
     def __init__(self, url: str, exp_key: str = "default", refresh=True,
-                 timeout: float = 30.0):
-        self._rpc = _Rpc(url, exp_key, timeout=timeout)
+                 timeout: float = 30.0, token: str | None = None):
+        self._rpc = _Rpc(url, exp_key, timeout=timeout, token=token)
         super().__init__(exp_key=exp_key, refresh=refresh)
         self.attachments = _NetAttachments(self._rpc)
 
@@ -314,11 +378,18 @@ class NetTrials(Trials):
 
 class NetWorker(FileWorker):
     """`FileWorker` over the network store: the identical
-    reserve→evaluate→heartbeat→write loop, claims arbitrated server-side."""
+    reserve→evaluate→heartbeat→write loop, claims arbitrated server-side.
+    ``token`` (or the env secret) authenticates every verb against a
+    token-protected :class:`StoreServer`."""
 
-    @staticmethod
-    def _make_trials(url, exp_key):
-        return NetTrials(url, exp_key=exp_key)
+    def __init__(self, url, exp_key="default", token: str | None = None,
+                 **kwargs):
+        # Resolved before super().__init__ — which calls _make_trials.
+        self._token = _resolve_token(token)
+        super().__init__(url, exp_key=exp_key, **kwargs)
+
+    def _make_trials(self, url, exp_key):
+        return NetTrials(url, exp_key=exp_key, token=self._token)
 
 
 # ---------------------------------------------------------------------------
@@ -342,6 +413,10 @@ def main(argv=None):
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=8417)
     p.add_argument("--exp-key", default="default")
+    p.add_argument("--token", default=None,
+                   help="shared secret for every verb (default: the "
+                        "HYPEROPT_TPU_NETSTORE_TOKEN env var; unset = "
+                        "open server)")
     p.add_argument("--poll-interval", type=float, default=0.1)
     p.add_argument("--reserve-timeout", type=float, default=None)
     p.add_argument("--max-consecutive-failures", type=int, default=4)
@@ -351,7 +426,8 @@ def main(argv=None):
     if args.serve:
         if not args.root:
             p.error("--serve requires --root")
-        server = StoreServer(args.root, host=args.host, port=args.port)
+        server = StoreServer(args.root, host=args.host, port=args.port,
+                             token=args.token)
         print(f"netstore: serving {args.root} at {server.url}", flush=True)
         try:
             server.serve_forever()
@@ -359,7 +435,7 @@ def main(argv=None):
             server.shutdown()
         return 0
 
-    worker = NetWorker(args.worker, exp_key=args.exp_key,
+    worker = NetWorker(args.worker, exp_key=args.exp_key, token=args.token,
                        poll_interval=args.poll_interval,
                        reserve_timeout=args.reserve_timeout,
                        max_consecutive_failures=args.max_consecutive_failures,

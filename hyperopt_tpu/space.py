@@ -753,6 +753,16 @@ class CompiledSpace:
             return int(raw)
         if spec.kind in (RANDINT, UNIFORMINT):
             return int(raw)
+        if spec.q:
+            # Re-snap to the q-lattice in f64 on the host: the device
+            # value is the f32 ROUNDING of a lattice point, which for
+            # large-magnitude non-power-of-two lattices (quniform(0, 1e9,
+            # 100) passes the collision guard since 1e7 < 2**24) decodes
+            # off-lattice (999999904.0).  The guard ensures distinct
+            # lattice points stay distinct in f32, so round(raw/q)
+            # recovers the exact intended k and k·q in f64 is exact
+            # (round-5 advisor finding #3).
+            return float(np.round(float(raw) / spec.q) * spec.q)
         return float(raw)
 
     def _walk(self, getter):

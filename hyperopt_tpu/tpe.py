@@ -57,6 +57,7 @@ from .ops import (
     gmm_sample,
 )
 from .ops.gmm import onehot_lookup
+from .utils.tracing import kernel_cache_event
 from .space import (
     CATEGORICAL,
     LOGNORMAL,
@@ -143,6 +144,47 @@ def _pallas_ei_impl() -> str:
     """
     env = os.environ.get("HYPEROPT_TPU_PALLAS_EI", "vpu")
     return env if env in ("vpu", "mxu") else "vpu"
+
+
+def _ei_precision() -> str:
+    """EI exponent-math precision (``HYPEROPT_TPU_EI_PRECISION``).
+
+    ``f32`` (default) — the pre-existing exact formulation, bit-identical
+    to every earlier round.  ``bf16`` — the ``[n_cand, K]``
+    ``(z−mu)/sigma`` standardize-and-square broadcast runs in bfloat16
+    while the logsumexp accumulate and normalizers stay f32, in BOTH the
+    Pallas VPU kernel (``ei_scores(..., bf16=True)``) and the XLA
+    fallback (``gmm_logpdf(..., exp_dtype=bfloat16)``).  Density EI path
+    only; the q-lattice/q-mass path has no equivalent broadcast and
+    ignores the toggle.  Judged by the proposal-parity canary in
+    ``benchmarks/step_ei_ab.py`` — any default flip requires the canary
+    bit-identical, which bf16 by construction is NOT, so this ships
+    opt-in (measured A/B recorded in DESIGN.md §6).  Snapshotted at
+    kernel construction and part of the kernel cache key.
+    """
+    env = os.environ.get("HYPEROPT_TPU_EI_PRECISION", "f32").strip().lower()
+    return env if env in ("f32", "bf16") else "f32"
+
+
+def _ei_topm() -> int:
+    """Above-model component-truncation width (``HYPEROPT_TPU_EI_TOPM``).
+
+    0/unset (default) — score against the full above mixture.  M > 0 —
+    prefilter the above model to its top-M components by weight
+    (``ops/gmm.py::truncate_mixture``) before the ``[n_cand, K]``
+    density broadcast, shrinking the EI block's K axis for big buckets.
+    Only the ABOVE model is truncated: candidates are drawn from the
+    below model, so its full mixture is needed anyway, and the above
+    weight tail is what the truncation argument (sub-f32-epsilon
+    contributions) applies to.  Density path only.  Heuristic, not an
+    identity — off by default, judged by the step_ei_ab.py parity
+    canary; snapshotted at construction and part of the cache key.
+    """
+    try:
+        m = int(os.environ.get("HYPEROPT_TPU_EI_TOPM", "0"))
+        return m if m > 0 else 0
+    except ValueError:
+        return 0
 
 
 def _split_impl() -> str:
@@ -319,6 +361,8 @@ class _TpeKernel:
         self.multivariate = multivariate
         self.pallas = _pallas_mode()
         self.pallas_ei = _pallas_ei_impl()
+        self.ei_precision = _ei_precision()
+        self.ei_topm = _ei_topm()
         self.split_impl = _split_impl()
         # Snapshot at construction: the cache key records this value, and a
         # lazily-traced program must bake in the SAME lowering even if the
@@ -566,6 +610,17 @@ class _TpeKernel:
                 ei = self._chunked_score(ei_q, q_edges(v))
         else:
             v = x_nat
+            if self.ei_topm and self.ei_topm < lwa.shape[-1]:
+                # Above-model prefilter (HYPEROPT_TPU_EI_TOPM): shrink the
+                # EI broadcast's K axis to the top-M above components by
+                # weight.  Above only — the below mixture also feeds the
+                # candidate draw and must stay whole.  Truncation changes
+                # the above normalizer, but that is a per-column constant
+                # along candidates and cancels in the argmax (and the
+                # Pallas path never folds normalizers in anyway).
+                from .ops.gmm import truncate_mixture
+
+                lwa, mua, sga = truncate_mixture(lwa, mua, sga, self.ei_topm)
             if self.pallas != "off":
                 # Fused single-pass Pallas kernel (ops/pallas_gmm.py).  The
                 # per-column truncation normalizers are constants along the
@@ -583,10 +638,15 @@ class _TpeKernel:
                 ei = ei_scores(zc, lwb, mub, sgb, lwa, mua, sga,
                                tile=tile,
                                interpret=self.pallas == "interpret",
-                               mxu=self.pallas_ei == "mxu")
+                               mxu=self.pallas_ei == "mxu",
+                               bf16=self.ei_precision == "bf16")
             else:
+                exp_dtype = (jnp.bfloat16 if self.ei_precision == "bf16"
+                             else None)
+                logpdf = partial(gmm_logpdf, exp_dtype=exp_dtype)
+
                 def ei_n(z_):
-                    sb = jax.vmap(gmm_logpdf, in_axes=(0,) * 6)
+                    sb = jax.vmap(logpdf, in_axes=(0,) * 6)
                     return (sb(z_, lwb, mub, sgb, fit_lo, fit_hi)
                             - sb(z_, lwa, mua, sga, fit_lo, fit_hi))
 
@@ -654,10 +714,17 @@ class _TpeKernel:
             cand = jnp.argmax(lpb[:, None, :] + g, axis=-1)  # [D, n_cand]
         # MXU lookup (ops/gmm.py::onehot_lookup) of the score diff:
         # padded options carry -inf in BOTH lpb and lpa (NaN under
-        # subtraction), so each side is made finite first — padded
-        # indices are never selected, the stand-in value is irrelevant.
-        diff = (jnp.where(jnp.isfinite(lpb), lpb, 0.0)
-                - jnp.where(jnp.isfinite(lpa), lpa, 0.0))  # [D, kmax]
+        # subtraction), so each side is clamped to a large negative
+        # FINITE value first — matching the q-lattice path's -3e38
+        # stand-in, not zero.  The distinction matters for SELECTABLE
+        # options with zero above-mass (prior_weight=0, or a pchoice
+        # zero-probability option seeded into the below set): the
+        # reference's density ratio gives them score +inf (always win);
+        # clamping lpa to -3e38 keeps them dominating the argmax, where
+        # the old zeroing silently demoted them to score lpb (round-5
+        # advisor finding #4).  Padded indices are never selected, so
+        # their 0.0 diff under the symmetric clamp stays irrelevant.
+        diff = jnp.maximum(lpb, -3e38) - jnp.maximum(lpa, -3e38)  # [D, kmax]
         score = onehot_lookup(cand, diff)
         return cand.astype(jnp.float32) + self.cat_offsets[:, None], score
 
@@ -872,8 +939,10 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
     # a mid-process toggle must produce a fresh kernel, never a stale one.
     k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
          _pallas_mode(), _comp_sampler(), _pallas_tile(), _split_impl(),
-         prng_impl(), _pallas_ei_impl())
-    if k not in cache:
+         prng_impl(), _pallas_ei_impl(), _ei_precision(), _ei_topm())
+    hit = k in cache
+    kernel_cache_event(k, hit)
+    if not hit:
         cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split, multivariate,
                               cat_prior)
     return cache[k]

@@ -9,16 +9,69 @@ Enable with ``fmin(..., trace_dir="/tmp/trace")`` or the
 ``HYPEROPT_TPU_TRACE_DIR`` environment variable.  The span summary is
 written to ``<trace_dir>/loop_trace.json``; device traces (if jax.profiler
 is usable) land in the same directory.
+
+Also home to the process-global TPE kernel-cache counters
+(:func:`kernel_cache_event` / :func:`kernel_cache_stats`) — compile-shape
+accounting for ``tpe.get_kernel``, consumed by ``benchmarks/atpe_profile.py``.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 from typing import Optional
+
+# -- kernel-cache statistics -------------------------------------------------
+#
+# Process-global request/miss counters for the TPE kernel cache
+# (``tpe.get_kernel``).  A miss means a fresh ``_TpeKernel`` was
+# constructed — i.e. a new XLA program will be traced and compiled — so
+# ``misses`` is the per-process compile-shape count the ATPE arm
+# canonicalization work optimizes (``benchmarks/atpe_profile.py`` reads
+# these before/after to show arms collapsing onto shared shapes).
+# Always on: two dict increments under a lock per suggest are noise next
+# to a single device dispatch.
+
+_CACHE_LOCK = threading.Lock()
+_CACHE_STATS: dict = {"requests": 0, "misses": 0, "by_key": {}}
+
+
+def kernel_cache_event(key, hit: bool) -> None:
+    """Record one ``get_kernel`` lookup. ``key``: the cache-key tuple."""
+    ks = repr(key)
+    with _CACHE_LOCK:
+        _CACHE_STATS["requests"] += 1
+        per = _CACHE_STATS["by_key"].setdefault(
+            ks, {"requests": 0, "misses": 0})
+        per["requests"] += 1
+        if not hit:
+            _CACHE_STATS["misses"] += 1
+            per["misses"] += 1
+
+
+def kernel_cache_stats(reset: bool = False) -> dict:
+    """Snapshot (and optionally reset) the process-global cache counters.
+
+    Returns ``{"requests": int, "misses": int, "by_key": {repr(key):
+    {"requests": int, "misses": int}}}``.  ``misses`` counts distinct
+    kernel constructions (compile shapes); ``by_key`` lets callers
+    attribute them — e.g. ``benchmarks/atpe_profile.py`` diffing arm
+    shapes with tiering on vs off.
+    """
+    with _CACHE_LOCK:
+        out = {"requests": _CACHE_STATS["requests"],
+               "misses": _CACHE_STATS["misses"],
+               "by_key": {k: dict(v)
+                          for k, v in _CACHE_STATS["by_key"].items()}}
+        if reset:
+            _CACHE_STATS["requests"] = 0
+            _CACHE_STATS["misses"] = 0
+            _CACHE_STATS["by_key"] = {}
+    return out
 
 
 class Tracer:

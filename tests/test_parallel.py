@@ -287,6 +287,57 @@ class TestNetStore:
         finally:
             srv.shutdown()
 
+    def test_net_auth_rejects_unauthenticated_peer(self, tmp_path,
+                                                   monkeypatch):
+        """A token-protected server must refuse every verb from a peer
+        with a missing or wrong ``X-Netstore-Token`` — an unauthenticated
+        peer can neither claim work nor write results nor read the queue
+        — while tokened clients (explicit arg or
+        ``HYPEROPT_TPU_NETSTORE_TOKEN``) operate normally."""
+        from hyperopt_tpu.parallel import NetTrials
+        from hyperopt_tpu.parallel.netstore import StoreServer
+
+        monkeypatch.delenv("HYPEROPT_TPU_NETSTORE_TOKEN", raising=False)
+        srv = StoreServer(str(tmp_path / "store"), token="s3kr1t")
+        srv.start()
+        try:
+            dom = Domain(_quad, _quad_space())
+            good = NetTrials(srv.url, exp_key="e1", token="s3kr1t")
+            docs = rand.suggest(good.new_trial_ids(1), dom, good, 0)
+            good.insert_trial_docs(docs)
+
+            for bad in (NetTrials(srv.url, exp_key="e1", refresh=False),
+                        NetTrials(srv.url, exp_key="e1", refresh=False,
+                                  token="wrong")):
+                with pytest.raises(RuntimeError, match="AuthError"):
+                    bad.reserve("intruder")
+                with pytest.raises(RuntimeError, match="AuthError"):
+                    bad.insert_trial_docs(
+                        rand.suggest([99], dom, good, 1))
+                with pytest.raises(RuntimeError, match="AuthError"):
+                    bad.refresh()
+                fake = dict(docs[0], state=JOB_STATE_DONE,
+                            result={"status": "ok", "loss": 0.0})
+                with pytest.raises(RuntimeError, match="AuthError"):
+                    bad.write_result(fake, owner="intruder")
+
+            # The rejected calls left the store untouched: the one real
+            # trial is still claimable and completable by a tokened peer.
+            good.refresh()
+            assert len(good.trials) == 1
+            doc = good.reserve("worker-a")
+            assert doc is not None and doc["tid"] == docs[0]["tid"]
+            doc["state"] = JOB_STATE_DONE
+            doc["result"] = {"status": "ok", "loss": 1.0}
+            assert good.write_result(doc, owner="worker-a") is True
+
+            # Env-var fallback supplies the same secret.
+            monkeypatch.setenv("HYPEROPT_TPU_NETSTORE_TOKEN", "s3kr1t")
+            env_client = NetTrials(srv.url, exp_key="e1")
+            assert len(env_client.trials) == 1
+        finally:
+            srv.shutdown()
+
     def test_net_server_restart_preserves_state(self, tmp_path):
         """Durability across server restarts (the mongod-restart analog):
         every document, attachment, and the published domain live on the

@@ -274,6 +274,25 @@ def test_active_mask_host_matches_device():
 # -- decode / eval_point -----------------------------------------------------
 
 
+def test_quantized_decode_resnaps_to_lattice():
+    """quniform(0, 1e9, 100) passes the f32 collision guard (1e7 lattice
+    points < 2**24) yet its large lattice values are NOT exactly f32
+    representable — the device row holds the f32 ROUNDING of k·q (e.g.
+    999999872 for k·q = 999999900).  Decoding must re-snap on the host in
+    f64 so user-visible values sit exactly on the q-lattice."""
+    cs = ht.compile_space({"x": hp.quniform("x", 0, 1e9, 100)})
+    for kq in (999_999_900.0, 123_456_700.0, 16_777_300.0, 400.0, 0.0):
+        raw = np.float32(kq)           # what the device actually returns
+        out = cs.decode_row(np.asarray([raw], np.float32))
+        assert out["x"] == kq, (kq, float(raw), out["x"])
+        assert out["x"] % 100.0 == 0.0
+    # Sampled end-to-end: every decoded value is an exact multiple of q.
+    cs2, v, _ = _sample({"x": hp.quniform("x", 0, 1e9, 100)}, n=512, seed=7)
+    for i in range(0, 512, 37):
+        d = cs2.decode_row(v[i])
+        assert d["x"] % 100.0 == 0.0
+
+
 def test_decode_row_nested_structure():
     space = {"lr": hp.loguniform("lr", -5, 0),
              "opt": hp.choice("opt", [

@@ -4,9 +4,10 @@ Answers two questions the ATPE canonicalization work is judged on:
 
 1. How many distinct XLA programs (kernel-cache MISSES) does an ATPE run
    compile, per arm-shape key, with arm tiering ON vs OFF
-   (``HYPEROPT_TPU_ATPE_TIERS``)?  Counters come from
-   ``hyperopt_tpu.utils.tracing.kernel_cache_stats`` — a miss is a fresh
-   ``_TpeKernel`` (one trace + compile).
+   (``HYPEROPT_TPU_ATPE_TIERS``)?  Counters come from the shared
+   observability registry (``hyperopt_tpu.obs.registry().snapshot()``,
+   whose ``kernel_cache`` section is the old ``kernel_cache_stats``
+   schema) — a miss is a fresh ``_TpeKernel`` (one trace + compile).
 2. What is the resulting wall-time ratio ``atpe_s / tpe_s`` on an
    identical run?  Target: <= 1.5x; if the residual gap is irreducible
    (each remaining shape is a distinct program REQUIRED by arm
@@ -33,7 +34,7 @@ def _child(algo_name):
     import numpy as np
 
     from hyperopt_tpu import Trials, atpe, fmin, hp, tpe
-    from hyperopt_tpu.utils.tracing import kernel_cache_stats
+    from hyperopt_tpu.obs import registry
 
     space = {
         "x": hp.uniform("x", -5, 5),
@@ -57,8 +58,10 @@ def _child(algo_name):
     wall_s = time.perf_counter() - t0
     best = min(t["result"]["loss"] for t in trials
                if t["result"].get("loss") is not None)
+    snap = registry().snapshot()
     print(json.dumps({"wall_s": round(wall_s, 3), "best": best,
-                      "cache": kernel_cache_stats()}))
+                      "cache": snap["kernel_cache"],
+                      "counters": snap["counters"]}))
 
 
 def _run(algo_name, tiers):

@@ -5,6 +5,11 @@ same posterior update per trial) — the streams differ (different key
 derivation), so the check is statistical: per-seed best losses from both
 paths on the same domains must land in the same family.
 
+Sweep: 5 zoo domains x 20 seeds, including one conditional
+(activity-mask) space — ``gauss_wave2``'s choice-gated amplitude, whose
+device objective reads the mask through the two-argument ``(params,
+active)`` convention.
+
 Run::
 
     env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python benchmarks/device_ab.py
@@ -25,7 +30,7 @@ import numpy as np
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _ROOT)
 
-SEEDS = [0, 1, 2, 3, 4]
+SEEDS = list(range(20))
 
 
 def main():
@@ -46,25 +51,74 @@ def main():
                  - 6) ** 2 + 10 * (1 - 1 / (8 * math.pi)) * jnp.cos(x)
                 + 10)
 
+    def gauss_wave_host(p):
+        x = p["x"]
+        return -math.exp(-(x ** 2)) * (1 + 0.5 * math.cos(5 * x))
+
+    def gauss_wave_dev(p):
+        x = p["x"]
+        return -jnp.exp(-(x ** 2)) * (1 + 0.5 * jnp.cos(5 * x))
+
+    def distractor_host(p):
+        x = p["x"]
+        return -(math.exp(-((x - 3) ** 2))
+                 + 2.0 * math.exp(-((x + 3) ** 2) / 0.02 ** 2))
+
+    def distractor_dev(p):
+        x = p["x"]
+        return -(jnp.exp(-((x - 3) ** 2))
+                 + 2.0 * jnp.exp(-((x + 3) ** 2) / 0.02 ** 2))
+
+    # Conditional space (tests/zoo.py::gauss_wave2): the "curve" choice
+    # gates an amplitude parameter.  The host objective branches on the
+    # realized dict; the device objective takes the two-argument
+    # ``(params, active)`` form and selects with the activity mask.
+    gw2_space = {
+        "x": hp.uniform("x", -5, 5),
+        "curve": hp.choice("curve", [
+            {"kind": "plain"},
+            {"kind": "cos", "amp": hp.uniform("amp", 0.5, 2.0)},
+        ]),
+    }
+
+    def gw2_host(p):
+        x = p["x"]
+        c = p["curve"]
+        if c["kind"] == "plain":
+            return -math.exp(-(x ** 2))
+        return -c["amp"] * math.exp(-(x ** 2)) * math.cos(3 * x) ** 2
+
+    def gw2_dev(p, active):
+        x = p["x"]
+        plain = -jnp.exp(-(x ** 2))
+        cos_branch = -p["amp"] * jnp.exp(-(x ** 2)) * jnp.cos(3 * x) ** 2
+        return jnp.where(active["amp"], cos_branch, plain)
+
     domains = [
-        ("branin", {"x": hp.uniform("x", -5, 10),
-                    "y": hp.uniform("y", 0, 15)},
-         branin_host, branin_dev, 150),
         ("quadratic1", {"x": hp.uniform("x", -5, 5)},
          lambda p: (p["x"] - 3.0) ** 2,
          lambda p: (p["x"] - 3.0) ** 2, 80),
+        ("branin", {"x": hp.uniform("x", -5, 10),
+                    "y": hp.uniform("y", 0, 15)},
+         branin_host, branin_dev, 150),
+        ("gauss_wave", {"x": hp.uniform("x", -10, 10)},
+         gauss_wave_host, gauss_wave_dev, 120),
+        ("distractor", {"x": hp.uniform("x", -15, 15)},
+         distractor_host, distractor_dev, 150),
+        ("gauss_wave2", gw2_space, gw2_host, gw2_dev, 150),
     ]
     rows = []
     for name, space, fh, fd, budget in domains:
+        cs = ho.compile_space(space)   # one sampler/kernel cache per domain
         host, dev = [], []
         t0 = time.perf_counter()
         for s in SEEDS:
             t = ho.Trials()
-            ho.fmin(fh, space, algo=ho.tpe.suggest, max_evals=budget,
+            ho.fmin(fh, cs, algo=ho.tpe.suggest, max_evals=budget,
                     trials=t, rstate=np.random.default_rng(s),
                     show_progressbar=False)
             host.append(float(t.best_trial["result"]["loss"]))
-            _, info = ho.fmin_device(fd, space, max_evals=budget, seed=s)
+            _, info = ho.fmin_device(fd, cs, max_evals=budget, seed=s)
             dev.append(info["best_loss"])
         rec = {"domain": name, "budget": budget,
                "host_median": round(float(np.median(host)), 6),
@@ -75,10 +129,16 @@ def main():
         rows.append(rec)
         print(json.dumps(rec), flush=True)
 
+    import jax
+
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "quality_ab_fmin_vs_fmin_device.json")
     with open(out, "w") as f:
-        json.dump({"seeds": SEEDS, "rows": rows}, f, indent=1)
+        json.dump({"metric": "quality_ab_fmin_vs_fmin_device",
+                   "backend": jax.default_backend(),
+                   "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime()),
+                   "seeds": SEEDS, "rows": rows}, f, indent=1)
     print(f"# wrote {out}")
 
 

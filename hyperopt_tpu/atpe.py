@@ -58,6 +58,8 @@ import numpy as np
 
 from . import base, tpe
 from .base import JOB_STATE_DONE, JOB_STATE_ERROR, STATUS_OK
+from .obs import metrics as _metrics
+from .obs.events import EVENTS
 from .space import CATEGORICAL, RANDINT, UNIFORMINT
 
 logger = logging.getLogger(__name__)
@@ -362,11 +364,23 @@ class _TransferStore:
         losses = np.ones(n_arms)
         counts = self._counts(data.get(fp), n_arms)
         cap = self.EVIDENCE_CAP
+        _reg = _metrics.registry()
+        if counts is not None:
+            _reg.counter("atpe.transfer.exact").inc()
+            EVENTS.emit("transfer_borrow", name="exact", fp=fp)
+        elif fp in data:
+            # A record exists for this fingerprint but failed validation.
+            _reg.counter("atpe.transfer.dropped").inc()
+            EVENTS.emit("transfer_drop", name="malformed", fp=fp)
         if counts is None and features is not None:
             counts, sim = self._nearest(data, fp, features)
             if counts is not None:
                 cap *= self.NEIGHBOR_DISCOUNT * sim
+                _reg.counter("atpe.transfer.neighbor").inc()
+                EVENTS.emit("transfer_borrow", name="neighbor", fp=fp,
+                            sim=round(sim, 4))
         if counts is None:
+            _reg.counter("atpe.transfer.cold").inc()
             return wins, losses
         w, l = counts
         m = min(n_arms, len(w))       # prefix-map an evolved portfolio
@@ -435,7 +449,11 @@ class _TransferStore:
                 with open(tmp, "w") as f:
                     json.dump(data, f)
                 os.replace(tmp, self.path)
+                _metrics.registry().counter("atpe.transfer.flushes").inc()
+                EVENTS.emit("store_flush", name="atpe_transfer", fp=fp)
             except OSError:   # cache dir unwritable → adapt in-memory only
+                _metrics.registry().counter(
+                    "atpe.transfer.flush_failed").inc()
                 logger.debug("atpe transfer flush failed", exc_info=True)
 
 
@@ -571,6 +589,9 @@ def suggest(new_ids, domain, trials, seed,
     st.settle(trials)
     rng = np.random.default_rng(int(seed) % (2 ** 32))
     arm = st.pick(rng)
+    _reg = _metrics.registry()
+    _reg.counter("atpe.suggest.calls").inc()
+    _reg.counter(f"atpe.arm.{arm}.picked").inc()
     cfg = dict(arms[arm])
     lockout = cfg.pop("lockout", None)
     cfg.setdefault("linear_forgetting", linear_forgetting)

@@ -254,9 +254,15 @@ def fmin_device(fn, space, max_evals, seed=0,
                  _pallas_tile(), mesh_k,
                  n_runs, patience, float(min_improvement), prng_impl())
     run = cache.get(cache_key)
+    from .obs import EVENTS, registry as _obs_registry
+    _reg = _obs_registry()
     if run is not None:
         cache.move_to_end(cache_key)
+        _reg.counter("device.run_cache.hits").inc()
     if run is None:
+        _reg.counter("device.run_cache.misses").inc()
+        EVENTS.emit("compile", name="fmin_device",
+                    max_evals=max_evals, n_runs=n_runs)
         gamma_f = jnp.float32(gamma)
         pw_f = jnp.float32(prior_weight)
         p_dim = cs.n_params

@@ -35,6 +35,8 @@ from .base import (
     coarse_utcnow,
 )
 from .exceptions import AllTrialsFailed
+from .obs import metrics as _metrics
+from .obs.events import EVENTS
 from .space import compile_space
 from .utils.progress import default_callback, no_progress_callback
 
@@ -96,7 +98,7 @@ class FMinIter:
                  timeout=None, loss_threshold=None,
                  show_progressbar=True, verbose=False, trace_dir=None,
                  overlap_suggest=False):
-        from .utils.tracing import NullTracer, Tracer
+        from .obs import NullTracer, Tracer
         trace_dir = trace_dir or os.environ.get("HYPEROPT_TPU_TRACE_DIR")
         self.tracer = (Tracer(trace_dir, device_trace=True) if trace_dir
                        else NullTracer())
@@ -146,11 +148,13 @@ class FMinIter:
     # -- evaluation ---------------------------------------------------------
 
     def serial_evaluate(self, N=-1):
+        _reg = _metrics.registry()
         for trial in self.trials._dynamic_trials:
             if trial["state"] != JOB_STATE_NEW:
                 continue
             trial["state"] = JOB_STATE_RUNNING
             trial["book_time"] = coarse_utcnow()
+            EVENTS.emit("trial_start", trial=trial["tid"])
             ctrl = Ctrl(self.trials, current_trial=trial)
             try:
                 spec = base.spec_from_misc(trial["misc"])
@@ -160,6 +164,9 @@ class FMinIter:
                 trial["state"] = JOB_STATE_ERROR
                 trial["misc"]["error"] = (type(e).__name__, str(e))
                 trial["refresh_time"] = coarse_utcnow()
+                EVENTS.emit("trial_end", trial=trial["tid"], state="error",
+                            error=type(e).__name__)
+                _reg.counter("fmin.trials.error").inc()
                 if not self.catch_eval_exceptions:
                     self.trials.refresh()
                     raise
@@ -167,6 +174,9 @@ class FMinIter:
                 trial["state"] = JOB_STATE_DONE
                 trial["result"] = result
                 trial["refresh_time"] = coarse_utcnow()
+                EVENTS.emit("trial_end", trial=trial["tid"], state="done",
+                            loss=result.get("loss"))
+                _reg.counter("fmin.trials.done").inc()
             N -= 1
             if N == 0:
                 break
@@ -235,11 +245,14 @@ class FMinIter:
                     new_ids = trials.new_trial_ids(n_to_enqueue)
                     trials.refresh()
                     new_trials = self.algo(new_ids, self.domain, trials, seed)
+                EVENTS.emit("suggest",
+                            n=0 if new_trials is None else len(new_trials))
             if new_trials is None or len(new_trials) == 0:
                 stopped = True
             else:
-                trials.insert_trial_docs(new_trials)
-                trials.refresh()
+                with self.tracer.span("store"):
+                    trials.insert_trial_docs(new_trials)
+                    trials.refresh()
                 if self.overlap_suggest and remaining > n_to_enqueue:
                     # Pre-dispatch the NEXT batch before evaluating: it
                     # conditions on history up to the previous batch and
@@ -251,22 +264,26 @@ class FMinIter:
                         ids, self.domain, trials, seed)
 
         if self.asynchronous:
-            time.sleep(self.poll_interval_secs)
-            trials.refresh()
+            with self.tracer.span("poll"):
+                time.sleep(self.poll_interval_secs)
+                trials.refresh()
         else:
             with self.tracer.span("evaluate"):
                 self.serial_evaluate()
 
-        self._save_trials()
+        with self.tracer.span("save"):
+            self._save_trials()
 
         if self.early_stop_fn is not None:
-            stop, kwargs = self.early_stop_fn(self.trials,
-                                              *self.early_stop_args)
+            with self.tracer.span("early_stop"):
+                stop, kwargs = self.early_stop_fn(self.trials,
+                                                  *self.early_stop_args)
             self.early_stop_args = kwargs
             if stop:
                 logger.info("early stop triggered")
                 self._cancel_inflight("early stop")
                 stopped = True
+        _metrics.registry().counter("fmin.batches").inc()
         return stopped
 
     def _cancel_inflight(self, reason):
@@ -324,9 +341,11 @@ class FMinIter:
                     pass
                 raise
             os.replace(tmp, self.trials_save_file)
+            EVENTS.emit("store_flush", name="trials_save_file")
             return
         with open(self.trials_save_file, "wb") as f:
             pickle.dump(self.trials, f, protocol=self.pickle_protocol)
+        EVENTS.emit("store_flush", name="trials_save_file")
 
     def run(self, N, block_until_done=True):
         """Reference-compat: enqueue+evaluate ~N more trials."""
@@ -372,10 +391,16 @@ class FMinIter:
     def exhaust(self):
         """Run until ``max_evals`` complete (or a stop condition fires)."""
         self.tracer.start_device_trace()
+        t0 = time.perf_counter()
         try:
             self._loop()
             self.block_until_done()
         finally:
+            wall = time.perf_counter() - t0
+            if wall > 0:
+                _metrics.registry().gauge("fmin.trials_per_sec").set(
+                    self.n_done() / wall)
+            self.tracer.set_wall(wall)
             self.tracer.stop_device_trace()
             self.tracer.dump()
         return self

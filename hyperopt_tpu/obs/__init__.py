@@ -1,0 +1,44 @@
+"""Observability subsystem: structured events, metrics, loop tracing.
+
+SURVEY.md §5.1 names tracing/profiling as a required auxiliary subsystem;
+until round 6 it lived as ad-hoc counters in ``utils/tracing.py`` plus
+bespoke instrumentation re-rolled inside each bench script.  This package
+makes telemetry first-class, in three layers:
+
+* :mod:`~hyperopt_tpu.obs.events` — a process-global **structured event
+  log**: a bounded ring buffer of typed events (``trial_start/end``,
+  ``suggest``, ``compile``, ``store_claim/write/flush``,
+  ``worker_up/down``, ``transfer_borrow/drop``) carrying trial ids,
+  monotonic + wall timestamps and nested span ids, dumpable as JSONL and
+  exportable as Chrome ``trace_event`` JSON so host spans load in
+  Perfetto alongside ``jax.profiler`` device traces.
+* :mod:`~hyperopt_tpu.obs.metrics` — a process-global **metrics
+  registry** (counters / gauges / histograms behind one lock,
+  near-zero-cost when disabled) fed by the loop, both suggest
+  algorithms, the device-resident loop and all four parallel backends;
+  also home to the TPE kernel-cache compile-shape counters
+  (``kernel_cache_event`` / ``kernel_cache_stats``).
+* :mod:`~hyperopt_tpu.obs.trace` — the per-``fmin`` :class:`Tracer`
+  (span aggregation + ``jax.profiler`` device traces) which arms the
+  event log for the run and writes ``loop_trace.json`` /
+  ``loop_events.jsonl`` / ``chrome_trace.json`` into its ``trace_dir``.
+
+Surfacing: ``hyperopt-tpu-show trace <dir>`` renders a per-phase summary
+table from a trace directory; the netstore server exposes the registry
+via a token-gated ``GET /metrics``.
+
+Everything here is host-side bookkeeping — nothing in this package ever
+touches the traced/compiled XLA programs.
+"""
+
+from __future__ import annotations
+
+from .events import EVENTS, EventLog  # noqa: F401
+from .metrics import (  # noqa: F401
+    MetricsRegistry,
+    kernel_cache_event,
+    kernel_cache_stats,
+    metrics_enabled,
+    registry,
+)
+from .trace import NullTracer, Tracer  # noqa: F401

@@ -1,0 +1,275 @@
+"""Bounded-buffer structured event log with Chrome trace_event export.
+
+One process-global :class:`EventLog` (``EVENTS``) collects typed events
+from the loop, the suggest algorithms and the parallel backends.  The
+log is **disabled by default** — ``emit()``/``span()`` reduce to a single
+attribute check — and is armed either explicitly or by constructing a
+:class:`~hyperopt_tpu.obs.trace.Tracer` with a ``trace_dir``.
+
+Event vocabulary (advisory, not enforced — see EVENT_TYPES):
+
+* ``trial_start`` / ``trial_end`` — one pair per trial, carrying the tid
+* ``suggest`` — one per suggest call (point event; the wall time lives
+  in the enclosing ``span_begin/span_end`` pair emitted by the Tracer)
+* ``compile`` — a kernel-cache miss (TPE kernel or device-loop run
+  cache); each one is a fresh XLA compilation
+* ``store_claim`` / ``store_write`` / ``store_flush`` — trial-store
+  claim/result/persistence traffic
+* ``worker_up`` / ``worker_down`` — parallel worker lifecycle
+* ``transfer_borrow`` / ``transfer_drop`` — ATPE cross-run transfer
+  decisions
+* ``span_begin`` / ``span_end`` — nested named spans (suggest, evaluate,
+  store, save, ...) with per-thread parent links
+
+Each record carries ``t_mono`` (``time.perf_counter()``) and ``t_wall``
+(epoch seconds, derived from a single wall/mono anchor pair so the two
+clocks never disagree about ordering), the emitting thread, and the
+enclosing span id.  Storage is a ``collections.deque(maxlen=capacity)``
+ring buffer (``HYPEROPT_TPU_TRACE_BUFFER``, default 65536): a run that
+out-lives the buffer keeps the most recent events instead of growing
+without bound.
+
+``to_chrome_trace()`` converts span pairs into ``"ph": "X"`` complete
+events and everything else into ``"ph": "i"`` instants, microsecond
+timestamps, which Perfetto / chrome://tracing load directly; because
+``ts`` is epoch-anchored the host spans line up with ``jax.profiler``
+device traces captured in the same run.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+__all__ = ["EVENTS", "EventLog", "EVENT_TYPES", "DEFAULT_CAPACITY"]
+
+DEFAULT_CAPACITY = 65536
+
+#: Advisory vocabulary for ``type`` — emit() accepts any string so new
+#: subsystems can add events without touching this module, but everything
+#: the core emits is listed here (tests pin the core set against it).
+EVENT_TYPES = frozenset(
+    {
+        "trial_start",
+        "trial_end",
+        "suggest",
+        "compile",
+        "store_claim",
+        "store_write",
+        "store_flush",
+        "store_requeue",
+        "worker_up",
+        "worker_down",
+        "transfer_borrow",
+        "transfer_drop",
+        "span_begin",
+        "span_end",
+    }
+)
+
+
+def _capacity_from_env() -> int:
+    raw = os.environ.get("HYPEROPT_TPU_TRACE_BUFFER", "")
+    try:
+        cap = int(raw) if raw else DEFAULT_CAPACITY
+    except ValueError:
+        cap = DEFAULT_CAPACITY
+    return max(1, cap)
+
+
+class EventLog:
+    """Thread-safe bounded ring buffer of typed telemetry events."""
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            capacity = _capacity_from_env()
+        self.capacity = max(1, int(capacity))
+        self._buf: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._span_ids = itertools.count(1)
+        self._tls = threading.local()
+        self._enabled = False
+        self.n_emitted = 0  # total ever emitted (buffer may have dropped some)
+        # One wall/mono anchor pair: t_wall is always derived from t_mono so
+        # the two clocks can never disagree about event ordering.
+        self._wall0 = time.time()
+        self._mono0 = time.perf_counter()
+
+    # -- arming ----------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self.n_emitted = 0
+
+    # -- emission --------------------------------------------------------
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def emit(self, etype: str, name=None, trial=None, **fields):
+        """Record one point event; returns the record (or None if disabled).
+
+        ``span``/``parent`` are filled from the calling thread's span
+        stack unless passed explicitly in ``fields``.
+        """
+        if not self._enabled:
+            return None
+        mono = time.perf_counter()
+        stack = self._stack()
+        rec = {
+            "type": etype,
+            "t_mono": mono,
+            "t_wall": self._wall0 + (mono - self._mono0),
+            "thread": threading.current_thread().name,
+        }
+        if name is not None:
+            rec["name"] = name
+        if trial is not None:
+            rec["trial"] = trial
+        if "span" not in fields and stack:
+            rec["span"] = stack[-1]
+        rec.update(fields)
+        with self._lock:
+            self._buf.append(rec)
+            self.n_emitted += 1
+        return rec
+
+    @contextmanager
+    def span(self, name: str, trial=None, **fields):
+        """Nested named span: emits span_begin/span_end with parent links."""
+        if not self._enabled:
+            yield None
+            return
+        sid = next(self._span_ids)
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        self.emit("span_begin", name=name, trial=trial, span=sid, parent=parent, **fields)
+        stack.append(sid)
+        try:
+            yield sid
+        finally:
+            stack.pop()
+            self.emit("span_end", name=name, trial=trial, span=sid, parent=parent)
+
+    # -- readout ---------------------------------------------------------
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._buf)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)
+
+    def dump_jsonl(self, path) -> int:
+        """Write one JSON object per line; returns the number written."""
+        events = self.snapshot()
+        with open(path, "w") as fh:
+            for rec in events:
+                fh.write(json.dumps(rec) + "\n")
+        return len(events)
+
+    def to_chrome_trace(self, events: list | None = None) -> dict:
+        """Render as Chrome ``trace_event`` JSON (Perfetto-loadable).
+
+        Matched span_begin/span_end pairs become ``"ph": "X"`` complete
+        events (ts/dur in µs, epoch-anchored); a begin whose end fell
+        outside the ring buffer becomes a zero-duration ``"B"``-less
+        instant rather than an unclosed nesting error; all other events
+        become ``"ph": "i"`` instants.
+        """
+        if events is None:
+            events = self.snapshot()
+        pid = os.getpid()
+        tids: dict = {}
+
+        def _tid(thread_name):
+            return tids.setdefault(thread_name, len(tids) + 1)
+
+        open_spans: dict = {}
+        out = []
+        for rec in events:
+            ph_args = {
+                k: v
+                for k, v in rec.items()
+                if k not in ("type", "name", "t_mono", "t_wall", "thread")
+            }
+            ts_us = rec["t_wall"] * 1e6
+            if rec["type"] == "span_begin":
+                open_spans[rec.get("span")] = rec
+            elif rec["type"] == "span_end":
+                begin = open_spans.pop(rec.get("span"), None)
+                if begin is None:
+                    continue  # begin fell out of the ring buffer
+                out.append(
+                    {
+                        "name": begin.get("name", "span"),
+                        "ph": "X",
+                        "ts": begin["t_wall"] * 1e6,
+                        "dur": max(0.0, (rec["t_mono"] - begin["t_mono"]) * 1e6),
+                        "pid": pid,
+                        "tid": _tid(begin["thread"]),
+                        "cat": "hyperopt_tpu",
+                        "args": {
+                            k: v
+                            for k, v in begin.items()
+                            if k not in ("type", "name", "t_mono", "t_wall", "thread")
+                        },
+                    }
+                )
+            else:
+                out.append(
+                    {
+                        "name": rec.get("name", rec["type"]),
+                        "ph": "i",
+                        "s": "t",
+                        "ts": ts_us,
+                        "pid": pid,
+                        "tid": _tid(rec["thread"]),
+                        "cat": "hyperopt_tpu:" + rec["type"],
+                        "args": ph_args,
+                    }
+                )
+        # Spans still open when the log was read: emit as zero-length marks
+        # so the trace stays loadable.
+        for begin in open_spans.values():
+            out.append(
+                {
+                    "name": begin.get("name", "span"),
+                    "ph": "i",
+                    "s": "t",
+                    "ts": begin["t_wall"] * 1e6,
+                    "pid": pid,
+                    "tid": _tid(begin["thread"]),
+                    "cat": "hyperopt_tpu:span_open",
+                    "args": {},
+                }
+            )
+        out.sort(key=lambda e: e["ts"])
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path) -> int:
+        trace = self.to_chrome_trace()
+        with open(path, "w") as fh:
+            json.dump(trace, fh)
+        return len(trace["traceEvents"])
+
+
+#: Process-global event log; disabled until a Tracer (or a test) arms it.
+EVENTS = EventLog()

@@ -1,0 +1,295 @@
+"""Process-global metrics registry: counters, gauges, histograms.
+
+One :class:`MetricsRegistry` (``registry()``) serves the whole process.
+All mutation happens under a single registry lock — contention is
+negligible at loop rates (tens of updates per trial vs one device
+dispatch) and a single lock keeps ``snapshot()`` trivially consistent.
+
+Cost model: metrics are **on by default** (``HYPEROPT_TPU_METRICS=0``
+disables) because each update is two dict/float ops under an uncontended
+lock.  When disabled, every ``inc``/``set``/``observe`` returns after a
+single attribute check — the disabled path is the budget the
+``trials_per_sec`` bench holds to <1% (DESIGN.md §6).
+
+Also home to the TPE kernel-cache compile-shape counters
+(:func:`kernel_cache_event` / :func:`kernel_cache_stats`), relocated
+from ``utils/tracing.py``.  These stay **always-on** regardless of the
+enable flag — they are the compile-shape accounting contract consumed by
+``benchmarks/atpe_profile.py`` — and each miss additionally emits a
+``compile`` event into the structured event log.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+from typing import Optional
+
+from . import events as _events
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "registry",
+    "metrics_enabled",
+    "kernel_cache_event",
+    "kernel_cache_stats",
+]
+
+# Log-spaced latency bucket upper bounds (seconds): 100µs .. ~52s, ×2 per
+# bucket, plus a catch-all.  Covers netstore RPCs through full fmin runs.
+DEFAULT_BUCKETS = tuple(1e-4 * (2.0 ** i) for i in range(20))
+
+
+def _enabled_from_env() -> bool:
+    return os.environ.get("HYPEROPT_TPU_METRICS", "1") not in ("0", "off", "false")
+
+
+class Counter:
+    """Monotonic float counter."""
+
+    __slots__ = ("name", "_reg", "_value")
+
+    def __init__(self, name: str, reg: "MetricsRegistry"):
+        self.name = name
+        self._reg = reg
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        if not self._reg._enabled:
+            return
+        with self._reg._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._reg._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ("name", "_reg", "_value")
+
+    def __init__(self, name: str, reg: "MetricsRegistry"):
+        self.name = name
+        self._reg = reg
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        if not self._reg._enabled:
+            return
+        with self._reg._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._reg._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with count/sum/min/max.
+
+    Buckets are upper bounds in the observed unit (default: log-spaced
+    seconds for latencies).  Quantiles in ``summary()`` are bucket-upper-
+    bound approximations — good enough for "p99 netstore reserve is 8ms",
+    not for SLO math.
+    """
+
+    __slots__ = ("name", "_reg", "bounds", "_counts", "_count", "_sum", "_min", "_max")
+
+    def __init__(self, name: str, reg: "MetricsRegistry", buckets=None):
+        self.name = name
+        self._reg = reg
+        self.bounds = tuple(sorted(buckets)) if buckets else DEFAULT_BUCKETS
+        self._counts = [0] * (len(self.bounds) + 1)  # +1 overflow bucket
+        self._count = 0
+        self._sum = 0.0
+        self._min = None
+        self._max = None
+
+    def observe(self, v: float) -> None:
+        if not self._reg._enabled:
+            return
+        with self._reg._lock:
+            self._counts[bisect.bisect_left(self.bounds, v)] += 1
+            self._count += 1
+            self._sum += v
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    def _quantile_locked(self, q: float):
+        if self._count == 0:
+            return None
+        target = q * self._count
+        seen = 0
+        for i, c in enumerate(self._counts):
+            seen += c
+            if seen >= target:
+                return self.bounds[i] if i < len(self.bounds) else self._max
+        return self._max
+
+    def summary(self) -> dict:
+        with self._reg._lock:
+            if self._count == 0:
+                return {"count": 0}
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "mean": self._sum / self._count,
+                "min": self._min,
+                "max": self._max,
+                "p50": self._quantile_locked(0.50),
+                "p90": self._quantile_locked(0.90),
+                "p99": self._quantile_locked(0.99),
+            }
+
+
+class MetricsRegistry:
+    """Lock-protected name → metric table with one-call snapshot."""
+
+    def __init__(self, enabled: Optional[bool] = None):
+        self._lock = threading.Lock()
+        self._enabled = _enabled_from_env() if enabled is None else bool(enabled)
+        self._counters: dict = {}
+        self._gauges: dict = {}
+        self._histograms: dict = {}
+        # Kernel-cache compile-shape accounting (always-on; see module doc).
+        self._kernel_cache: dict = {"requests": 0, "misses": 0, "by_key": {}}
+
+    # -- arming ----------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def set_enabled(self, flag: bool) -> None:
+        self._enabled = bool(flag)
+
+    # -- get-or-create ---------------------------------------------------
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            m = self._counters.get(name)
+            if m is None:
+                m = self._counters[name] = Counter(name, self)
+            return m
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            m = self._gauges.get(name)
+            if m is None:
+                m = self._gauges[name] = Gauge(name, self)
+            return m
+
+    def histogram(self, name: str, buckets=None) -> Histogram:
+        with self._lock:
+            m = self._histograms.get(name)
+            if m is None:
+                m = self._histograms[name] = Histogram(name, self, buckets)
+            return m
+
+    # -- kernel cache (always-on) ---------------------------------------
+    def kernel_cache_event(self, key, hit: bool) -> None:
+        ks = repr(key)
+        with self._lock:
+            kc = self._kernel_cache
+            kc["requests"] += 1
+            per = kc["by_key"].setdefault(ks, {"requests": 0, "misses": 0})
+            per["requests"] += 1
+            if not hit:
+                kc["misses"] += 1
+                per["misses"] += 1
+        if not hit:
+            _events.EVENTS.emit("compile", name="tpe_kernel", key=ks)
+
+    def kernel_cache_stats(self, reset: bool = False) -> dict:
+        with self._lock:
+            kc = self._kernel_cache
+            out = {
+                "requests": kc["requests"],
+                "misses": kc["misses"],
+                "by_key": {k: dict(v) for k, v in kc["by_key"].items()},
+            }
+            if reset:
+                kc["requests"] = 0
+                kc["misses"] = 0
+                kc["by_key"] = {}
+        return out
+
+    # -- readout ---------------------------------------------------------
+    def snapshot(self, reset: bool = False) -> dict:
+        """One consistent read of everything, for /metrics and benches."""
+        with self._lock:
+            out = {
+                "enabled": self._enabled,
+                "counters": {n: c._value for n, c in sorted(self._counters.items())},
+                "gauges": {n: g._value for n, g in sorted(self._gauges.items())},
+                "kernel_cache": {
+                    "requests": self._kernel_cache["requests"],
+                    "misses": self._kernel_cache["misses"],
+                    "by_key": {
+                        k: dict(v) for k, v in self._kernel_cache["by_key"].items()
+                    },
+                },
+            }
+        # Histogram.summary takes the same lock; collect outside the hold.
+        out["histograms"] = {
+            n: h.summary() for n, h in sorted(self._histograms.items())
+        }
+        if reset:
+            self.reset()
+        return out
+
+    def reset(self) -> None:
+        """Zero all metrics (kernel cache included). Mainly for tests/benches."""
+        with self._lock:
+            for c in self._counters.values():
+                c._value = 0.0
+            for g in self._gauges.values():
+                g._value = 0.0
+            for h in self._histograms.values():
+                h._counts = [0] * (len(h.bounds) + 1)
+                h._count = 0
+                h._sum = 0.0
+                h._min = None
+                h._max = None
+            self._kernel_cache = {"requests": 0, "misses": 0, "by_key": {}}
+
+
+_REGISTRY = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    """The process-global registry."""
+    return _REGISTRY
+
+
+def metrics_enabled() -> bool:
+    return _REGISTRY.enabled
+
+
+def kernel_cache_event(key, hit: bool) -> None:
+    """Record one ``tpe.get_kernel`` lookup. ``key``: the cache-key tuple.
+
+    A miss means a fresh ``_TpeKernel`` was constructed — a new XLA
+    program will be traced and compiled — so ``misses`` is the
+    per-process compile-shape count (``benchmarks/atpe_profile.py``).
+    """
+    _REGISTRY.kernel_cache_event(key, hit)
+
+
+def kernel_cache_stats(reset: bool = False) -> dict:
+    """Snapshot (and optionally reset) the kernel-cache counters.
+
+    Returns ``{"requests": int, "misses": int, "by_key": {repr(key):
+    {"requests": int, "misses": int}}}`` — the same schema the counters
+    had in ``utils/tracing.py``; ``benchmarks/atpe_profile.py`` and the
+    ATPE tiering tests consume it unchanged.
+    """
+    return _REGISTRY.kernel_cache_stats(reset=reset)

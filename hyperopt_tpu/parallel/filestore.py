@@ -46,6 +46,8 @@ except ImportError:  # pragma: no cover
     _pickler = pickle
 
 from .. import base
+from ..obs import metrics as _metrics
+from ..obs.events import EVENTS
 from ..base import (
     JOB_STATE_DONE,
     JOB_STATE_ERROR,
@@ -280,6 +282,9 @@ class FileTrials(Trials):
                 fd = os.open(self._claim_path(doc["tid"]),
                              os.O_CREAT | os.O_EXCL | os.O_WRONLY)
             except FileExistsError:
+                # Another worker holds (or just won) this trial's claim:
+                # the contention signal for sizing worker fleets.
+                _metrics.registry().counter("store.claim.contended").inc()
                 continue
             with os.fdopen(fd, "w") as f:
                 f.write(owner)
@@ -288,6 +293,8 @@ class FileTrials(Trials):
             doc["book_time"] = coarse_utcnow()
             doc["refresh_time"] = doc["book_time"]
             self._write_doc(doc)
+            _metrics.registry().counter("store.claim.won").inc()
+            EVENTS.emit("store_claim", trial=doc["tid"], owner=owner)
             return doc
         return None
 
@@ -321,9 +328,13 @@ class FileTrials(Trials):
         if owner is not None and not self.owns(doc, owner):
             logger.warning("dropping result for tid %s: claim lost by %s",
                            doc["tid"], owner)
+            _metrics.registry().counter("store.write.fenced").inc()
             return False
         doc["refresh_time"] = coarse_utcnow()
         self._write_doc(doc)
+        _metrics.registry().counter("store.write.ok").inc()
+        EVENTS.emit("store_write", trial=doc["tid"],
+                    state=doc.get("state"))
         return True
 
     def requeue_stale(self, timeout: float) -> int:
@@ -359,6 +370,8 @@ class FileTrials(Trials):
                 except (FileNotFoundError, OSError):
                     pass
         if n:
+            _metrics.registry().counter("store.requeue_stale").inc(n)
+            EVENTS.emit("store_requeue", n=n)
             self.refresh()
         return n
 
@@ -454,30 +467,39 @@ class FileWorker:
 
     def run(self) -> int:
         """Serve jobs until idle past ``reserve_timeout``; returns #done."""
+        _reg = _metrics.registry()
+        _reg.counter("worker.up").inc()
+        EVENTS.emit("worker_up", name=self.owner)
         n_done = 0
         failures = 0
         idle_since = time.time()
-        while True:
-            try:
-                worked = self.run_one()
-            except Exception:
-                failures += 1
-                if failures >= self.max_consecutive_failures:
-                    logger.error("worker exiting after %d consecutive "
-                                 "failures", failures)
-                    return n_done
-                worked = True  # the queue wasn't empty
-            else:
+        try:
+            while True:
+                try:
+                    worked = self.run_one()
+                except Exception:
+                    failures += 1
+                    if failures >= self.max_consecutive_failures:
+                        logger.error("worker exiting after %d consecutive "
+                                     "failures", failures)
+                        return n_done
+                    worked = True  # the queue wasn't empty
+                else:
+                    if worked:
+                        failures = 0
+                        n_done += 1
+                        _reg.counter("worker.trials").inc()
                 if worked:
-                    failures = 0
-                    n_done += 1
-            if worked:
-                idle_since = time.time()
-            else:
-                if (self.reserve_timeout is not None
-                        and time.time() - idle_since > self.reserve_timeout):
-                    return n_done
-                time.sleep(self.poll_interval)
+                    idle_since = time.time()
+                else:
+                    if (self.reserve_timeout is not None
+                            and time.time() - idle_since
+                            > self.reserve_timeout):
+                        return n_done
+                    time.sleep(self.poll_interval)
+        finally:
+            _reg.counter("worker.down").inc()
+            EVENTS.emit("worker_down", name=self.owner, n_done=n_done)
 
 
 def main(argv=None):

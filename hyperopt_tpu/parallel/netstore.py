@@ -63,6 +63,7 @@ from urllib.request import Request, urlopen
 
 from .filestore import FileTrials, FileWorker, _pickler
 from ..base import Trials
+from ..obs import metrics as _metrics
 
 logger = logging.getLogger(__name__)
 
@@ -109,42 +110,62 @@ class StoreServer:
             def log_message(self, fmt, *args):   # quiet by default
                 logger.debug("netstore: " + fmt, *args)
 
-            def do_POST(self):
+            def _send_json(self, code, body: bytes):
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _authed(self) -> bool:
                 # Auth gate BEFORE the body is parsed or any verb runs:
                 # constant-time compare so the secret can't be recovered
                 # byte-by-byte from response timing.  The request body is
                 # still drained (keep-alive correctness) but never
                 # dispatched.
-                if server._token is not None:
-                    got = self.headers.get("X-Netstore-Token", "")
-                    if not hmac.compare_digest(got.encode(),
-                                               server._token.encode()):
-                        self.rfile.read(
-                            int(self.headers.get("Content-Length", "0")))
-                        body = json.dumps(
-                            {"error": "AuthError: missing or bad "
-                             "X-Netstore-Token"}).encode()
-                        self.send_response(401)
-                        self.send_header("Content-Type",
-                                         "application/json")
-                        self.send_header("Content-Length", str(len(body)))
-                        self.end_headers()
-                        self.wfile.write(body)
-                        return
+                if server._token is None:
+                    return True
+                got = self.headers.get("X-Netstore-Token", "")
+                if hmac.compare_digest(got.encode(),
+                                       server._token.encode()):
+                    return True
+                _metrics.registry().counter("netstore.auth.rejected").inc()
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", "0")))
+                self._send_json(401, json.dumps(
+                    {"error": "AuthError: missing or bad "
+                     "X-Netstore-Token"}).encode())
+                return False
+
+            def do_POST(self):
+                if not self._authed():
+                    return
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(n) or b"{}")
                     out = server._dispatch(req)
                     body = json.dumps(out).encode()
-                    self.send_response(200)
+                    code = 200
                 except Exception as e:  # surface server faults to the client
                     body = json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode()
-                    self.send_response(500)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                    code = 500
+                self._send_json(code, body)
+
+            def do_GET(self):
+                # Read-only metrics surface, token-gated like every verb:
+                # ``GET /metrics`` returns the process-global registry
+                # snapshot (counters/gauges/histograms/kernel_cache) so an
+                # operator can curl the server a driver and workers feed.
+                if not self._authed():
+                    return
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = json.dumps(
+                        _metrics.registry().snapshot()).encode()
+                    self._send_json(200, body)
+                    return
+                self._send_json(404, json.dumps(
+                    {"error": f"NotFound: {self.path}"}).encode())
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
@@ -179,6 +200,22 @@ class StoreServer:
 
     def _dispatch(self, req: dict) -> dict:
         verb = req["verb"]
+        reg = _metrics.registry()
+        t0 = time.perf_counter()
+        try:
+            return self._dispatch_verb(verb, req)
+        finally:
+            # Per-verb call count + latency histogram: the contention
+            # signal for the single-writer lock under many workers.
+            reg.counter(f"netstore.verb.{verb}.calls").inc()
+            reg.histogram(f"netstore.verb.{verb}.s").observe(
+                time.perf_counter() - t0)
+
+    def _dispatch_verb(self, verb: str, req: dict) -> dict:
+        if verb == "metrics":
+            # Registry snapshot (same payload as GET /metrics) so RPC
+            # clients (NetTrials.metrics) don't need a second transport.
+            return {"metrics": _metrics.registry().snapshot()}
         with self._lock:
             ft = self._store(req.get("exp_key", "default"))
             if verb == "docs":
@@ -350,6 +387,10 @@ class NetTrials(Trials):
 
     def requeue_stale(self, timeout: float) -> int:
         return self._rpc("requeue_stale", timeout=float(timeout))["n"]
+
+    def metrics(self) -> dict:
+        """Server-side metrics registry snapshot (``GET /metrics`` twin)."""
+        return self._rpc("metrics")["metrics"]
 
     # -- domain shipping -----------------------------------------------------
 

@@ -40,6 +40,8 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 
 from .. import base
+from ..obs import metrics as _metrics
+from ..obs.events import EVENTS
 from ..base import (
     JOB_STATE_DONE,
     JOB_STATE_ERROR,
@@ -200,6 +202,8 @@ class PoolTrials(Trials):
             if proc.is_alive():  # pragma: no cover — SIGTERM ignored
                 proc.kill()
                 proc.join(timeout=5.0)
+        _metrics.registry().counter("pool.cancelled").inc()
+        EVENTS.emit("trial_end", trial=tid, state="cancelled", reason=reason)
         return True
 
     def _on_deadline(self, doc):
@@ -210,6 +214,7 @@ class PoolTrials(Trials):
         if still_running:
             logger.warning("trial %s exceeded trial_timeout=%ss — cancelling",
                            tid, self.trial_timeout)
+            _metrics.registry().counter("pool.trials.timeout").inc()
             self._cancel_trial(
                 tid, f"exceeded trial_timeout={self.trial_timeout}s")
 
@@ -222,6 +227,7 @@ class PoolTrials(Trials):
         spuriously timed out while waiting for a worker."""
         if ev.is_set():  # cancelled while still queued
             return
+        EVENTS.emit("trial_start", trial=doc["tid"])
         timer = None
         if self.trial_timeout is not None:
             timer = threading.Timer(self.trial_timeout,
@@ -246,6 +252,12 @@ class PoolTrials(Trials):
             self._inflight.discard(doc["tid"])
             self._cancel_events.pop(doc["tid"], None)
             self._procs.pop(doc["tid"], None)
+        if not cancelled:
+            EVENTS.emit("trial_end", trial=doc["tid"],
+                        state="done" if state == JOB_STATE_DONE else "error")
+            _metrics.registry().counter(
+                "pool.trials.done" if state == JOB_STATE_DONE
+                else "pool.trials.error").inc()
         if not cancelled and attachments:
             ta = self.trial_attachments(doc)
             for k, v in attachments.items():
@@ -316,6 +328,7 @@ class PoolTrials(Trials):
                             and len(self._inflight) < self.parallelism:
                         doc["state"] = JOB_STATE_RUNNING
                         doc["book_time"] = coarse_utcnow()
+                        _metrics.registry().counter("pool.dispatched").inc()
                         self._inflight.add(doc["tid"])
                         ev = threading.Event()
                         self._cancel_events[doc["tid"]] = ev

@@ -5,7 +5,9 @@ SURVEY.md §2 L0): ``scope`` (expression namespace),
 ``stochastic.sample(space, rng)`` (draw one concrete configuration), and
 the graph-interpreter surface reference code uses for graph surgery —
 ``rec_eval`` (memoized lazy evaluator), ``dfs``/``toposort`` (node
-enumeration), ``clone`` (substituting copy), ``Literal``/``as_apply``.
+enumeration), ``clone`` (substituting copy), ``clone_merge``
+(common-subexpression-merging copy), ``use_obj_for_literal_in_memo``
+(sentinel-literal substitution), ``Literal``/``as_apply``.
 
 These operate on THIS framework's expression graph
 (:class:`hyperopt_tpu.space.Expr` trees: ``Param``/``Choice`` stochastic
@@ -264,6 +266,114 @@ def clone(expr, memo=None):
 
     _copies: dict = {}
     return rec(expr)
+
+
+def clone_merge(expr, memo=None, merge_literals=False):
+    """Clone with common-subexpression merging.
+
+    Reference: ``pyll/base.py::clone_merge`` — like :func:`clone`, but
+    structurally identical nodes in the copy collapse onto one shared
+    node (two ``scope.add(x, 1)`` applications of the same ``x`` become
+    one).  ``merge_literals`` additionally merges equal-valued
+    :class:`Literal` nodes (off by default, like the reference: literal
+    identity can be load-bearing for memo-based substitution).  ``memo``
+    pre-seeds node replacements exactly as in :func:`clone`.
+    """
+    memo = dict(memo or {})
+    _copies: dict = {}
+    _table: dict = {}
+
+    def ckey(c):
+        # Children are merged before parents, so structural equality of
+        # Expr children has become object identity by the time a parent's
+        # key is computed; plain values compare by value when hashable.
+        if isinstance(c, Expr):
+            return ("n", id(c))
+        try:
+            hash(c)
+        except TypeError:
+            return ("u", id(c))
+        return ("v", type(c).__name__, c)
+
+    def skey(new):
+        if isinstance(new, Literal):
+            if not merge_literals:
+                return None
+            try:
+                hash(new.obj)
+            except TypeError:
+                return None
+            return ("lit", type(new.obj).__name__, new.obj)
+        if isinstance(new, Param):
+            probs = None if new.probs is None else tuple(map(float,
+                                                             new.probs))
+            return ("param", new.label, new.kind, new.low, new.high,
+                    new.mu, new.sigma, new.q, probs)
+        if isinstance(new, Choice):
+            probs = None if new.probs is None else tuple(map(float,
+                                                             new.probs))
+            return ("choice", new.label,
+                    tuple(ckey(o) for o in new.options), probs)
+        if isinstance(new, Apply):
+            return ("apply", new.op, tuple(ckey(a) for a in new.args))
+        return None
+
+    def rec(node):
+        if isinstance(node, Expr):
+            if id(node) in _copies:
+                return _copies[id(node)]
+            if memo:
+                try:
+                    if node in memo:
+                        return memo[node]
+                except TypeError:
+                    pass
+            if isinstance(node, Literal):
+                new = Literal(node.obj)
+            elif isinstance(node, Param):
+                new = Param(node.label, node.kind, low=node.low,
+                            high=node.high, mu=node.mu, sigma=node.sigma,
+                            q=node.q, probs=node.probs)
+            elif isinstance(node, Choice):
+                new = Choice(node.label, [rec(o) for o in node.options],
+                             probs=node.probs)
+            elif isinstance(node, Apply):
+                new = Apply(node.op, tuple(rec(a) for a in node.args))
+            else:       # pragma: no cover - future Expr subclasses
+                raise TypeError(
+                    f"clone_merge: unknown node type {type(node)!r}")
+            k = skey(new)
+            if k is not None:
+                new = _table.setdefault(k, new)
+            _copies[id(node)] = new
+            return new
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(rec(v) for v in node)
+        return node
+
+    return rec(expr)
+
+
+def use_obj_for_literal_in_memo(expr, obj, lit, memo):
+    """Set ``memo[node] = obj`` for every ``Literal`` equal to ``lit``.
+
+    Reference: ``pyll/base.py::use_obj_for_literal_in_memo`` — the idiom
+    behind ``fmin_pass_expr_memo_ctrl`` objectives: plant a sentinel
+    literal in the space, then substitute the live object (e.g. a
+    ``Ctrl``) at evaluation time.  Existing memo entries are preserved;
+    the (mutated) memo is returned for chaining.
+    """
+    for node in dfs(expr):
+        if isinstance(node, Literal):
+            try:
+                match = node.obj == lit
+            except Exception:
+                match = False
+            if match and node not in memo:
+                memo[node] = obj
+    return memo
 
 
 class stochastic:

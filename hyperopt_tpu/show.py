@@ -7,14 +7,17 @@ Usage::
 
     python -m hyperopt_tpu.show --root /shared/exp --exp-key e1
     python -m hyperopt_tpu.show --pickle trials.pkl [--plot history.png]
+    python -m hyperopt_tpu.show trace /tmp/trace   # per-phase span table
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import os
 import pickle
 import sys
-from collections import Counter
+from collections import Counter, defaultdict
 
 from .base import (
     JOB_STATE_CANCEL,
@@ -63,7 +66,76 @@ def summarize(trials: Trials, out=None) -> None:
         print(f"attachments: {n_att}", file=out)
 
 
+def summarize_trace(trace_dir: str, out=None) -> None:
+    """Render a trace directory (``fmin(..., trace_dir=...)``) as a
+    per-phase summary table — the table the bench scripts used to
+    hand-roll.  Prefers the aggregated ``loop_trace.json``; falls back to
+    re-deriving span totals from ``loop_events.jsonl``."""
+    out = out if out is not None else sys.stdout
+    summary_path = os.path.join(trace_dir, "loop_trace.json")
+    events_path = os.path.join(trace_dir, "loop_events.jsonl")
+    wall = None
+    phases = {}
+    if os.path.exists(summary_path):
+        with open(summary_path) as f:
+            doc = json.load(f)
+        wall = doc.pop("_wall", None)
+        phases = {k: v for k, v in doc.items() if isinstance(v, dict)
+                  and "total_s" in v}
+    elif os.path.exists(events_path):
+        begins, totals, counts = {}, defaultdict(float), defaultdict(int)
+        with open(events_path) as f:
+            for line in f:
+                rec = json.loads(line)
+                if rec["type"] == "span_begin":
+                    begins[rec.get("span")] = rec
+                elif rec["type"] == "span_end":
+                    b = begins.pop(rec.get("span"), None)
+                    if b is not None:
+                        totals[b["name"]] += rec["t_mono"] - b["t_mono"]
+                        counts[b["name"]] += 1
+        phases = {n: {"total_s": t, "count": counts[n],
+                      "mean_ms": 1e3 * t / max(counts[n], 1)}
+                  for n, t in totals.items()}
+    else:
+        print(f"no loop_trace.json or loop_events.jsonl in {trace_dir}",
+              file=out)
+        return
+    wall_s = wall["wall_s"] if wall else sum(
+        v["total_s"] for v in phases.values()) or 1.0
+    print(f"{'phase':<14s} {'total_s':>10s} {'count':>7s} "
+          f"{'mean_ms':>9s} {'% wall':>7s}", file=out)
+    for name, rec in sorted(phases.items(),
+                            key=lambda kv: -kv[1]["total_s"]):
+        print(f"{name:<14s} {rec['total_s']:>10.4f} {rec['count']:>7d} "
+              f"{rec['mean_ms']:>9.3f} "
+              f"{100.0 * rec['total_s'] / max(wall_s, 1e-12):>6.1f}%",
+              file=out)
+    if wall:
+        print(f"wall {wall['wall_s']:.4f}s, attributed "
+              f"{wall['attributed_s']:.4f}s "
+              f"({100.0 * wall['coverage']:.1f}% coverage)", file=out)
+    if os.path.exists(events_path):
+        n_events = sum(1 for _ in open(events_path))
+        print(f"events: {n_events} in loop_events.jsonl", file=out)
+    chrome = os.path.join(trace_dir, "chrome_trace.json")
+    if os.path.exists(chrome):
+        print(f"chrome trace: {chrome} (load in Perfetto / "
+              f"chrome://tracing)", file=out)
+
+
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "trace":
+        # Subcommand form (`hyperopt-tpu-show trace <dir>`); the flag-based
+        # trials inspection below keeps its historical interface.
+        tp = argparse.ArgumentParser(prog="hyperopt-tpu-show trace",
+                                     description="summarize a trace dir")
+        tp.add_argument("trace_dir", help="fmin(..., trace_dir=...) output")
+        targs = tp.parse_args(argv[1:])
+        summarize_trace(targs.trace_dir)
+        return 0
+
     p = argparse.ArgumentParser(description="inspect a hyperopt_tpu "
                                             "experiment")
     src = p.add_mutually_exclusive_group(required=True)

@@ -57,7 +57,7 @@ from .ops import (
     gmm_sample,
 )
 from .ops.gmm import onehot_lookup
-from .utils.tracing import kernel_cache_event
+from .obs import kernel_cache_event
 from .space import (
     CATEGORICAL,
     LOGNORMAL,

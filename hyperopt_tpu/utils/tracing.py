@@ -1,149 +1,16 @@
-"""Tracing / profiling instrumentation for the optimization loop.
+"""Back-compat shim: tracing now lives in :mod:`hyperopt_tpu.obs`.
 
-The reference has no tracing subsystem (SURVEY.md §5.1 — closest: verbose
-logging + tqdm postfix).  The TPU build adds the recommended equivalent:
-wall-clock spans around the loop phases (suggest / evaluate / store) plus
-optional XLA device traces via ``jax.profiler`` for TensorBoard.
-
-Enable with ``fmin(..., trace_dir="/tmp/trace")`` or the
-``HYPEROPT_TPU_TRACE_DIR`` environment variable.  The span summary is
-written to ``<trace_dir>/loop_trace.json``; device traces (if jax.profiler
-is usable) land in the same directory.
-
-Also home to the process-global TPE kernel-cache counters
-(:func:`kernel_cache_event` / :func:`kernel_cache_stats`) — compile-shape
-accounting for ``tpe.get_kernel``, consumed by ``benchmarks/atpe_profile.py``.
+Round 6 grew this module into the ``hyperopt_tpu/obs/`` subsystem
+(structured event log + metrics registry + Tracer).  The four public
+names that lived here — :class:`Tracer`, :class:`NullTracer`,
+:func:`kernel_cache_event`, :func:`kernel_cache_stats` — are re-exported
+unchanged so existing imports keep working; new code should import from
+``hyperopt_tpu.obs`` directly.
 """
 
 from __future__ import annotations
 
-import json
-import os
-import threading
-import time
-from collections import defaultdict
-from contextlib import contextmanager
-from typing import Optional
+from ..obs.metrics import kernel_cache_event, kernel_cache_stats  # noqa: F401
+from ..obs.trace import NullTracer, Tracer  # noqa: F401
 
-# -- kernel-cache statistics -------------------------------------------------
-#
-# Process-global request/miss counters for the TPE kernel cache
-# (``tpe.get_kernel``).  A miss means a fresh ``_TpeKernel`` was
-# constructed — i.e. a new XLA program will be traced and compiled — so
-# ``misses`` is the per-process compile-shape count the ATPE arm
-# canonicalization work optimizes (``benchmarks/atpe_profile.py`` reads
-# these before/after to show arms collapsing onto shared shapes).
-# Always on: two dict increments under a lock per suggest are noise next
-# to a single device dispatch.
-
-_CACHE_LOCK = threading.Lock()
-_CACHE_STATS: dict = {"requests": 0, "misses": 0, "by_key": {}}
-
-
-def kernel_cache_event(key, hit: bool) -> None:
-    """Record one ``get_kernel`` lookup. ``key``: the cache-key tuple."""
-    ks = repr(key)
-    with _CACHE_LOCK:
-        _CACHE_STATS["requests"] += 1
-        per = _CACHE_STATS["by_key"].setdefault(
-            ks, {"requests": 0, "misses": 0})
-        per["requests"] += 1
-        if not hit:
-            _CACHE_STATS["misses"] += 1
-            per["misses"] += 1
-
-
-def kernel_cache_stats(reset: bool = False) -> dict:
-    """Snapshot (and optionally reset) the process-global cache counters.
-
-    Returns ``{"requests": int, "misses": int, "by_key": {repr(key):
-    {"requests": int, "misses": int}}}``.  ``misses`` counts distinct
-    kernel constructions (compile shapes); ``by_key`` lets callers
-    attribute them — e.g. ``benchmarks/atpe_profile.py`` diffing arm
-    shapes with tiering on vs off.
-    """
-    with _CACHE_LOCK:
-        out = {"requests": _CACHE_STATS["requests"],
-               "misses": _CACHE_STATS["misses"],
-               "by_key": {k: dict(v)
-                          for k, v in _CACHE_STATS["by_key"].items()}}
-        if reset:
-            _CACHE_STATS["requests"] = 0
-            _CACHE_STATS["misses"] = 0
-            _CACHE_STATS["by_key"] = {}
-    return out
-
-
-class Tracer:
-    """Accumulates named wall-clock spans; optionally drives jax.profiler."""
-
-    def __init__(self, trace_dir: Optional[str] = None,
-                 device_trace: bool = False):
-        self.trace_dir = trace_dir
-        self.device_trace = device_trace and trace_dir is not None
-        self.totals = defaultdict(float)
-        self.counts = defaultdict(int)
-        self._started = False
-        if trace_dir:
-            os.makedirs(trace_dir, exist_ok=True)
-
-    # -- spans ---------------------------------------------------------------
-
-    @contextmanager
-    def span(self, name: str):
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            dt = time.perf_counter() - t0
-            self.totals[name] += dt
-            self.counts[name] += 1
-
-    # -- device traces -------------------------------------------------------
-
-    def start_device_trace(self):
-        if not self.device_trace or self._started:
-            return
-        try:
-            import jax
-
-            jax.profiler.start_trace(self.trace_dir)
-            self._started = True
-        except Exception:  # profiler unavailable on this backend
-            self.device_trace = False
-
-    def stop_device_trace(self):
-        if not self._started:
-            return
-        try:
-            import jax
-
-            jax.profiler.stop_trace()
-        except Exception:
-            pass
-        self._started = False
-
-    # -- summary -------------------------------------------------------------
-
-    def summary(self) -> dict:
-        out = {}
-        for name, total in sorted(self.totals.items()):
-            n = self.counts[name]
-            out[name] = {"total_s": round(total, 6), "count": n,
-                         "mean_ms": round(1e3 * total / max(n, 1), 3)}
-        return out
-
-    def dump(self) -> Optional[str]:
-        if not self.trace_dir:
-            return None
-        path = os.path.join(self.trace_dir, "loop_trace.json")
-        with open(path, "w") as f:
-            json.dump(self.summary(), f, indent=2)
-        return path
-
-
-class NullTracer(Tracer):
-    """No-op tracer (no dir, no device traces); spans still cost ~0."""
-
-    def __init__(self):
-        super().__init__(trace_dir=None, device_trace=False)
+__all__ = ["Tracer", "NullTracer", "kernel_cache_event", "kernel_cache_stats"]

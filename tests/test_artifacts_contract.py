@@ -1,0 +1,142 @@
+"""CI guard over the repo's measurement artifacts and timing budgets.
+
+Two contracts, both cheap enough for the quick loop:
+
+1. Every ``benchmarks/*.json`` artifact parses and is attributable —
+   it must say *where* it was measured (a ``backend`` key) and *when*
+   (a ``timestamp``/``updated`` key, a date-stamped filename, or a
+   ``provenance`` block).  Artifacts written before r6 standardized the
+   header are pinned in an explicit grandfather list: that list may only
+   shrink — new artifacts must carry the full header (the benches all
+   write ``metric`` + ``backend`` + a date signal now).
+
+2. The per-file timing budgets stay inside the 240s ceiling and the r5
+   tier split stays split: the slow TPE ladders live in
+   ``test_tpe_longrun.py`` (slow-marked, excluded from the quick loop),
+   so no quick-loop file may budget past 240s.
+"""
+
+import glob
+import json
+import os
+import re
+
+import pytest
+
+_BENCH_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "benchmarks")
+_TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+#: Artifacts written before the r6 header convention (metric/backend/
+#: timestamp).  Frozen: files may leave this set (regenerated with the
+#: full header) but never join it — a new artifact missing its header
+#: fails the guard instead of growing the exemption.
+_LEGACY_ARTIFACTS = frozenset({
+    "bench_tpu_20260729.json",          # provenance-block era
+    "quality_ab_tpe_vs_tpe_cat_const.json",
+    "quality_ab_tpe_vs_tpe_mv_vs_atpe.json",
+    "quality_ab_tpe_vs_tpe_mv_vs_atpe_b0p5.json",
+    "quality_ab_tpe_vs_tpe_q8.json",
+    "quality_ab_tpe_vs_tpe_q8_vs_tpe_q32.json",
+    "quality_gumbel_pre_icdf.json",
+    "quality_latest.json",
+    "results_latest.json",
+    "transfer_ab_cross.json",
+    "transfer_ab_latest.json",
+})
+
+_DATE_STAMP = re.compile(r"_20\d{6}")     # _YYYYMMDD in the filename
+
+
+def _artifacts():
+    return sorted(glob.glob(os.path.join(_BENCH_DIR, "*.json")))
+
+
+class TestBenchmarkArtifacts:
+    def test_artifacts_exist(self):
+        assert _artifacts(), "benchmarks/ lost all of its artifacts"
+
+    @pytest.mark.parametrize("path", _artifacts(),
+                             ids=[os.path.basename(p) for p in _artifacts()])
+    def test_artifact_parses_and_is_attributable(self, path):
+        name = os.path.basename(path)
+        with open(path) as fh:
+            doc = json.load(fh)          # must parse at all
+        assert isinstance(doc, dict), f"{name}: top level must be an object"
+
+        if name in _LEGACY_ARTIFACTS:
+            # pre-header era: still must be structurally sane
+            if "rows" in doc:
+                assert isinstance(doc["rows"], list) and doc["rows"]
+                assert all(isinstance(r, dict) for r in doc["rows"])
+            elif "records" in doc:
+                assert isinstance(doc["records"], list)
+                assert "updated" in doc   # results_latest carries its stamp
+            else:
+                assert "provenance" in doc
+            return
+
+        # r6 convention: where + when, and a metric name for aggregators
+        assert "backend" in doc, f"{name}: missing 'backend'"
+        assert doc["backend"] in ("cpu", "tpu", "gpu"), \
+            f"{name}: unknown backend {doc['backend']!r}"
+        has_when = ("timestamp" in doc or "updated" in doc
+                    or "provenance" in doc
+                    or _DATE_STAMP.search(name) is not None)
+        assert has_when, f"{name}: no timestamp key or date-stamped filename"
+        assert "metric" in doc, f"{name}: missing 'metric'"
+
+    def test_grandfather_list_only_shrinks(self):
+        # every grandfathered name that still exists must really be a
+        # legacy artifact (no header); regenerated files must leave the
+        # list rather than mask a regression
+        present = {os.path.basename(p) for p in _artifacts()}
+        for name in _LEGACY_ARTIFACTS & present:
+            with open(os.path.join(_BENCH_DIR, name)) as fh:
+                doc = json.load(fh)
+            assert not ("backend" in doc and "metric" in doc), (
+                f"{name} now carries the full header — remove it from "
+                "_LEGACY_ARTIFACTS")
+
+    def test_device_ab_artifact_matches_its_bench(self):
+        # the r6 device A/B (5 domains x 20 seeds, one conditional space)
+        path = os.path.join(_BENCH_DIR, "quality_ab_fmin_vs_fmin_device.json")
+        with open(path) as fh:
+            doc = json.load(fh)
+        assert doc["metric"] == "quality_ab_fmin_vs_fmin_device"
+        assert len(doc["seeds"]) >= 20
+        domains = [r["domain"] for r in doc["rows"]]
+        assert len(domains) >= 5
+        assert "gauss_wave2" in domains   # the conditional (activity-mask) one
+        for r in doc["rows"]:
+            assert len(r["host"]) == len(doc["seeds"])
+            assert len(r["device"]) == len(doc["seeds"])
+
+
+class TestTimingBudgets:
+    def test_no_quick_loop_file_budgets_past_240s(self):
+        import conftest
+
+        for fname, budget in conftest._FILE_BUDGET_S.items():
+            assert budget <= 240.0, (
+                f"{fname} budgets {budget}s — past the 240s ceiling; "
+                "move its heavy cases behind @pytest.mark.slow instead")
+
+    def test_r5_tier_split_is_pinned(self):
+        # the slow TPE ladders stay in their own slow-marked file; the
+        # quick file keeps the 240s budget it was split down to
+        longrun = os.path.join(_TESTS_DIR, "test_tpe_longrun.py")
+        assert os.path.exists(longrun), \
+            "test_tpe_longrun.py gone — the r5 tier split was undone"
+        src = open(longrun).read()
+        assert "@pytest.mark.slow" in src
+        # every test class in the longrun file is slow-marked
+        classes = re.findall(r"^(@pytest\.mark\.slow\n)?class (Test\w+)",
+                             src, flags=re.M)
+        assert classes, "no test classes found in test_tpe_longrun.py"
+        for marked, cname in classes:
+            assert marked, f"{cname} in test_tpe_longrun.py lost its " \
+                           "slow marker"
+        import conftest
+
+        assert conftest._FILE_BUDGET_S.get("test_tpe.py") == 240.0

@@ -1,0 +1,417 @@
+"""Observability subsystem (hyperopt_tpu/obs/): structured event log,
+metrics registry, tracer thread-safety, netstore /metrics surfacing, and
+Chrome trace_event export.
+
+The four areas ISSUE r6 pins: event ordering / span nesting under a
+two-thread overlap, the NullTracer / disabled-registry overhead bound,
+``/metrics`` auth rejection, and the Chrome-trace schema round-trip.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import hyperopt_tpu as ho
+from hyperopt_tpu import hp
+from hyperopt_tpu.obs import NullTracer, Tracer
+from hyperopt_tpu.obs.events import EVENT_TYPES, EventLog
+from hyperopt_tpu.obs.metrics import MetricsRegistry
+
+
+# ---------------------------------------------------------------------------
+# event log
+# ---------------------------------------------------------------------------
+
+
+class TestEventLog:
+    def test_disabled_log_records_nothing(self):
+        log = EventLog(capacity=16)
+        assert not log.enabled
+        assert log.emit("trial_start", trial=0) is None
+        with log.span("s"):
+            pass
+        assert len(log) == 0 and log.n_emitted == 0
+
+    def test_ring_buffer_keeps_most_recent(self):
+        log = EventLog(capacity=8)
+        log.enable()
+        for i in range(20):
+            log.emit("suggest", n=i)
+        assert len(log) == 8
+        assert log.n_emitted == 20
+        assert [e["n"] for e in log.snapshot()] == list(range(12, 20))
+
+    def test_wall_derived_from_mono_anchor(self):
+        # t_wall is wall0 + (t_mono - mono0): the two clocks must agree
+        # on every inter-event gap exactly.
+        log = EventLog(capacity=16)
+        log.enable()
+        a = log.emit("trial_start", trial=0)
+        time.sleep(0.01)
+        b = log.emit("trial_end", trial=0)
+        # epoch-magnitude doubles carry ~2e-7 s of quantization; the
+        # anchor identity holds to well under a microsecond
+        assert (b["t_wall"] - a["t_wall"]) == pytest.approx(
+            b["t_mono"] - a["t_mono"], abs=1e-6)
+
+    def test_core_event_vocabulary_is_pinned(self):
+        for t in ("trial_start", "trial_end", "suggest", "compile",
+                  "store_claim", "store_write", "store_flush",
+                  "worker_up", "worker_down", "transfer_borrow",
+                  "transfer_drop", "span_begin", "span_end"):
+            assert t in EVENT_TYPES
+
+    def test_span_nesting_and_ordering_two_threads(self):
+        """Two threads run nested spans concurrently: each thread's
+        event sequence must stay correctly ordered and parent-linked,
+        with no cross-thread bleed of the span stack (it is
+        thread-local) and globally unique span ids."""
+        log = EventLog(capacity=1024)
+        log.enable()
+        barrier = threading.Barrier(2)
+
+        def work(tid):
+            barrier.wait()
+            for k in range(25):
+                with log.span("outer", trial=tid):
+                    log.emit("trial_start", trial=tid)
+                    with log.span("inner", trial=tid):
+                        log.emit("suggest", trial=tid)
+                    log.emit("trial_end", trial=tid)
+
+        threads = [threading.Thread(target=work, args=(i,),
+                                    name=f"obs-w{i}") for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        events = log.snapshot()
+        assert len(events) == 2 * 25 * 7
+        # span ids are globally unique
+        begins = [e for e in events if e["type"] == "span_begin"]
+        assert len({e["span"] for e in begins}) == len(begins)
+        for tname in ("obs-w0", "obs-w1"):
+            seq = sorted((e for e in events if e["thread"] == tname),
+                         key=lambda e: e["t_mono"])
+            assert [e["type"] for e in seq] == [
+                "span_begin", "trial_start", "span_begin", "suggest",
+                "span_end", "trial_end", "span_end"] * 25
+            for j in range(0, len(seq), 7):
+                (ob, ts, ib, sg, ie, te, oe) = seq[j:j + 7]
+                # inner span parents onto outer; point events attach to
+                # the innermost enclosing span at emit time
+                assert ib["parent"] == ob["span"]
+                assert oe["span"] == ob["span"] and oe["parent"] is None
+                assert ie["span"] == ib["span"]
+                assert ts["span"] == ob["span"]
+                assert sg["span"] == ib["span"]
+                assert te["span"] == ob["span"]
+
+
+# ---------------------------------------------------------------------------
+# chrome trace export
+# ---------------------------------------------------------------------------
+
+
+class TestChromeTrace:
+    def _populated_log(self):
+        log = EventLog(capacity=256)
+        log.enable()
+        with log.span("suggest", trial=0):
+            log.emit("compile", name="tpe_kernel", key="(k,)")
+        with log.span("evaluate", trial=0):
+            time.sleep(0.002)
+        log.emit("store_flush", name="json")
+        return log
+
+    def test_schema_round_trip(self, tmp_path):
+        log = self._populated_log()
+        path = tmp_path / "chrome_trace.json"
+        n = log.export_chrome_trace(path)
+        doc = json.loads(path.read_text())
+        assert set(doc) == {"traceEvents", "displayTimeUnit"}
+        assert doc["displayTimeUnit"] == "ms"
+        evs = doc["traceEvents"]
+        assert len(evs) == n
+        for e in evs:
+            assert {"name", "ph", "ts", "pid", "tid", "cat"} <= set(e)
+            assert e["ph"] in ("X", "i")
+            if e["ph"] == "X":
+                assert e["dur"] >= 0.0
+            else:
+                assert e["s"] == "t"
+        # sorted by timestamp, as chrome://tracing prefers
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts)
+        # both spans became complete events; the sleep span has real dur
+        spans = {e["name"]: e for e in evs if e["ph"] == "X"}
+        assert set(spans) == {"suggest", "evaluate"}
+        assert spans["evaluate"]["dur"] >= 1e3  # >= 1ms in microseconds
+        # point events kept their type in the category
+        cats = {e["cat"] for e in evs if e["ph"] == "i"}
+        assert "hyperopt_tpu:compile" in cats
+        assert "hyperopt_tpu:store_flush" in cats
+
+    def test_unmatched_spans_stay_loadable(self):
+        log = self._populated_log()
+        events = log.snapshot()
+        # Drop the first span_begin: its span_end is skipped, not an error.
+        first_begin = next(e for e in events if e["type"] == "span_begin")
+        truncated = [e for e in events if e is not first_begin]
+        doc = log.to_chrome_trace(truncated)
+        names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert names == ["evaluate"]
+        # Drop the last span_end: the open span becomes a zero-length mark.
+        last_end = [e for e in events if e["type"] == "span_end"][-1]
+        doc2 = log.to_chrome_trace([e for e in events if e is not last_end])
+        cats = {e["cat"] for e in doc2["traceEvents"]}
+        assert "hyperopt_tpu:span_open" in cats
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_span_totals_survive_two_thread_overlap(self):
+        """The r5 Tracer kept unlocked defaultdicts, so concurrent spans
+        (overlap_suggest runs suggest on a worker thread) could lose
+        increments.  Counts must now be exact under contention."""
+        tracer = Tracer(trace_dir=None, events=EventLog(capacity=1))
+        n_threads, n_spans = 4, 300
+        barrier = threading.Barrier(n_threads)
+
+        def work():
+            barrier.wait()
+            for _ in range(n_spans):
+                with tracer.span("work"):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert tracer.counts["work"] == n_threads * n_spans
+        assert tracer.totals["work"] > 0.0
+
+    def test_nested_spans_attribute_only_top_level(self):
+        log = EventLog(capacity=64)
+        tracer = Tracer(trace_dir=None, events=log)
+        with tracer.span("outer"):
+            with tracer.span("inner"):
+                time.sleep(0.002)
+        tracer.set_wall(tracer.totals["outer"])
+        att = tracer.attribution()
+        # inner is excluded from the numerator (no double counting)
+        assert att["attributed_s"] == pytest.approx(
+            tracer.totals["outer"], abs=1e-5)
+        assert att["coverage"] == pytest.approx(1.0, abs=0.01)
+
+    def test_dump_writes_all_three_artifacts_and_disarms(self, tmp_path):
+        log = EventLog(capacity=256)
+        d = tmp_path / "trace"
+        tracer = Tracer(str(d), events=log)
+        assert log.enabled  # armed by construction
+        with tracer.span("suggest", trial=0):
+            pass
+        with tracer.span("evaluate", trial=0):
+            pass
+        tracer.dump()
+        summary = json.loads((d / "loop_trace.json").read_text())
+        assert {"suggest", "evaluate", "_wall"} <= set(summary)
+        assert {"wall_s", "attributed_s", "coverage"} == set(summary["_wall"])
+        lines = (d / "loop_events.jsonl").read_text().splitlines()
+        assert all(json.loads(ln)["type"] for ln in lines)
+        chrome = json.loads((d / "chrome_trace.json").read_text())
+        assert chrome["traceEvents"]
+        assert not log.enabled  # disarmed + cleared after dump
+        assert len(log) == 0
+
+    def test_null_tracer_span_is_shared_noop(self):
+        nt = NullTracer()
+        s1, s2 = nt.span("a"), nt.span("b", trial=3)
+        assert s1 is s2  # one preallocated context manager
+        with s1:
+            pass
+        assert nt.totals == {} and nt.dump() is None
+
+    def test_disabled_path_overhead_bound(self):
+        """NullTracer spans and disabled-registry updates must stay in
+        the no-clock/no-lock regime: bound the mean cost far below a
+        microsecond-scale budget (generous vs the <1% trials_per_sec
+        acceptance bench, which runs ~ms-scale trials)."""
+        nt = NullTracer()
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with nt.span("x"):
+                pass
+        span_cost = (time.perf_counter() - t0) / n
+        reg = MetricsRegistry(enabled=False)
+        c = reg.counter("c")
+        h = reg.histogram("h")
+        t0 = time.perf_counter()
+        for _ in range(n):
+            c.inc()
+            h.observe(0.5)
+        metric_cost = (time.perf_counter() - t0) / n
+        assert span_cost < 5e-6
+        assert metric_cost < 5e-6
+        assert c.value == 0.0 and h.summary() == {"count": 0}
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsRegistry:
+    def test_counters_gauges_histograms_snapshot(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("fmin.trials.done").inc()
+        reg.counter("fmin.trials.done").inc(2)
+        reg.gauge("fmin.trials_per_sec").set(41.5)
+        h = reg.histogram("netstore.verb.reserve.s")
+        for v in (0.001, 0.002, 0.004):
+            h.observe(v)
+        snap = reg.snapshot()
+        assert snap["enabled"] is True
+        assert snap["counters"]["fmin.trials.done"] == 3.0
+        assert snap["gauges"]["fmin.trials_per_sec"] == 41.5
+        hs = snap["histograms"]["netstore.verb.reserve.s"]
+        assert hs["count"] == 3
+        assert hs["sum"] == pytest.approx(0.007)
+        assert hs["min"] == 0.001 and hs["max"] == 0.004
+        assert hs["p50"] >= 0.001
+        # get-or-create returns the same instance
+        assert reg.counter("fmin.trials.done") is reg.counter(
+            "fmin.trials.done")
+        reg.reset()
+        assert reg.snapshot()["counters"]["fmin.trials.done"] == 0.0
+
+    def test_kernel_cache_always_on_even_when_disabled(self):
+        """Compile-shape accounting is a correctness contract
+        (benchmarks/atpe_profile.py), not telemetry: it must count even
+        with HYPEROPT_TPU_METRICS=0 semantics, preserving the legacy
+        utils/tracing.py schema exactly."""
+        reg = MetricsRegistry(enabled=False)
+        reg.counter("ignored").inc()
+        key = ("u", 3, True)
+        reg.kernel_cache_event(key, hit=False)
+        reg.kernel_cache_event(key, hit=True)
+        stats = reg.kernel_cache_stats()
+        assert stats == {"requests": 2, "misses": 1,
+                         "by_key": {repr(key): {"requests": 2,
+                                                "misses": 1}}}
+        assert reg.snapshot()["counters"]["ignored"] == 0.0
+        # reset=True drains
+        reg.kernel_cache_stats(reset=True)
+        assert reg.kernel_cache_stats()["requests"] == 0
+
+    def test_shim_import_path_still_works(self):
+        # utils/tracing.py is kept as a re-export shim for old imports.
+        from hyperopt_tpu.utils.tracing import (kernel_cache_event,
+                                                kernel_cache_stats)
+        from hyperopt_tpu.obs import metrics as m
+
+        assert kernel_cache_event is m.kernel_cache_event
+        assert kernel_cache_stats is m.kernel_cache_stats
+
+
+# ---------------------------------------------------------------------------
+# netstore /metrics surfacing
+# ---------------------------------------------------------------------------
+
+
+class TestMetricsEndpoint:
+    def test_metrics_get_requires_token(self, tmp_path, monkeypatch):
+        """GET /metrics is gated by the same X-Netstore-Token as the
+        POST verbs: missing/wrong tokens get 401 before any dispatch,
+        the right token gets the registry snapshot, other paths 404."""
+        from urllib.error import HTTPError
+        from urllib.request import Request, urlopen
+
+        from hyperopt_tpu.parallel import NetTrials
+        from hyperopt_tpu.parallel.netstore import StoreServer
+
+        monkeypatch.delenv("HYPEROPT_TPU_NETSTORE_TOKEN", raising=False)
+        srv = StoreServer(str(tmp_path / "store"), token="s3kr1t")
+        srv.start()
+        try:
+            def get(path, token=None):
+                headers = {"X-Netstore-Token": token} if token else {}
+                with urlopen(Request(srv.url + path, headers=headers),
+                             timeout=10.0) as resp:
+                    return json.loads(resp.read())
+
+            for bad in ({}, {"token": "wrong"}):
+                with pytest.raises(HTTPError) as ei:
+                    get("/metrics", **bad)
+                assert ei.value.code == 401
+            snap = get("/metrics", token="s3kr1t")
+            assert {"enabled", "counters", "gauges",
+                    "kernel_cache", "histograms"} <= set(snap)
+            with pytest.raises(HTTPError) as ei:
+                get("/not-metrics", token="s3kr1t")
+            assert ei.value.code == 404
+
+            # the RPC verb mirror: a tokened client reads the same snapshot
+            nt = NetTrials(srv.url, exp_key="e1", token="s3kr1t",
+                           refresh=False)
+            via_rpc = nt.metrics()
+            assert "kernel_cache" in via_rpc
+            with pytest.raises(RuntimeError, match="AuthError"):
+                NetTrials(srv.url, exp_key="e1", refresh=False).metrics()
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: fmin(trace_dir=...) artifacts
+# ---------------------------------------------------------------------------
+
+
+class TestFminTraceDir:
+    def test_fmin_emits_trace_artifacts(self, tmp_path):
+        d = tmp_path / "trace"
+        t = ho.Trials()
+
+        def obj(p):
+            # Real objectives do work; without it (warm kernel caches,
+            # trivial loss) the whole loop is ~15 ms and the fixed
+            # µs-scale inter-span bookkeeping dominates the coverage
+            # denominator, which is not what attribution measures.
+            time.sleep(0.01)
+            return (p["x"] - 1.0) ** 2
+
+        ho.fmin(obj, {"x": hp.uniform("x", -5, 5)},
+                algo=ho.tpe.suggest, max_evals=8, trials=t,
+                rstate=np.random.default_rng(0), show_progressbar=False,
+                trace_dir=str(d))
+        summary = json.loads((d / "loop_trace.json").read_text())
+        # every trial passed through the core phases
+        for phase in ("suggest", "evaluate"):
+            assert summary[phase]["count"] == 8
+        wall = summary["_wall"]
+        assert 0.0 < wall["attributed_s"] <= wall["wall_s"] * 1.001
+        assert wall["coverage"] >= 0.95
+        lines = [json.loads(ln) for ln in
+                 (d / "loop_events.jsonl").read_text().splitlines()]
+        types = {e["type"] for e in lines}
+        assert {"trial_start", "trial_end", "span_begin",
+                "span_end"} <= types
+        assert sum(e["type"] == "trial_end" for e in lines) == 8
+        chrome = json.loads((d / "chrome_trace.json").read_text())
+        assert any(e["ph"] == "X" and e["name"] == "evaluate"
+                   for e in chrome["traceEvents"])
+        # the run also published its throughput gauge
+        from hyperopt_tpu.obs import registry
+
+        assert registry().snapshot()["gauges"].get(
+            "fmin.trials_per_sec", 0.0) >= 0.0

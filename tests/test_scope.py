@@ -261,3 +261,62 @@ class TestPyllInterpreter:
                 trials=t, rstate=np.random.default_rng(0),
                 show_progressbar=False)
         assert len(t) == 10
+
+    def test_clone_merge_collapses_common_subexpressions(self):
+        from hyperopt_tpu import pyll
+
+        x = hp.uniform("x", 0, 1)
+        # two structurally identical (x + 1) subtrees, built separately
+        expr = {"a": (x + 1) * 2, "b": (x + 1) * 3}
+        merged = pyll.clone_merge(expr)
+        adds = [n for n in pyll.dfs(merged)
+                if isinstance(n, pyll.Apply) and n.op == "add"]
+        assert len(adds) == 1          # collapsed onto one shared node
+        # semantics preserved, original untouched
+        assert pyll.rec_eval(merged, memo={"x": 1.0}) == {"a": 4.0,
+                                                          "b": 6.0}
+        assert pyll.rec_eval(expr, memo={"x": 0.0}) == {"a": 2.0,
+                                                        "b": 3.0}
+        orig_adds = [n for n in pyll.dfs(expr)
+                     if isinstance(n, pyll.Apply) and n.op == "add"]
+        assert len(orig_adds) == 2
+
+    def test_clone_merge_literals_opt_in_and_memo(self):
+        from hyperopt_tpu import pyll
+
+        x = hp.uniform("x", 0, 1)
+        # operator sugar keeps plain floats raw in Apply args, so build
+        # the equal-valued Literal nodes explicitly
+        expr = {"a": x + pyll.Literal(7.0), "b": x * pyll.Literal(7.0)}
+
+        def lits(e):
+            return [n for n in pyll.dfs(e)
+                    if isinstance(n, pyll.Literal) and n.obj == 7.0]
+
+        # literal identity is load-bearing for memo substitution, so
+        # equal-valued Literals merge only on request (reference default)
+        assert len(lits(pyll.clone_merge(expr))) == 2
+        assert len(lits(pyll.clone_merge(expr, merge_literals=True))) == 1
+        # memo pre-seeds replacements exactly as in clone()
+        sub = pyll.clone_merge(expr, memo={x: pyll.Literal(2.0)})
+        assert pyll.rec_eval(sub) == {"a": 9.0, "b": 14.0}
+
+    def test_use_obj_for_literal_in_memo(self):
+        from hyperopt_tpu import pyll
+
+        # plant a sentinel literal, then substitute the live object at
+        # evaluation time (the fmin_pass_expr_memo_ctrl idiom)
+        sentinel = "__ctrl__"
+        lit_a, lit_b = pyll.Literal(sentinel), pyll.Literal(sentinel)
+        expr = {"u": hp.uniform("u", 0, 1), "c": lit_a, "d": lit_b}
+        live = {"attachments": 42}
+        memo = pyll.use_obj_for_literal_in_memo(expr, live, sentinel, {})
+        assert memo == {lit_a: live, lit_b: live}
+        out = pyll.rec_eval(expr, memo=dict(memo, **{"u": 0.25}))
+        assert out == {"u": 0.25, "c": live, "d": live}
+        # existing memo entries win; non-matching literals untouched
+        memo2 = pyll.use_obj_for_literal_in_memo(expr, live, sentinel,
+                                                 {lit_a: "kept"})
+        assert memo2[lit_a] == "kept" and memo2[lit_b] is live
+        assert pyll.use_obj_for_literal_in_memo(expr, live, "other",
+                                                {}) == {}

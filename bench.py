@@ -272,8 +272,39 @@ def child():
                     overlap_suggest=overlap, max_queue_len=qlen)
             return n / (time.perf_counter() - t0)
 
+        # Host-loop breakdown (ISSUE 3): per-phase wall time and the
+        # resident-history transfer counters, deltas over the TIMED run
+        # only, so future rounds can attribute a loop-floor regression to
+        # feed/dispatch/fetch instead of re-profiling from scratch.
+        from hyperopt_tpu.obs.metrics import registry as _obs_reg
+
+        _loop_keys = ("suggest.upload_ms", "suggest.dispatch_ms",
+                      "suggest.fetch_sync_ms", "history.upload_bytes",
+                      "history.append_hits", "history.rebuilds")
+
+        def _loop_counters():
+            c = _obs_reg().snapshot()["counters"]
+            return {k: c.get(k, 0.0) for k in _loop_keys}
+
         run(objective, False)                     # warm-up: compiles only
+        c0 = _loop_counters()
         partial["trials_per_sec"] = round(run(objective, False), 2)
+        c1 = _loop_counters()
+        partial["loop_breakdown"] = {
+            "upload_ms": round(c1["suggest.upload_ms"]
+                               - c0["suggest.upload_ms"], 3),
+            "dispatch_ms": round(c1["suggest.dispatch_ms"]
+                                 - c0["suggest.dispatch_ms"], 3),
+            "fetch_sync_ms": round(c1["suggest.fetch_sync_ms"]
+                                   - c0["suggest.fetch_sync_ms"], 3),
+            "history_upload_bytes": c1["history.upload_bytes"]
+            - c0["history.upload_bytes"],
+            "history_append_hits": c1["history.append_hits"]
+            - c0["history.append_hits"],
+            "history_rebuilds": c1["history.rebuilds"]
+            - c0["history.rebuilds"],
+            "n_evals": n_evals,
+        }
         partial["trials_sec_n_EI"] = n_cand_ts
         _say("partial", partial)
         if not fast and on_tpu:

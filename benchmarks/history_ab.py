@@ -1,0 +1,155 @@
+"""CPU A/B: device-resident history feed vs the legacy host-padded feed.
+
+ISSUE 3's measured-transfer contract: steady-state per-trial host→device
+bytes drop from O(n_cap·P) (full padded re-upload every suggest) to O(P)
+(one appended row), with ``trials_per_sec`` no worse than the legacy
+path on the CPU backend.  Both arms run the same seeded fmin; the dense
+trial histories must come out bit-identical (the parity the test suite
+pins per scenario), so the A/B is purely a transfer/throughput
+comparison.
+
+Resident-arm bytes come from the ``history.upload_bytes`` counter; the
+legacy arm moves its whole padded buffer through the jit boundary every
+call, so its figure is the analytic ``Σ n_cap·(5P+5)`` over the same
+suggest schedule (the counter only meters the resident module).
+
+Run::
+
+    env JAX_PLATFORMS=cpu python benchmarks/history_ab.py
+
+Writes ``benchmarks/history_ab_cpu_<stamp>.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+N_EVALS = 120
+SEED = 0
+
+
+def _space():
+    import hyperopt_tpu as ho
+
+    hp = ho.hp
+    # 10-param mixed space in the flagship mold: continuous, log, quantized,
+    # integer and categorical columns so every feed dtype is exercised.
+    return {
+        **{f"u{i}": hp.uniform(f"u{i}", -3, 3) for i in range(4)},
+        **{f"n{i}": hp.normal(f"n{i}", 0, 1) for i in range(2)},
+        "lr": hp.loguniform("lr", -5, 0),
+        "q0": hp.quniform("q0", 0, 16, 1),
+        "i0": hp.randint("i0", 8),
+        "c0": hp.choice("c0", [0, 1, 2]),
+    }
+
+
+def _objective(cfg):
+    return float(cfg["u0"] ** 2 + abs(cfg["n0"]) + 0.1 * cfg["c0"])
+
+
+def _counters():
+    from hyperopt_tpu.obs.metrics import registry
+
+    c = registry().snapshot()["counters"]
+    keys = ("history.upload_bytes", "history.rebuilds",
+            "history.append_hits", "suggest.upload_ms",
+            "suggest.dispatch_ms", "suggest.fetch_sync_ms")
+    return {k: c.get(k, 0.0) for k in keys}
+
+
+def _run(resident: bool):
+    import hyperopt_tpu as ho
+    from hyperopt_tpu.space import compile_space
+
+    os.environ["HYPEROPT_TPU_RESIDENT_HISTORY"] = "1" if resident else "0"
+    space = _space()
+
+    def once():
+        t = ho.Trials()
+        t0 = time.perf_counter()
+        ho.fmin(_objective, space, algo=ho.tpe.suggest, max_evals=N_EVALS,
+                trials=t, rstate=np.random.default_rng(SEED),
+                show_progressbar=False)
+        return t, N_EVALS / (time.perf_counter() - t0)
+
+    once()                       # warm-up: absorbs every compile
+    c0 = _counters()
+    trials, tps = once()         # timed steady-state run
+    c1 = _counters()
+    h = trials.history(compile_space(space))
+    delta = {k: c1[k] - c0[k] for k in c0}
+    return h, tps, delta
+
+
+def _legacy_feed_bytes(p: int, n_startup: int = 20) -> int:
+    """Analytic bytes/run the legacy path moves through the jit boundary:
+    the full padded buffer, every post-startup suggest."""
+    from hyperopt_tpu.tpe import _bucket
+
+    row = p * 4 + p + 4 + 1
+    return sum(_bucket(n) * row for n in range(n_startup, N_EVALS))
+
+
+def main():
+    from hyperopt_tpu.space import compile_space
+
+    h_leg, tps_leg, d_leg = _run(resident=False)
+    h_res, tps_res, d_res = _run(resident=True)
+
+    parity = (np.array_equal(h_leg["vals"], h_res["vals"])
+              and np.array_equal(h_leg["loss"], h_res["loss"]))
+    p = compile_space(_space()).n_params
+    n_sugg = N_EVALS - 20
+    legacy_bytes = _legacy_feed_bytes(p)
+
+    doc = {
+        "metric": "history_ab_resident_vs_legacy",
+        "backend": "cpu",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "n_evals": N_EVALS,
+        "n_suggested": n_sugg,
+        "space_params": p,
+        "seed": SEED,
+        "parity_bit_identical": bool(parity),
+        "rows": [
+            {"mode": "legacy",
+             "trials_per_sec": round(tps_leg, 2),
+             "feed_bytes_total": legacy_bytes,
+             "feed_bytes_per_trial": round(legacy_bytes / n_sugg, 1),
+             "feed_bytes_source": "analytic sum(n_cap*(5P+5)) over the "
+                                  "suggest schedule",
+             "upload_ms": round(d_leg["suggest.upload_ms"], 2),
+             "dispatch_ms": round(d_leg["suggest.dispatch_ms"], 2),
+             "fetch_sync_ms": round(d_leg["suggest.fetch_sync_ms"], 2)},
+            {"mode": "resident",
+             "trials_per_sec": round(tps_res, 2),
+             "feed_bytes_total": int(d_res["history.upload_bytes"]),
+             "feed_bytes_per_trial": round(
+                 d_res["history.upload_bytes"] / n_sugg, 1),
+             "feed_bytes_source": "history.upload_bytes counter",
+             "rebuilds": int(d_res["history.rebuilds"]),
+             "append_hits": int(d_res["history.append_hits"]),
+             "upload_ms": round(d_res["suggest.upload_ms"], 2),
+             "dispatch_ms": round(d_res["suggest.dispatch_ms"], 2),
+             "fetch_sync_ms": round(d_res["suggest.fetch_sync_ms"], 2)},
+        ],
+    }
+    stamp = time.strftime("%Y%m%d")
+    path = os.path.join(_ROOT, "benchmarks", f"history_ab_cpu_{stamp}.json")
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    print(json.dumps(doc, indent=1))
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

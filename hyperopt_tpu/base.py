@@ -326,6 +326,12 @@ class Trials:
             self.attachments = {}
             self._soa_cache = None
             self._best_cache = None
+        # Free any device-resident history buffers now rather than at GC
+        # (the tids-prefix check would catch the wipe anyway — this is a
+        # memory courtesy, not a correctness requirement).
+        from . import history as _rhist
+
+        _rhist.forget(self)
 
     # -- state bookkeeping ---------------------------------------------------
 

@@ -45,6 +45,7 @@ import numpy as np
 
 from collections import OrderedDict
 
+from . import history as _rhist
 from .space import CompiledSpace, compile_space, prng_impl, prng_key
 from .tpe import (
     _bucket,
@@ -252,7 +253,8 @@ def fmin_device(fn, space, max_evals, seed=0,
                  kern.split_impl, kern.pallas, kern.pallas_ei,
                  kern.ei_precision, kern.ei_topm,
                  _pallas_tile(), mesh_k,
-                 n_runs, patience, float(min_improvement), prng_impl())
+                 n_runs, patience, float(min_improvement), prng_impl(),
+                 _rhist.enabled())
     run = cache.get(cache_key)
     from .obs import EVENTS, registry as _obs_registry
     _reg = _obs_registry()

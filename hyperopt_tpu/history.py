@@ -1,0 +1,303 @@
+"""Device-resident history feed: O(P) per-trial host→device transfer.
+
+``tpe.suggest_dispatch`` used to rebuild the full padded history on host
+(``_padded_history`` — fresh ``n_cap×P`` numpy allocs) and re-upload all
+of it every call: O(n_cap·P) bytes across the axon tunnel per step for a
+delta of one row.  This module keeps the padded ``(hv, ha, hl, hok)``
+buffers RESIDENT on device, per ``(trials, space, mesh-placement)``, with
+an append cursor:
+
+* **Append** — only the newly completed ``[k, P]`` rows (+ losses/flags)
+  cross host→device, through a jitted ``dynamic_update_slice`` program
+  whose history operands are donated (in-place XLA aliasing) on
+  accelerator backends.
+* **Coherence** — the same tids-prefix check ``Trials.history()`` uses:
+  the store remembers the tids of the rows it holds, and any mismatch
+  (deletions, warm-start injection, multi-process stores rewriting the
+  log) falls back to ONE full re-upload.  Never wrong answers; the
+  fallback is counted, not silent.
+* **Bucket rollover** — a single on-device pad-copy to the next
+  power-of-two capacity, pre-triggered from ``suggest_dispatch``'s
+  ``_prewarm_async`` boundary check so the switchover call doesn't pay
+  it; zero host→device bytes.
+* **In-flight fantasies** — ``_with_inflight_fantasies``'s host-side
+  concat would dirty the buffers every overlapped step, so constant-liar
+  rows are instead OVERLAID device-side into the slack rows past
+  ``n_real`` (a non-donating program: the canonical buffers survive
+  untouched for the next append).
+
+Gate: ``HYPEROPT_TPU_RESIDENT_HISTORY`` (default on; ``=0`` restores the
+legacy host-padded feed).  The buffer CONTENT is bit-identical to
+``_padded_history`` either way — tests/test_history.py pins seeded
+proposal parity — so the toggle is a transfer-path choice, not a math
+choice.
+
+Instrumentation (``obs.metrics``): ``history.upload_bytes`` (every
+host→device byte this module moves), ``history.append_hits`` (calls
+served by the delta path), ``history.rebuilds`` (full re-uploads).  The
+steady-state per-trial upload contract — O(P) bytes, not O(n_cap·P) —
+is asserted from these counters in the tier-1 suite.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .obs.metrics import registry as _registry
+
+__all__ = ["enabled", "device_history", "pregrow", "forget"]
+
+
+def enabled() -> bool:
+    """Resident-history gate (``HYPEROPT_TPU_RESIDENT_HISTORY``, default on)."""
+    return os.environ.get("HYPEROPT_TPU_RESIDENT_HISTORY", "1").lower() \
+        not in ("0", "off", "false")
+
+
+def _row_bytes(p: int) -> int:
+    """Host→device bytes per history row: f32 vals + bool active + f32
+    loss + bool ok."""
+    return p * 4 + p + 4 + 1
+
+
+# ---------------------------------------------------------------------------
+# jitted buffer programs (shape-polymorphic via jit retracing)
+# ---------------------------------------------------------------------------
+
+
+def _append_impl(hv, ha, hl, hok, rows, acts, loss, ok, idx):
+    hv = jax.lax.dynamic_update_slice(hv, rows, (idx, 0))
+    ha = jax.lax.dynamic_update_slice(ha, acts, (idx, 0))
+    hl = jax.lax.dynamic_update_slice(hl, loss, (idx,))
+    hok = jax.lax.dynamic_update_slice(hok, ok, (idx,))
+    return hv, ha, hl, hok
+
+
+def _grow_impl(hv, ha, hl, hok, new_cap):
+    # Pad values match _padded_history exactly: 0 vals, False active,
+    # +inf loss, False ok.
+    pad = new_cap - hv.shape[0]
+    return (jnp.pad(hv, ((0, pad), (0, 0))),
+            jnp.pad(ha, ((0, pad), (0, 0))),
+            jnp.pad(hl, ((0, pad),), constant_values=np.inf),
+            jnp.pad(hok, ((0, pad),)))
+
+
+def _slice_impl(hv, ha, hl, hok, cap):
+    return hv[:cap], ha[:cap], hl[:cap], hok[:cap]
+
+
+def _overlay_impl(hv, ha, hl, hok, pv, pa, lie, idx):
+    m = pv.shape[0]
+    hv = jax.lax.dynamic_update_slice(hv, pv, (idx, 0))
+    ha = jax.lax.dynamic_update_slice(ha, pa, (idx, 0))
+    hl = jax.lax.dynamic_update_slice(
+        hl, jnp.full((m,), lie, jnp.float32), (idx,))
+    hok = jax.lax.dynamic_update_slice(
+        hok, jnp.ones((m,), jnp.bool_), (idx,))
+    return hv, ha, hl, hok
+
+
+_FNS: dict = {}
+_FNS_LOCK = threading.Lock()
+
+
+def _donate_ok() -> bool:
+    # Donation on the CPU backend is never honored and warns per program;
+    # on TPU/GPU it lets XLA alias the append in place.
+    try:
+        return jax.default_backend() != "cpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+def _fn(name: str):
+    fn = _FNS.get(name)
+    if fn is not None:
+        return fn
+    with _FNS_LOCK:
+        fn = _FNS.get(name)
+        if fn is None:
+            donate = (0, 1, 2, 3) if _donate_ok() else ()
+            if name == "append":
+                # Exact-shape in-place aliasing; the only donating program.
+                fn = jax.jit(_append_impl, donate_argnums=donate)
+            elif name == "grow":
+                # Shapes differ old→new so donation could never alias —
+                # plain pad-copy (device-side only, zero upload bytes).
+                fn = jax.jit(_grow_impl, static_argnums=(4,))
+            elif name == "slice":
+                fn = jax.jit(_slice_impl, static_argnums=(4,))
+            else:  # overlay: canonical buffers must SURVIVE — no donation
+                fn = jax.jit(_overlay_impl)
+            _FNS[name] = fn
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# resident store
+# ---------------------------------------------------------------------------
+
+
+class _Resident:
+    """Canonical device buffers for one (trials, space, placement)."""
+
+    __slots__ = ("cs", "cap", "n", "tids", "bufs")
+
+    def __init__(self, cs, cap, n, tids, bufs):
+        self.cs = cs        # strong ref: pins id(cs) while this entry lives
+        self.cap = cap      # canonical capacity (monotone within an entry)
+        self.n = n          # real rows resident
+        self.tids = tids    # i64[n] — coherence fingerprint of those rows
+        self.bufs = bufs    # (hv, ha, hl, hok) device arrays [cap, ...]
+
+
+# trials → {(id(cs), shard_key): _Resident}.  Weak on the trials object so
+# a finished experiment's device buffers free with it; _Resident holds cs
+# strongly so the id(cs) key cannot be recycled while the entry lives.
+_STORE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_LOCK = threading.Lock()
+
+
+def _states(trials):
+    try:
+        d = _STORE.get(trials)
+        if d is None:
+            d = {}
+            _STORE[trials] = d
+        return d
+    except TypeError:       # exotic trials without weakref support
+        return None
+
+
+def _pad_full(h, cap, p):
+    n = h["vals"].shape[0]
+    vals = np.zeros((cap, p), np.float32)
+    active = np.zeros((cap, p), bool)
+    loss = np.full((cap,), np.inf, np.float32)
+    ok = np.zeros((cap,), bool)
+    vals[:n] = h["vals"]
+    active[:n] = h["active"]
+    loss[:n] = h["loss"]
+    ok[:n] = h["ok"]
+    return vals, active, loss, ok
+
+
+def _put(arrs, sharding):
+    if sharding is None:
+        return tuple(jax.device_put(a) for a in arrs)
+    return tuple(jax.device_put(a, sharding) for a in arrs)
+
+
+def _validate(st, cs, h, p):
+    """Coherence: the resident rows must be a tids-prefix of the current
+    history (the exact check Trials.history() itself revalidates with)."""
+    return (st is not None and st.cs is cs
+            and st.bufs[0].shape[1] == p
+            and st.n <= h["tids"].shape[0]
+            and np.array_equal(st.tids, h["tids"][: st.n]))
+
+
+def device_history(trials, cs, h, n_cap, fantasies=None, sharding=None,
+                   shard_key=None):
+    """Return ``(hv, ha, hl, hok)`` device arrays bit-identical to
+    ``tpe._padded_history`` of ``h`` (+ optional constant-liar fantasy
+    rows) at capacity ``n_cap``, uploading only the delta since the last
+    call.
+
+    ``fantasies`` is ``(pv f32[M,P], pa bool[M,P], lie f32)`` — overlaid
+    into rows ``[n, n+M)`` of a DERIVED copy (exactly where the legacy
+    host-side concat put them) without dirtying the canonical buffers.
+    ``sharding``/``shard_key`` pin mesh placement for the sharded suggest
+    paths (replicated history); distinct placements keep distinct
+    canonical buffers.
+    """
+    n, p = h["vals"].shape
+    reg = _registry()
+    states = _states(trials)
+    key = (id(cs), shard_key)
+    with _LOCK:
+        st = states.get(key) if states is not None else None
+        if not _validate(st, cs, h, p):
+            # Prefix mismatch (or first touch): ONE full re-upload at the
+            # requested capacity — correctness fallback, never wrong rows.
+            cap = max(n_cap, st.cap if st is not None else 0)
+            bufs = _put(_pad_full(h, cap, p), sharding)
+            st = _Resident(cs, cap, n, h["tids"], bufs)
+            if states is not None:
+                states[key] = st
+            reg.counter("history.rebuilds").inc()
+            reg.counter("history.upload_bytes").inc(cap * _row_bytes(p))
+        else:
+            if max(n_cap, n) > st.cap:
+                # Rollover missed by the pregrow trigger (e.g. a batched
+                # call's slack jumped a bucket): device pad-copy now.
+                st.bufs = _fn("grow")(*st.bufs, max(n_cap, n))
+                st.cap = max(n_cap, n)
+            k = n - st.n
+            if k > 0:
+                rows = np.ascontiguousarray(h["vals"][st.n:n])
+                acts = np.ascontiguousarray(h["active"][st.n:n])
+                loss = np.ascontiguousarray(h["loss"][st.n:n])
+                oks = np.ascontiguousarray(h["ok"][st.n:n])
+                if sharding is not None:
+                    rows, acts, loss, oks = _put((rows, acts, loss, oks),
+                                                 sharding)
+                st.bufs = _fn("append")(*st.bufs, rows, acts, loss, oks,
+                                        np.int32(st.n))
+                st.n = n
+                st.tids = h["tids"]
+                reg.counter("history.upload_bytes").inc(k * _row_bytes(p))
+            reg.counter("history.append_hits").inc()
+        out = st.bufs
+    if st.cap > n_cap:
+        # Canonical outgrew the request (pregrow band / post-batch single
+        # call): derive the exact-capacity view device-side.
+        out = _fn("slice")(*out, n_cap)
+    if fantasies is not None:
+        pv, pa, lie = fantasies
+        if sharding is not None:
+            pv, pa = _put((pv, pa), sharding)
+        out = _fn("overlay")(*out, pv, pa, np.float32(lie), np.int32(n))
+        reg.counter("history.upload_bytes").inc(len(pv) * (p * 4 + p))
+    return out
+
+
+def pregrow(trials, cs, n_cap, shard_key=None):
+    """Roll the canonical buffers to ``n_cap`` ahead of the bucket flip.
+
+    Piggybacks on ``suggest_dispatch``'s ``_prewarm_async`` boundary
+    trigger (``n_rows >= 0.75·cap``): the pad-copy runs while the current
+    bucket still has headroom, so the first call on the next bucket pays
+    neither a compile (prewarmed) nor the copy.  Pure device work — no
+    host→device bytes.  No-op when the store is cold or already big.
+    """
+    states = _states(trials)
+    if states is None:
+        return
+    with _LOCK:
+        st = states.get((id(cs), shard_key))
+        if st is None or st.cap >= n_cap:
+            return
+        st.bufs = _fn("grow")(*st.bufs, n_cap)
+        st.cap = n_cap
+
+
+def forget(trials):
+    """Drop all resident buffers for ``trials`` (frees device memory).
+
+    Called by stores that know their history is going away wholesale
+    (``Trials.delete_all``, pool shutdown); ordinary mutation needs no
+    call — the tids-prefix check catches it.
+    """
+    with _LOCK:
+        try:
+            _STORE.pop(trials, None)
+        except TypeError:
+            pass

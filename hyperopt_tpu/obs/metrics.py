@@ -11,6 +11,15 @@ lock.  When disabled, every ``inc``/``set``/``observe`` returns after a
 single attribute check — the disabled path is the budget the
 ``trials_per_sec`` bench holds to <1% (DESIGN.md §6).
 
+Well-known loop-feed series (fed by ``tpe.suggest_dispatch`` and
+``hyperopt_tpu.history``): ``history.upload_bytes`` /
+``history.append_hits`` / ``history.rebuilds`` — the resident-history
+transfer contract (steady-state O(P) bytes/trial, asserted in
+tests/test_history.py) — and ``suggest.upload_ms`` /
+``suggest.dispatch_ms`` / ``suggest.fetch_sync_ms``, the host-loop
+phase breakdown ``bench.py``'s trials_sec phase snapshots into its
+``loop_breakdown`` artifact field.
+
 Also home to the TPE kernel-cache compile-shape counters
 (:func:`kernel_cache_event` / :func:`kernel_cache_stats`), relocated
 from ``utils/tracing.py``.  These stay **always-on** regardless of the

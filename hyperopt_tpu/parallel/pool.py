@@ -154,6 +154,12 @@ class PoolTrials(Trials):
         if self._pool is not None:
             self._pool.shutdown(wait=self.execution == "process")
             self._pool = None
+        # The run is over: release the device-resident history buffers
+        # this pool's suggests fed (tpe.suggest_dispatch keeps them per
+        # Trials object; a long-lived driver process may build many pools).
+        from .. import history as _rhist
+
+        _rhist.forget(self)
 
     # -- cancellation --------------------------------------------------------
 

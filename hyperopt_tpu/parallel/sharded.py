@@ -30,11 +30,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import base
+from .. import history as _rhist
 from ..space import CompiledSpace, prng_key
 from ..tpe import (
     _TpeKernel,
     _batch_size_for,
     _bucket,
+    _inflight_fantasy_rows,
     _with_inflight_fantasies,
     _default_gamma,
     _default_linear_forgetting,
@@ -47,6 +49,23 @@ from .. import rand
 
 CAND_AXIS = "sp"    # candidate (sequence-like long) axis
 START_AXIS = "dp"   # independent-posterior (data-parallel) axis
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` with a jax-0.4.x fallback.
+
+    ``shard_map`` graduated from ``jax.experimental`` only in jax 0.5;
+    on 0.4.x the top-level symbol is absent and the replication-check
+    kwarg is still spelled ``check_rep``.  Feature-detect rather than
+    version-parse so pre-release builds resolve correctly."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)
 
 
 def default_mesh(devices=None, n_starts=1):
@@ -117,7 +136,7 @@ def _get_sharded_kernel(cs, n_cap, n_cand, lf, mesh, split,
     # hands back a stale kernel.
     k = (n_cap, n_cand, lf, _mesh_key(mesh), split, multivariate,
          cat_prior, _pallas_mode(), _comp_sampler(), _pallas_tile(),
-         _split_impl())
+         _split_impl(), _rhist.enabled())
     if k not in cache:
         cache[k] = ShardedTpeKernel(cs, n_cap, n_cand, lf, mesh, split,
                                     multivariate=multivariate,
@@ -159,9 +178,15 @@ def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
                                       np.asarray(a),
                                       exp_key=getattr(trials, "exp_key",
                                                       None))
-    h = _with_inflight_fantasies(h, trials, cs)
     n = len(new_ids)
-    n_rows = h["vals"].shape[0]
+    resident = _rhist.enabled()
+    fant = None
+    if resident:
+        fant = _inflight_fantasy_rows(h, trials, cs)
+        n_rows = h["vals"].shape[0] + (fant[0].shape[0] if fant else 0)
+    else:
+        h = _with_inflight_fantasies(h, trials, cs)
+        n_rows = h["vals"].shape[0]
     # Batched proposals run the inherited constant-liar scan (the sharding
     # constraints live inside _suggest_one, so each scan step's EI sweep
     # is still mesh-sharded): one dispatch + one fetch for all n, with
@@ -171,7 +196,15 @@ def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
                                int(n_EI_candidates), int(linear_forgetting),
                                mesh, split, multivariate=multivariate,
                                cat_prior=cat_prior)
-    hv, ha, hl, hok = _padded_history(h, kern.n_cap)
+    if resident:
+        # Resident history replicated over the mesh (P() = no sharded
+        # dims); placement keys the store so a plain-jit path on the same
+        # trials keeps its own canonical buffers.
+        hv, ha, hl, hok = _rhist.device_history(
+            trials, cs, h, kern.n_cap, fantasies=fant,
+            sharding=NamedSharding(mesh, P()), shard_key=_mesh_key(mesh))
+    else:
+        hv, ha, hl, hok = _padded_history(h, kern.n_cap)
     seed32 = int(seed) % (2 ** 32)
     with mesh:
         if n == 1:
@@ -214,11 +247,10 @@ def _multi_start_fn(kern, mesh):
             lambda k, g: kern._suggest_one(k, vals, active, loss, ok,
                                            g, prior_weight))(keys, gammas)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(_shard_map(
         one_host, mesh=mesh,
         in_specs=(P(START_AXIS), P(START_AXIS), P(), P(), P(), P(), P()),
-        out_specs=P(START_AXIS),
-        check_vma=False))
+        out_specs=P(START_AXIS)))
 
 
 def _gamma_spread(gamma, n_starts):
@@ -264,12 +296,18 @@ def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
                                       np.asarray(a),
                                       exp_key=getattr(trials, "exp_key",
                                                       None))
-    h = _with_inflight_fantasies(h, trials, cs)
-
     n = len(new_ids)
+    resident = _rhist.enabled()
+    fant = None
+    if resident:
+        fant = _inflight_fantasy_rows(h, trials, cs)
+        n_rows = h["vals"].shape[0] + (fant[0].shape[0] if fant else 0)
+    else:
+        h = _with_inflight_fantasies(h, trials, cs)
+        n_rows = h["vals"].shape[0]
     n_dev = mesh.shape[START_AXIS]
     n_starts = -(-n // n_dev) * n_dev  # round up to fill the mesh axis
-    kern = get_kernel(cs, _bucket(h["vals"].shape[0]), int(n_EI_candidates),
+    kern = get_kernel(cs, _bucket(n_rows), int(n_EI_candidates),
                       int(linear_forgetting), split,
                       multivariate=multivariate, cat_prior=cat_prior)
     cache = getattr(cs, "_multi_start_fns", None)
@@ -280,7 +318,12 @@ def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
         cache[ck] = _multi_start_fn(kern, mesh)
     fn = cache[ck]
 
-    hv, ha, hl, hok = _padded_history(h, kern.n_cap)
+    if resident:
+        hv, ha, hl, hok = _rhist.device_history(
+            trials, cs, h, kern.n_cap, fantasies=fant,
+            sharding=NamedSharding(mesh, P()), shard_key=_mesh_key(mesh))
+    else:
+        hv, ha, hl, hok = _padded_history(h, kern.n_cap)
     keys = jax.random.split(prng_key(int(seed) % (2 ** 32)), n_starts)
     with mesh:
         rows, _ = fn(keys, _gamma_spread(gamma, n_starts), hv, ha, hl, hok,

@@ -43,12 +43,14 @@ from __future__ import annotations
 import logging
 import os
 from functools import partial
+from time import perf_counter
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from . import base, rand
+from . import history as _rhist
 from .ops import (
     fit_parzen,
     forgetting_weights,
@@ -58,6 +60,7 @@ from .ops import (
 )
 from .ops.gmm import onehot_lookup
 from .obs import kernel_cache_event
+from .obs.metrics import registry as _metrics_registry
 from .space import (
     CATEGORICAL,
     LOGNORMAL,
@@ -937,9 +940,13 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
     cat_prior = cat_prior or _cat_prior_default()
     # Env toggles baked into the traced program all key the cache —
     # a mid-process toggle must produce a fresh kernel, never a stale one.
+    # The resident-history gate keys it too (same discipline, though it
+    # only selects the FEED path): a flipped gate gets a kernel whose
+    # prewarm/compile accounting matches the feed it runs against.
     k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
          _pallas_mode(), _comp_sampler(), _pallas_tile(), _split_impl(),
-         prng_impl(), _pallas_ei_impl(), _ei_precision(), _ei_topm())
+         prng_impl(), _pallas_ei_impl(), _ei_precision(), _ei_topm(),
+         _rhist.enabled())
     hit = k in cache
     kernel_cache_event(k, hit)
     if not hit:
@@ -979,19 +986,32 @@ def _with_inflight_fantasies(h, trials, cs):
     :func:`suggest_dispatch`, ``parallel.sharded_suggest``, and
     ``parallel.multi_start_suggest``.
     """
-    infl = getattr(trials, "inflight", None)
-    if infl is None:
+    fant = _inflight_fantasy_rows(h, trials, cs)
+    if fant is None:
         return h
-    pv, pa = infl(cs)
-    if not len(pv):
-        return h
-    okl = h["loss"][h["ok"]]
-    lie = np.float32(okl.mean()) if okl.size else np.float32(0.0)
+    pv, pa, lie = fant
     return dict(
         vals=np.concatenate([h["vals"], pv]),
         active=np.concatenate([h["active"], pa]),
         loss=np.concatenate([h["loss"], np.full(len(pv), lie, np.float32)]),
         ok=np.concatenate([h["ok"], np.ones(len(pv), bool)]))
+
+
+def _inflight_fantasy_rows(h, trials, cs):
+    """Raw constant-liar rows ``(pv[M,P], pa[M,P], lie)`` or None.
+
+    Single source for the lie value (mean observed ok loss), shared by
+    the legacy host-concat path above and the resident device-overlay
+    path (``history.device_history(fantasies=...)``)."""
+    infl = getattr(trials, "inflight", None)
+    if infl is None:
+        return None
+    pv, pa = infl(cs)
+    if not len(pv):
+        return None
+    okl = h["loss"][h["ok"]]
+    lie = np.float32(okl.mean()) if okl.size else np.float32(0.0)
+    return pv, pa, lie
 
 
 def _batch_size_for(n):
@@ -1131,8 +1151,16 @@ def suggest_dispatch(new_ids, domain, trials, seed,
             a = cs.active_mask_host(v)
         return ("ready", cs, list(new_ids),
                 (np.asarray(v), np.asarray(a)), exp_key)
-    h = _with_inflight_fantasies(h, trials, cs)
-    n_rows = h["vals"].shape[0]
+    resident = _rhist.enabled()
+    if resident:
+        # Fantasy rows become a device-side overlay into the slack rows
+        # past n_real (history.device_history) — a host-side concat here
+        # would invalidate the resident buffers every overlapped step.
+        fant = _inflight_fantasy_rows(h, trials, cs)
+        n_rows = h["vals"].shape[0] + (fant[0].shape[0] if fant else 0)
+    else:
+        h = _with_inflight_fantasies(h, trials, cs)
+        n_rows = h["vals"].shape[0]
     # Batched proposals run m = pow2(n) liar-scan steps (surplus sliced
     # off at materialize) and insert m fantasy rows, so the bucket needs
     # m rows of padding slack.
@@ -1148,7 +1176,20 @@ def suggest_dispatch(new_ids, domain, trials, seed,
         _prewarm_async(get_kernel(cs, kern.n_cap * 2, int(n_EI_candidates),
                                   int(linear_forgetting), split,
                                   multivariate, cat_prior), n=m)
-    hv, ha, hl, hok = _padded_history(h, kern.n_cap)
+        if resident:
+            # Piggyback the resident rollover on the same boundary
+            # trigger: pad-copy to the next bucket on device NOW, so the
+            # flip call pays neither compile nor copy.
+            _rhist.pregrow(trials, cs, kern.n_cap * 2)
+    t_feed = perf_counter()
+    if resident:
+        hv, ha, hl, hok = _rhist.device_history(trials, cs, h, kern.n_cap,
+                                                fantasies=fant)
+    else:
+        hv, ha, hl, hok = _padded_history(h, kern.n_cap)
+    reg = _metrics_registry()
+    reg.counter("suggest.upload_ms").inc((perf_counter() - t_feed) * 1e3)
+    t_disp = perf_counter()
     seed32 = int(seed) % (2 ** 32)
     if n == 1:
         # Rank-1 (P,) device arrays; materialize reshapes to [1, P] on the
@@ -1166,6 +1207,7 @@ def suggest_dispatch(new_ids, domain, trials, seed,
         # too so the last trial doesn't pay a compile stall (round-3
         # advisor finding).
         _prewarm_async(kern, n=1)
+    reg.counter("suggest.dispatch_ms").inc((perf_counter() - t_disp) * 1e3)
     return ("pending", cs, list(new_ids), arrs, exp_key)
 
 
@@ -1180,7 +1222,13 @@ def _force_rows(handle):
     second fetch halves per-suggest latency on high-RTT attachment."""
     tag, cs, new_ids = handle[0], handle[1], handle[2]
     rows, acts = handle[3]
-    rows = np.asarray(rows)
+    if tag == "pending":
+        t0 = perf_counter()
+        rows = np.asarray(rows)   # THE device sync of the suggest step
+        _metrics_registry().counter("suggest.fetch_sync_ms").inc(
+            (perf_counter() - t0) * 1e3)
+    else:
+        rows = np.asarray(rows)
     if rows.ndim == 1:
         rows = rows[None, :]
     # A partial batch rounded up to a compiled program size carries

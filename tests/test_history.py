@@ -1,0 +1,205 @@
+"""Device-resident history feed (hyperopt_tpu/history.py).
+
+Two contracts from ISSUE 3:
+
+* **Seeded proposal parity** — with ``HYPEROPT_TPU_RESIDENT_HISTORY=1``
+  the suggest kernels must see buffers BIT-IDENTICAL to the legacy
+  host-padded feed, so seeded runs produce byte-equal trial histories
+  across the toggle.  Covered per scenario: single suggest, batched
+  (liar-scan) suggest, in-flight fantasy overlay (overlap_suggest),
+  bucket rollover, and the deletion/prefix-mismatch fallback.
+* **Transfer contract** — steady-state per-trial host→device upload is
+  O(P) (a few row-widths), not O(n_cap·P), read from the
+  ``history.upload_bytes`` counter.
+"""
+
+import copy
+
+import numpy as np
+import pytest
+
+import hyperopt_tpu as ho
+from hyperopt_tpu import hp, tpe
+from hyperopt_tpu import history as rhist
+from hyperopt_tpu.space import compile_space
+from hyperopt_tpu.tpe import _padded_history
+from hyperopt_tpu.obs.metrics import registry
+
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -4, 0),
+    "c": hp.choice("c", [
+        {"kind": 0},
+        {"kind": 1, "depth": hp.quniform("depth", 1, 8, 1)},
+    ]),
+}
+
+
+def _obj(p):
+    loss = p["x"] ** 2 + abs(np.log(p["lr"]) + 2.0)
+    if p["c"]["kind"] == 1:
+        loss += 0.1 * p["c"]["depth"]
+    return float(loss)
+
+
+def _counter(name):
+    return registry().snapshot()["counters"].get(name, 0.0)
+
+
+def _run(resident, seed, max_evals, monkeypatch, trials=None, **fmin_kw):
+    monkeypatch.setenv("HYPEROPT_TPU_RESIDENT_HISTORY",
+                       "1" if resident else "0")
+    t = trials if trials is not None else ho.Trials()
+    ho.fmin(_obj, SPACE, algo=tpe.suggest, max_evals=max_evals, trials=t,
+            rstate=np.random.default_rng(seed), show_progressbar=False,
+            **fmin_kw)
+    return t
+
+
+def _dense(t):
+    h = t.history(compile_space(SPACE))
+    return h["vals"].copy(), h["active"].copy(), h["loss"].copy()
+
+
+def _assert_parity(t_legacy, t_resident):
+    lv, la, ll = _dense(t_legacy)
+    rv, ra, rl = _dense(t_resident)
+    np.testing.assert_array_equal(lv, rv)
+    np.testing.assert_array_equal(la, ra)
+    np.testing.assert_array_equal(ll, rl)
+
+
+class TestSeededParity:
+    def test_single_suggest_with_rollover(self, monkeypatch):
+        # 40 evals crosses the 32→64 bucket boundary post-startup, so
+        # this covers ordinary appends AND the pregrow/rollover path.
+        a = _run(False, 11, 40, monkeypatch)
+        b = _run(True, 11, 40, monkeypatch)
+        _assert_parity(a, b)
+
+    def test_batched_suggest(self, monkeypatch):
+        a = _run(False, 12, 28, monkeypatch, max_queue_len=4)
+        b = _run(True, 12, 28, monkeypatch, max_queue_len=4)
+        _assert_parity(a, b)
+
+    def test_inflight_fantasy_overlay(self, monkeypatch):
+        # overlap_suggest pre-dispatches the next batch while the current
+        # one is still NEW → the suggest sees in-flight rows; resident
+        # mode overlays them device-side instead of concat-on-host.
+        a = _run(False, 13, 26, monkeypatch, max_queue_len=2,
+                 overlap_suggest=True)
+        b = _run(True, 13, 26, monkeypatch, max_queue_len=2,
+                 overlap_suggest=True)
+        _assert_parity(a, b)
+
+    def test_prefix_mismatch_falls_back_and_stays_correct(self, monkeypatch):
+        # Build a resident store, then DELETE a mid-history trial: the
+        # tids prefix no longer matches, the store must take exactly one
+        # full re-upload and keep proposing identically to a legacy feed
+        # over the same surviving docs.
+        t = _run(True, 14, 30, monkeypatch)
+        with t._lock:
+            del t._dynamic_trials[7]
+        t.refresh()
+        docs = copy.deepcopy(list(t._dynamic_trials))
+
+        r0 = _counter("history.rebuilds")
+        t = _run(True, 77, 34, monkeypatch, trials=t)
+        assert _counter("history.rebuilds") == r0 + 1
+
+        t2 = ho.trials_from_docs(docs)
+        t2 = _run(False, 77, 34, monkeypatch, trials=t2)
+        _assert_parity(t2, t)
+
+
+class TestFeedBitEquality:
+    """Direct buffer-level equality against tpe._padded_history."""
+
+    class _T:   # weakref-able stand-in for a Trials object
+        pass
+
+    def _h(self, rng, n, p, tid0=0):
+        vals = rng.standard_normal((n, p)).astype(np.float32)
+        active = rng.random((n, p)) < 0.8
+        vals[~active] = 0.0
+        loss = rng.standard_normal(n).astype(np.float32)
+        ok = rng.random(n) < 0.9
+        loss[~ok] = np.inf
+        return dict(vals=vals, active=active, loss=loss, ok=ok,
+                    tids=np.arange(tid0, tid0 + n, dtype=np.int64))
+
+    def _check(self, trials, cs, h, cap, fant=None):
+        got = rhist.device_history(trials, cs, h, cap, fantasies=fant)
+        if fant is not None:
+            pv, pa, lie = fant
+            h = dict(
+                vals=np.concatenate([h["vals"], pv]),
+                active=np.concatenate([h["active"], pa]),
+                loss=np.concatenate(
+                    [h["loss"], np.full(len(pv), lie, np.float32)]),
+                ok=np.concatenate([h["ok"], np.ones(len(pv), bool)]))
+        want = _padded_history(h, cap)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+
+    def test_append_grow_slice_overlay_fallback(self, rng):
+        trials, cs = self._T(), object()
+        p = 4
+        h = self._h(rng, 5, p)
+        r0 = _counter("history.rebuilds")
+        a0 = _counter("history.append_hits")
+
+        self._check(trials, cs, h, 32)                  # cold: rebuild
+        assert _counter("history.rebuilds") == r0 + 1
+
+        h8 = self._h(rng, 8, p)
+        h8["vals"][:5] = h["vals"]; h8["active"][:5] = h["active"]
+        h8["loss"][:5] = h["loss"]; h8["ok"][:5] = h["ok"]
+        self._check(trials, cs, h8, 32)                 # delta append
+        assert _counter("history.append_hits") == a0 + 1
+        assert _counter("history.rebuilds") == r0 + 1
+
+        pv = rng.standard_normal((2, p)).astype(np.float32)
+        pa = np.ones((2, p), bool)
+        self._check(trials, cs, h8, 32, fant=(pv, pa, np.float32(0.25)))
+        # Overlay must NOT dirty the canonical buffers:
+        self._check(trials, cs, h8, 32)
+
+        rhist.pregrow(trials, cs, 64)                   # rollover pad-copy
+        self._check(trials, cs, h8, 32)                 # sliced view
+        self._check(trials, cs, h8, 64)                 # full canonical
+        assert _counter("history.rebuilds") == r0 + 1   # no re-upload
+
+        bad = {k: (v[1:] if v.ndim else v) for k, v in h8.items()}
+        self._check(trials, cs, bad, 32)                # prefix mismatch
+        assert _counter("history.rebuilds") == r0 + 2
+
+    def test_forget_drops_state(self, rng):
+        trials, cs = self._T(), object()
+        h = self._h(rng, 3, 2)
+        r0 = _counter("history.rebuilds")
+        self._check(trials, cs, h, 32)
+        rhist.forget(trials)
+        self._check(trials, cs, h, 32)
+        assert _counter("history.rebuilds") == r0 + 2
+
+
+class TestTransferContract:
+    def test_steady_state_upload_is_o_p(self, monkeypatch):
+        """Regression guard on ISSUE 3's acceptance criterion: once warm,
+        each trial uploads O(P) bytes (one history row: P·4 vals + P
+        active + 5 loss/ok — bounded here by 8·P·4), NOT the legacy
+        O(n_cap·P) full-buffer re-upload (n_cap·(5P+5) ≈ 1.3 KB/trial at
+        the bucket this run sits in)."""
+        monkeypatch.setenv("HYPEROPT_TPU_RESIDENT_HISTORY", "1")
+        t = _run(True, 21, 40, monkeypatch)     # warm: rebuild + rollover
+        b0 = _counter("history.upload_bytes")
+        r0 = _counter("history.rebuilds")
+        t = _run(True, 22, 60, monkeypatch, trials=t)   # 20 steady trials
+        delta = _counter("history.upload_bytes") - b0
+        assert _counter("history.rebuilds") == r0       # appends only
+        p = compile_space(SPACE).n_params
+        assert delta / 20 <= 8 * p * 4, (
+            f"per-trial upload {delta / 20:.0f} B exceeds the O(P) bound "
+            f"({8 * p * 4} B) — resident feed is re-uploading history")

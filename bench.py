@@ -64,6 +64,7 @@ PHASE_DEADLINES = {
     "xla_full": 900.0,
     "pallas_ab": 600.0,
     "trials_sec": 420.0,
+    "pipeline": 600.0,
     "device_fmin": 600.0,
     "cpu_ref": 300.0,
     "result": 60.0,
@@ -346,11 +347,112 @@ def child():
             # advisor finding — only the q8 scan above is TPU-only).
             partial["trials_per_sec_25ms_obj"] = round(
                 run(slow_objective, False), 2)
+            s0 = _obs_reg().snapshot()
             partial["trials_per_sec_25ms_obj_overlap"] = round(
                 run(slow_objective, True), 2)
+            s1 = _obs_reg().snapshot()
+            # Pipeline occupancy alongside loop_breakdown (ISSUE 4): mean
+            # in-flight dispatch handles over the overlap run (histogram
+            # sum/count deltas — the registry is cumulative) plus which
+            # side stalled, so a throughput regression here is attributable
+            # to suggest-bound vs eval-bound without re-profiling.
+            def _hd(name, key):
+                a = s0["histograms"].get(name, {})
+                b = s1["histograms"].get(name, {})
+                return (b.get(key, 0) or 0) - (a.get(key, 0) or 0)
+
+            occ_n = _hd("pipeline.occupancy", "count")
+            partial["pipeline_occupancy"] = {
+                "mean": round(_hd("pipeline.occupancy", "sum") / occ_n, 3)
+                if occ_n else None,
+                "suggest_bound_stalls":
+                    s1["counters"].get("pipeline.stall.suggest_bound", 0.0)
+                    - s0["counters"].get("pipeline.stall.suggest_bound", 0.0),
+                "eval_bound_stalls":
+                    s1["counters"].get("pipeline.stall.eval_bound", 0.0)
+                    - s0["counters"].get("pipeline.stall.eval_bound", 0.0),
+            }
             _say("partial", partial)
     except Exception as e:
         partial["trials_sec_error"] = f"{type(e).__name__}: {e}"
+        _say("partial", partial)
+
+    # Depth-D pipeline sweep (ISSUE 4): trials/sec for the pipelined
+    # executor at D ∈ {1,2,4,8} × objective latency {0,5,25 ms}, one
+    # evaluator.  Depth 1 is the strict sequential-parity schedule, so
+    # each latency row's depth-1 number IS the old overlap_suggest
+    # baseline and speedup_vs_depth1 reads directly as the pipeline win.
+    _say("phase", {"name": "pipeline"})
+    try:
+        import hyperopt_tpu as ho_p
+        from hyperopt_tpu.obs.metrics import registry as _p_reg
+
+        cs10p = compile_space(_flagship_space(10))
+
+        def _p_obj(lat_ms):
+            def f(cfg):
+                if lat_ms:
+                    time.sleep(lat_ms / 1e3)
+                return float(cfg["u0"] ** 2 + abs(cfg["n0"]) + cfg["c0"] * 0.1)
+            return f
+
+        algo_p = ho_p.partial(ho_p.tpe.suggest, n_startup_jobs=5,
+                              n_EI_candidates=128 if fast else 1024)
+        depths = (1, 2) if fast else (1, 2, 4, 8)
+        lats = (0, 25) if fast else (0, 5, 25)
+        n_p = 24 if fast else 48
+
+        def _p_run(lat, depth):
+            t = ho_p.Trials()
+            s0p = _p_reg().snapshot()
+            t0p = time.perf_counter()
+            ho_p.fmin(_p_obj(lat), cs10p, algo=algo_p, max_evals=n_p,
+                      trials=t, rstate=np.random.default_rng(0),
+                      show_progressbar=False, overlap_depth=depth)
+            tps = n_p / (time.perf_counter() - t0p)
+            s1p = _p_reg().snapshot()
+
+            def _d(table, name, key="count"):
+                a = s0p[table].get(name, {}) if table == "histograms" \
+                    else s0p[table]
+                b = s1p[table].get(name, {}) if table == "histograms" \
+                    else s1p[table]
+                if table == "histograms":
+                    return (b.get(key, 0) or 0) - (a.get(key, 0) or 0)
+                return b.get(name, 0.0) - a.get(name, 0.0)
+
+            occ_n = _d("histograms", "pipeline.occupancy")
+            return tps, {
+                "occupancy_mean":
+                    round(_d("histograms", "pipeline.occupancy", "sum")
+                          / occ_n, 3) if occ_n else None,
+                "stall_suggest_bound":
+                    _d("counters", "pipeline.stall.suggest_bound"),
+                "stall_eval_bound":
+                    _d("counters", "pipeline.stall.eval_bound"),
+            }
+
+        _p_run(0, depths[-1])        # warm-up: absorb compiles
+        rows = []
+        for lat in lats:
+            base_tps = None
+            for depth in depths:
+                tps, stats = _p_run(lat, depth)
+                if depth == 1:
+                    base_tps = tps
+                row = {"depth": depth, "objective_ms": lat,
+                       "trials_per_sec": round(tps, 2),
+                       "speedup_vs_depth1":
+                       round(tps / base_tps, 3) if base_tps else None}
+                row.update(stats)
+                rows.append(row)
+                _say("rep", {"i": len(rows), "ms": round(1e3 / tps, 1)})
+        partial["pipeline"] = {"evaluators": 1, "n_evals": n_p,
+                               "depths": list(depths),
+                               "objective_ms": list(lats), "rows": rows}
+        _say("partial", partial)
+    except Exception as e:
+        partial["pipeline_error"] = f"{type(e).__name__}: {e}"
         _say("partial", partial)
 
     # Device-resident fmin (hyperopt_tpu/device.py): the ENTIRE optimize

@@ -1,0 +1,331 @@
+"""Depth-D pipeline A/B: pipelined executor vs the depth-1 overlap baseline.
+
+ISSUE 4's acceptance measurement.  Every arm runs the SAME harness
+(``fmin(..., overlap_depth=D)``, one evaluator); depth 1 is the strict
+sequential-parity schedule — the exact replaced ``overlap_suggest=True``
+stream — so each row's depth-1 number is the baseline and
+``speedup_vs_depth1`` reads directly as the pipeline win.
+
+Two sweeps, distinguished by ``fetch_sim_ms``:
+
+* ``fetch_sim_ms=0`` — the raw local-CPU loop.  Expected (and recorded)
+  NEGATIVE result at 25 ms objective: depth 1 already overlaps the
+  dispatch with the objective, and with no attachment latency the serial
+  remainder (materialize + record) is ~1 ms/trial, so deeper pipelines
+  have nothing to hide and their scheduling overhead shows up as ≲1×.
+  At 0 ms objective the sweep shows the suggest-bound regime instead,
+  where depth keeps the XLA queue fed.
+* ``fetch_sim_ms=66`` — the tunneled-TPU attachment model and the
+  acceptance arm.  BENCH_r05 measured ~66 ms of per-materialize
+  synchronous fetch wait through the axon tunnel (``tunnel_sync_ms``) —
+  latency depth 1 pays on the critical path every trial (the r05
+  ``trials_per_sec_25ms_obj_overlap`` = 12.17/s is exactly
+  25 ms + ~57 ms serial), but that a depth ≥ 2 ring hides: the handle's
+  device→host copy starts at dispatch time (``start_transfer``) and has
+  ≥ 2 objective evaluations of air time before the executor needs the
+  rows.  The simulation wraps the real algo's handle lifecycle: a
+  handle's rows become host-ready ``fetch_sim_ms`` after dispatch;
+  ``materialize`` before that blocks for the remainder (the tunnel's
+  synchronous wait), exactly like the real attachment.  Both arms run
+  the identical wrapped harness — depth 1 pays the wait, depth ≥ 2
+  schedules around it.
+
+The same artifact carries the parity evidence: a seeded depth-1 run
+through the executor is compared trial-by-trial (tids, proposal vals,
+losses) against an inline replica of the replaced overlap loop — the
+same reference generator ``tests/test_pipeline.py`` pins — and the
+result is recorded as ``parity.bit_identical``.
+
+Run::
+
+    env JAX_PLATFORMS=cpu python benchmarks/pipeline_ab.py
+
+Writes ``benchmarks/pipeline_ab_<backend>_<stamp>.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+N_EVALS = 48
+SEED = 0
+DEPTHS = (1, 2, 4, 8)
+OBJECTIVE_MS = (0, 5, 25)
+# BENCH_r05 measured ~66 ms synchronous fetch wait per materialize through
+# the axon tunnel (tunnel_sync_ms) — the attachment latency the tunnel_sim
+# sweep models and the depth-D ring exists to hide.
+FETCH_SIM_MS = (0, 66)
+N_PARAMS = 16
+N_EI_CANDIDATES = 2048
+N_STARTUP = 5
+
+
+def _space():
+    import hyperopt_tpu as ho
+
+    hp = ho.hp
+    return {
+        **{f"u{i}": hp.uniform(f"u{i}", -3, 3) for i in range(8)},
+        **{f"n{i}": hp.normal(f"n{i}", 0, 1) for i in range(3)},
+        "lr": hp.loguniform("lr", -5, 0),
+        "q0": hp.quniform("q0", 0, 16, 1),
+        "q1": hp.quniform("q1", 1, 64, 1),
+        "i0": hp.randint("i0", 8),
+        "c0": hp.choice("c0", [0, 1, 2]),
+    }
+
+
+def _objective(lat_ms):
+    def f(cfg):
+        if lat_ms:
+            time.sleep(lat_ms / 1e3)
+        return float(cfg["u0"] ** 2 + abs(cfg["n0"]) + 0.1 * cfg["c0"])
+    return f
+
+
+def _algo():
+    import hyperopt_tpu as ho
+
+    return ho.partial(ho.tpe.suggest, n_startup_jobs=N_STARTUP,
+                      n_EI_candidates=N_EI_CANDIDATES)
+
+
+def _sim_tunnel_algo(fetch_ms):
+    """The real TPE algo with its handle lifecycle wrapped in an
+    attachment-latency model: a handle's rows become host-ready
+    ``fetch_ms`` after dispatch (the device→host copy started by
+    ``start_transfer`` at dispatch time); ``materialize`` before that
+    blocks for the remainder — the tunnel's synchronous fetch wait.
+    ``fetch_ms=0`` degenerates to the unwrapped algo's timing."""
+    import hyperopt_tpu as ho
+
+    real = ho.tpe.suggest
+    kw = dict(n_startup_jobs=N_STARTUP, n_EI_candidates=N_EI_CANDIDATES)
+
+    def algo(new_ids, domain, trials, seed):
+        return real(new_ids, domain, trials, seed, **kw)
+
+    def dispatch(new_ids, domain, trials, seed):
+        h = real.dispatch(new_ids, domain, trials, seed, **kw)
+        return {"h": h, "t0": time.perf_counter()}
+
+    def start_transfer(sh):
+        real.start_transfer(sh["h"])
+
+    def handle_ready(sh):
+        aged = (time.perf_counter() - sh["t0"]) * 1e3 >= fetch_ms
+        return aged and real.handle_ready(sh["h"])
+
+    def materialize(sh):
+        rem = fetch_ms / 1e3 - (time.perf_counter() - sh["t0"])
+        if rem > 0:
+            time.sleep(rem)
+        return real.materialize(sh["h"])
+
+    algo.dispatch = dispatch
+    algo.materialize = materialize
+    algo.handle_ready = handle_ready
+    algo.start_transfer = start_transfer
+    return algo
+
+
+def _snapshot():
+    from hyperopt_tpu.obs.metrics import registry
+
+    return registry().snapshot()
+
+
+def _run(lat_ms, depth, fetch_ms=0):
+    import hyperopt_tpu as ho
+
+    algo = _sim_tunnel_algo(fetch_ms) if fetch_ms else _algo()
+    t = ho.Trials()
+    s0 = _snapshot()
+    t0 = time.perf_counter()
+    ho.fmin(_objective(lat_ms), _space(), algo=algo, max_evals=N_EVALS,
+            trials=t, rstate=np.random.default_rng(SEED),
+            show_progressbar=False, overlap_depth=depth)
+    wall = time.perf_counter() - t0
+    s1 = _snapshot()
+
+    def cd(name):
+        return s1["counters"].get(name, 0.0) - s0["counters"].get(name, 0.0)
+
+    def hd(name, key):
+        a, b = s0["histograms"].get(name, {}), s1["histograms"].get(name, {})
+        return (b.get(key, 0) or 0) - (a.get(key, 0) or 0)
+
+    occ_n = hd("pipeline.occupancy", "count")
+    return t, {
+        "depth": depth,
+        "objective_ms": lat_ms,
+        "fetch_sim_ms": fetch_ms,
+        "trials_per_sec": round(N_EVALS / wall, 2),
+        "wall_s": round(wall, 3),
+        "occupancy_mean": round(hd("pipeline.occupancy", "sum") / occ_n, 3)
+        if occ_n else None,
+        "stall_suggest_bound": cd("pipeline.stall.suggest_bound"),
+        "stall_eval_bound": cd("pipeline.stall.eval_bound"),
+        "stall_suggest_bound_ms": round(cd("pipeline.stall.suggest_bound_ms"),
+                                        1),
+        "dispatch_ms_total": round(cd("suggest.dispatch_ms"), 1),
+        "fetch_sync_ms_total": round(cd("suggest.fetch_sync_ms"), 1),
+    }
+
+
+def _stream(t):
+    return [(d["tid"],
+             {k: tuple(v) for k, v in d["misc"]["vals"].items()},
+             d["result"].get("loss"))
+            for d in t.trials]
+
+
+def _reference_overlap_trials(lat_ms, max_evals):
+    """Inline replica of the REPLACED depth-1 overlap_suggest loop (the
+    pre-executor ``fmin.run_one_batch``) — same rstate draw order: one
+    ``integers(2**31-1)`` per dispatched batch, drawn before the ids."""
+    import hyperopt_tpu as ho
+    from hyperopt_tpu.base import (Ctrl, Domain, JOB_STATE_DONE,
+                                   JOB_STATE_ERROR, JOB_STATE_NEW,
+                                   JOB_STATE_RUNNING, spec_from_misc)
+
+    algo = _algo()
+    kw = dict(algo.keywords)
+    dispatch = ho.tpe.suggest.dispatch
+    materialize = ho.tpe.suggest.materialize
+    domain = Domain(_objective(lat_ms), _space())
+    trials = ho.Trials()
+    rstate = np.random.default_rng(SEED)
+    pending = None
+
+    def n_done():
+        return sum(d["state"] in (JOB_STATE_DONE, JOB_STATE_ERROR)
+                   for d in trials._dynamic_trials)
+
+    while n_done() < max_evals:
+        remaining = max_evals - len(trials._dynamic_trials)
+        n_to_enqueue = min(1, remaining)
+        if pending is not None:
+            docs = materialize(pending)[:n_to_enqueue]
+            pending = None
+        else:
+            s = int(rstate.integers(2 ** 31 - 1))
+            ids = trials.new_trial_ids(n_to_enqueue)
+            trials.refresh()
+            docs = ho.tpe.suggest(ids, domain, trials, s, **kw)
+        if not docs:
+            break
+        trials.insert_trial_docs(docs)
+        trials.refresh()
+        if remaining > n_to_enqueue:
+            s = int(rstate.integers(2 ** 31 - 1))
+            ids = trials.new_trial_ids(min(1, remaining - n_to_enqueue))
+            pending = dispatch(ids, domain, trials, s, **kw)
+        for doc in trials._dynamic_trials:
+            if doc["state"] == JOB_STATE_NEW:
+                doc["state"] = JOB_STATE_RUNNING
+                doc["result"] = domain.evaluate(
+                    spec_from_misc(doc["misc"]),
+                    Ctrl(trials, current_trial=doc))
+                doc["state"] = JOB_STATE_DONE
+        trials.refresh()
+    return trials
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    print(f"backend={backend}  sweep depths={DEPTHS} x "
+          f"objective_ms={OBJECTIVE_MS}  ({N_EVALS} evals/arm)", flush=True)
+
+    _run(0, DEPTHS[-1])          # warm-up: absorbs every compile
+    rows = []
+    for fetch in FETCH_SIM_MS:
+        for lat in OBJECTIVE_MS:
+            base = None
+            for depth in DEPTHS:
+                _, row = _run(lat, depth, fetch)
+                if depth == 1:
+                    base = row["trials_per_sec"]
+                row["speedup_vs_depth1"] = (
+                    round(row["trials_per_sec"] / base, 3) if base else None)
+                rows.append(row)
+                print(f"  fetch={fetch:>2}ms lat={lat:>2}ms depth={depth}: "
+                      f"{row['trials_per_sec']:7.2f} trials/s "
+                      f"(x{row['speedup_vs_depth1']})", flush=True)
+
+    # Parity: seeded depth-1 executor vs the replaced-loop replica, same
+    # shape as the throughput arms (latency 0 keeps it quick).
+    t_pipe, _ = _run(0, 1)
+    t_ref = _reference_overlap_trials(0, N_EVALS)
+    parity = _stream(t_pipe) == _stream(t_ref)
+    print(f"  depth-1 parity vs replaced overlap loop: "
+          f"bit_identical={parity}", flush=True)
+
+    # Acceptance arm: 25 ms objective under the tunnel attachment model —
+    # same wrapped harness for every depth, so depth 1 IS the r05-style
+    # overlap baseline (it pays the fetch wait on the critical path).
+    r25 = {r["depth"]: r for r in rows
+           if r["objective_ms"] == 25 and r["fetch_sim_ms"] == FETCH_SIM_MS[-1]}
+    local25 = {r["depth"]: r for r in rows
+               if r["objective_ms"] == 25 and r["fetch_sim_ms"] == 0}
+    best_depth = max(r25, key=lambda d: r25[d]["trials_per_sec"])
+    headline = {
+        "objective_ms": 25,
+        "fetch_sim_ms": FETCH_SIM_MS[-1],
+        "baseline_depth1_trials_per_sec": r25[1]["trials_per_sec"],
+        "depth2_speedup": r25[2]["speedup_vs_depth1"],
+        "best_depth": best_depth,
+        "best_speedup": r25[best_depth]["speedup_vs_depth1"],
+        "meets_1p5x": r25[2]["speedup_vs_depth1"] >= 1.5,
+        "local_fetch0_depth2_speedup": local25[2]["speedup_vs_depth1"],
+        "note": "fetch_sim_ms=0 rows are the local-CPU negative result "
+                "(nothing to hide at 25 ms objective); fetch_sim_ms=66 "
+                "models the r05-measured axon tunnel sync the ring hides",
+    }
+
+    doc = {
+        "metric": "pipeline_trials_per_sec",
+        "backend": backend,
+        "device": str(jax.devices()[0]),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "n_evals": N_EVALS,
+        "evaluators": 1,
+        "seed": SEED,
+        "space_params": N_PARAMS,
+        "n_EI_candidates": N_EI_CANDIDATES,
+        "n_startup_jobs": N_STARTUP,
+        "depths": list(DEPTHS),
+        "objective_ms": list(OBJECTIVE_MS),
+        "fetch_sim_ms": list(FETCH_SIM_MS),
+        "fetch_sim_source": "BENCH_r05 tunnel_sync_ms (~66 ms synchronous "
+                            "fetch wait per materialize on the axon tunnel)",
+        "rows": rows,
+        "parity": {
+            "bit_identical": bool(parity),
+            "n_trials": len(t_ref.trials),
+            "checked": "depth-1 executor stream (tids/vals/losses) vs "
+                       "inline replica of the replaced overlap_suggest loop",
+        },
+        "headline": headline,
+    }
+    stamp = time.strftime("%Y%m%d")
+    path = os.path.join(_ROOT, "benchmarks",
+                        f"pipeline_ab_{backend}_{stamp}.json")
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    print(json.dumps(doc["headline"], indent=1))
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

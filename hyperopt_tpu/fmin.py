@@ -97,7 +97,7 @@ class FMinIter:
                  poll_interval_secs=0.1, max_evals=None,
                  timeout=None, loss_threshold=None,
                  show_progressbar=True, verbose=False, trace_dir=None,
-                 overlap_suggest=False):
+                 overlap_suggest=False, overlap_depth=None, evaluators=None):
         from .obs import NullTracer, Tracer
         trace_dir = trace_dir or os.environ.get("HYPEROPT_TPU_TRACE_DIR")
         self.tracer = (Tracer(trace_dir, device_trace=True) if trace_dir
@@ -123,16 +123,40 @@ class FMinIter:
         self.start_time = time.time()
         self.show_progressbar = show_progressbar
         self.verbose = verbose
-        # PP-analog overlap (SURVEY.md §2 parallelism table): pre-dispatch
-        # the NEXT suggest on device before evaluating on host, hiding
-        # suggest latency behind the objective.  Needs a dispatch-capable
-        # algo (tpe.suggest / suggest_quantile) and a synchronous backend;
-        # the pre-dispatched posterior is one batch stale — the standard
-        # async-optimizer tradeoff.  With max_queue_len=K the next K-batch
-        # (one liar-scan program) hides behind the K host evaluations.
-        self._pending_suggest = None
-        self._dispatch = self._materialize = None
-        if overlap_suggest and not self.asynchronous:
+        # serial_evaluate's monotone scan cursor: _dynamic_trials is
+        # append-only and settled states never revert to NEW, so every
+        # batch resumes the NEW-trial scan where the last one stopped
+        # (O(N) total bookkeeping over a run instead of O(N²)).
+        self._serial_cursor = 0
+        # PP-analog overlap (SURVEY.md §2 parallelism table), generalized
+        # to a depth-D pipeline (hyperopt_tpu/pipeline.py): up to D suggest
+        # dispatch handles in flight feed `evaluators` concurrent workers
+        # through a completion queue.  overlap_suggest=True is the depth-1
+        # single-evaluator alias, which reproduces the historical overlap
+        # stream bit-for-bit; HYPEROPT_TPU_PIPELINE_DEPTH overrides the
+        # default depth process-wide.  Needs a dispatch-capable algo
+        # (tpe.suggest / suggest_quantile) and a synchronous backend; the
+        # in-flight posterior is up to D batches stale — the standard
+        # async-optimizer tradeoff, fantasy-compensated via Trials.inflight.
+        if overlap_depth is None:
+            env_depth = os.environ.get("HYPEROPT_TPU_PIPELINE_DEPTH", "")
+            if env_depth:
+                try:
+                    overlap_depth = int(env_depth)
+                except ValueError:
+                    logger.warning("ignoring non-integer "
+                                   "HYPEROPT_TPU_PIPELINE_DEPTH=%r", env_depth)
+        evaluators = 1 if evaluators is None else max(1, int(evaluators))
+        if overlap_depth is None:
+            depth = 1 if overlap_suggest else 0
+        else:
+            depth = max(0, int(overlap_depth))
+        if depth == 0 and evaluators > 1:
+            depth = 1  # concurrent evaluation needs the pipelined loop
+        self.overlap_depth = depth
+        self.evaluators = evaluators
+        self._pipeline = None
+        if depth > 0 and not self.asynchronous:
             fn, kw = algo, {}
             if isinstance(algo, partial) and not algo.args:
                 fn = algo.func
@@ -140,17 +164,38 @@ class FMinIter:
             d = getattr(fn, "dispatch", None)
             m = getattr(fn, "materialize", None)
             if d is not None and m is not None:
-                self._dispatch = lambda ids, dom, tr, seed: d(
-                    ids, dom, tr, seed, **kw)
-                self._materialize = m
-        self.overlap_suggest = self._dispatch is not None
+                from .pipeline import PipelinedExecutor
+                self._pipeline = PipelinedExecutor(
+                    self, depth=depth, evaluators=evaluators,
+                    dispatch=lambda ids, dom, tr, seed: d(
+                        ids, dom, tr, seed, **kw),
+                    materialize=m,
+                    handle_ready=getattr(fn, "handle_ready", None),
+                    start_transfer=getattr(fn, "start_transfer", None))
+        self.overlap_suggest = self._pipeline is not None
 
     # -- evaluation ---------------------------------------------------------
 
     def serial_evaluate(self, N=-1):
         _reg = _metrics.registry()
-        for trial in self.trials._dynamic_trials:
+        dyn = self.trials._dynamic_trials
+        # Monotone cursor: everything before it is settled (DONE/ERROR) —
+        # NEW trials only ever appear by append, so each batch scans the
+        # tail instead of re-walking the full log (10k-trial runs used to
+        # pay an O(N²) rescan here).  fmin.scan_skipped accumulates the
+        # avoided doc visits; the cursor stalls (never reverses) on
+        # transient RUNNING docs from async/pool backends.
+        cur = min(self._serial_cursor, len(dyn))
+        _reg.counter("fmin.scan_skipped").inc(cur)
+        advance = True
+        for i in range(cur, len(dyn)):
+            trial = dyn[i]
             if trial["state"] != JOB_STATE_NEW:
+                if advance and trial["state"] in (JOB_STATE_DONE,
+                                                  JOB_STATE_ERROR):
+                    self._serial_cursor = i + 1
+                else:
+                    advance = False
                 continue
             trial["state"] = JOB_STATE_RUNNING
             trial["book_time"] = coarse_utcnow()
@@ -177,6 +222,8 @@ class FMinIter:
                 EVENTS.emit("trial_end", trial=trial["tid"], state="done",
                             loss=result.get("loss"))
                 _reg.counter("fmin.trials.done").inc()
+            if advance:
+                self._serial_cursor = i + 1
             N -= 1
             if N == 0:
                 break
@@ -219,7 +266,9 @@ class FMinIter:
         """Enqueue up to ``max_queue_len`` new trials and evaluate/poll once.
 
         Returns True if the experiment should stop (algo exhausted or early
-        stop fired).
+        stop fired).  This is the plain (non-pipelined) loop body; when a
+        pipeline is configured, ``_loop`` delegates to
+        :class:`~hyperopt_tpu.pipeline.PipelinedExecutor` instead.
         """
         trials = self.trials
         stopped = False
@@ -231,20 +280,10 @@ class FMinIter:
         n_to_enqueue = min(self.max_queue_len - qlen, remaining)
         if n_to_enqueue > 0:
             with self.tracer.span("suggest"):
-                if self._pending_suggest is not None:
-                    # Dispatched during the previous batch's evaluation —
-                    # the device has (usually) already finished.  Clamp to
-                    # the CURRENT allowance: a pending K-batch that
-                    # outlived a stop condition (then run(N) resumed with
-                    # a smaller budget) must not overshoot max_evals.
-                    new_trials = self._materialize(
-                        self._pending_suggest)[:n_to_enqueue]
-                    self._pending_suggest = None
-                else:
-                    seed = int(self.rstate.integers(2 ** 31 - 1))
-                    new_ids = trials.new_trial_ids(n_to_enqueue)
-                    trials.refresh()
-                    new_trials = self.algo(new_ids, self.domain, trials, seed)
+                seed = int(self.rstate.integers(2 ** 31 - 1))
+                new_ids = trials.new_trial_ids(n_to_enqueue)
+                trials.refresh()
+                new_trials = self.algo(new_ids, self.domain, trials, seed)
                 EVENTS.emit("suggest",
                             n=0 if new_trials is None else len(new_trials))
             if new_trials is None or len(new_trials) == 0:
@@ -253,15 +292,6 @@ class FMinIter:
                 with self.tracer.span("store"):
                     trials.insert_trial_docs(new_trials)
                     trials.refresh()
-                if self.overlap_suggest and remaining > n_to_enqueue:
-                    # Pre-dispatch the NEXT batch before evaluating: it
-                    # conditions on history up to the previous batch and
-                    # computes on device while the host runs the objective.
-                    seed = int(self.rstate.integers(2 ** 31 - 1))
-                    ids = trials.new_trial_ids(
-                        min(self.max_queue_len, remaining - n_to_enqueue))
-                    self._pending_suggest = self._dispatch(
-                        ids, self.domain, trials, seed)
 
         if self.asynchronous:
             with self.tracer.span("poll"):
@@ -363,6 +393,9 @@ class FMinIter:
         progress_ctx = default_callback if self.show_progressbar \
             else no_progress_callback
         with progress_ctx(initial=self.n_done(), total=self.max_evals) as prog:
+            if self._pipeline is not None:
+                self._pipeline.run(prog)
+                return self
             while not self._stopped(self.n_done()):
                 before = self.n_done()
                 stopped = self.run_one_batch()
@@ -414,7 +447,8 @@ def fmin(fn, space, algo=None, max_evals=None,
          verbose=True, return_argmin=True,
          points_to_evaluate=None, max_queue_len=1,
          show_progressbar=True, early_stop_fn=None,
-         trials_save_file="", trace_dir=None, overlap_suggest=False):
+         trials_save_file="", trace_dir=None, overlap_suggest=False,
+         overlap_depth=None, evaluators=None):
     """Minimize ``fn`` over ``space`` using ``algo``.
 
     Reference-parity signature: ``hyperopt/fmin.py::fmin`` (SURVEY.md §2 L5).
@@ -428,11 +462,17 @@ def fmin(fn, space, algo=None, max_evals=None,
     checkpoint, auto-resume), ``early_stop_fn(trials, *args)->(stop, args)``,
     ``return_argmin`` (return best point dict vs None).
 
-    TPU-first addition: ``overlap_suggest=True`` pre-dispatches the next
-    suggest step on device while the host evaluates the current objective
-    (the PP-analog of SURVEY.md §2's parallelism table), hiding suggest
-    latency behind evaluation at the cost of a one-result-stale posterior.
-    Requires a dispatch-capable algo (``tpe.suggest`` /
+    TPU-first addition: the pipelined loop (``hyperopt_tpu/pipeline.py``).
+    ``overlap_depth=D`` keeps up to D suggest dispatches in flight on
+    device — each started with ``copy_to_host_async`` so materialization
+    never fetch-syncs — while ``evaluators=E`` worker threads run the
+    objective concurrently, recording results through a completion queue
+    as they land.  ``overlap_suggest=True`` is the ``overlap_depth=1,
+    evaluators=1`` alias and reproduces the historical overlap stream
+    bit-for-bit; ``HYPEROPT_TPU_PIPELINE_DEPTH`` overrides the default
+    depth process-wide.  The in-flight posterior is up to D batches stale
+    (constant-liar fantasies for pending trials compensate — Snoek et al.
+    2012).  Requires a dispatch-capable algo (``tpe.suggest`` /
     ``tpe.suggest_quantile``, optionally ``functools.partial``-bound);
     silently degrades to the ordinary loop otherwise.
     """
@@ -509,7 +549,8 @@ def fmin(fn, space, algo=None, max_evals=None,
                     loss_threshold=loss_threshold,
                     show_progressbar=show_progressbar and verbose,
                     verbose=verbose, trace_dir=trace_dir,
-                    overlap_suggest=overlap_suggest)
+                    overlap_suggest=overlap_suggest,
+                    overlap_depth=overlap_depth, evaluators=evaluators)
     rval.catch_eval_exceptions = catch_eval_exceptions
     rval.exhaust()
     rval._save_trials()

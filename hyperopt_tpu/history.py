@@ -214,6 +214,14 @@ def device_history(trials, cs, h, n_cap, fantasies=None, sharding=None,
     ``fantasies`` is ``(pv f32[M,P], pa bool[M,P], lie f32)`` — overlaid
     into rows ``[n, n+M)`` of a DERIVED copy (exactly where the legacy
     host-side concat put them) without dirtying the canonical buffers.
+    A LIST of such tuples is a multi-slot overlay: one slot per pending
+    batch (the depth-D pipeline keeps D batches in flight, each with its
+    own lie value), laid out contiguously from row ``n``.  Slots are
+    clipped to the capacity slack — ``dynamic_update_slice`` would
+    otherwise silently clamp the start index and overwrite REAL rows —
+    and every clipped fantasy row increments ``history.fantasy_clipped``
+    (``suggest_dispatch`` sizes the bucket to include fantasy rows, so a
+    nonzero count means a caller bypassed that sizing).
     ``sharding``/``shard_key`` pin mesh placement for the sharded suggest
     paths (replicated history); distinct placements keep distinct
     canonical buffers.
@@ -261,11 +269,23 @@ def device_history(trials, cs, h, n_cap, fantasies=None, sharding=None,
         # call): derive the exact-capacity view device-side.
         out = _fn("slice")(*out, n_cap)
     if fantasies is not None:
-        pv, pa, lie = fantasies
-        if sharding is not None:
-            pv, pa = _put((pv, pa), sharding)
-        out = _fn("overlay")(*out, pv, pa, np.float32(lie), np.int32(n))
-        reg.counter("history.upload_bytes").inc(len(pv) * (p * 4 + p))
+        slots = fantasies if isinstance(fantasies, list) else [fantasies]
+        idx = n
+        for pv, pa, lie in slots:
+            if not len(pv):
+                continue
+            room = n_cap - idx
+            if room <= 0:
+                reg.counter("history.fantasy_clipped").inc(len(pv))
+                continue
+            if len(pv) > room:
+                reg.counter("history.fantasy_clipped").inc(len(pv) - room)
+                pv, pa = pv[:room], pa[:room]
+            if sharding is not None:
+                pv, pa = _put((pv, pa), sharding)
+            out = _fn("overlay")(*out, pv, pa, np.float32(lie), np.int32(idx))
+            reg.counter("history.upload_bytes").inc(len(pv) * (p * 4 + p))
+            idx += len(pv)
     return out
 
 

@@ -69,6 +69,9 @@ EVENT_TYPES = frozenset(
         "transfer_drop",
         "span_begin",
         "span_end",
+        "pipeline_dispatch",
+        "pipeline_materialize",
+        "pipeline_cancel",
     }
 )
 

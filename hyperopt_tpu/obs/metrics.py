@@ -18,7 +18,25 @@ transfer contract (steady-state O(P) bytes/trial, asserted in
 tests/test_history.py) — and ``suggest.upload_ms`` /
 ``suggest.dispatch_ms`` / ``suggest.fetch_sync_ms``, the host-loop
 phase breakdown ``bench.py``'s trials_sec phase snapshots into its
-``loop_breakdown`` artifact field.
+``loop_breakdown`` artifact field.  Each ``suggest.*_ms`` name is fed
+**twice** per sample: the counter accumulates total milliseconds (the
+legacy breakdown contract) and a same-named millisecond-bucketed
+histogram (50µs .. ~26s, ×2/bucket) records the distribution so the
+pipeline bench can report p50/p95 stall times via ``summary()``.
+
+Pipeline-executor series (fed by ``hyperopt_tpu.pipeline``):
+``pipeline.occupancy`` (gauge+histogram, in-flight suggest handles
+after each dispatch), ``pipeline.eval_backlog`` (gauge, trials
+submitted to the evaluator and not yet recorded),
+``pipeline.stall.suggest_bound`` (counter, times the executor wanted
+to feed the evaluator but the head handle was still computing) with
+``pipeline.stall.suggest_bound_ms`` (counter+histogram, time blocked
+materializing a not-yet-ready head), ``pipeline.stall.eval_bound``
+(counter, times every slot was ready but the evaluator was still
+busy), ``history.fantasy_clipped`` (counter, fantasy rows dropped at
+the overlay capacity edge — nonzero means a dispatch under-sized its
+bucket), and ``fmin.scan_skipped`` (counter, dynamic-trial docs the
+``serial_evaluate`` monotone cursor avoided re-scanning).
 
 Also home to the TPE kernel-cache compile-shape counters
 (:func:`kernel_cache_event` / :func:`kernel_cache_stats`), relocated
@@ -157,6 +175,7 @@ class Histogram:
                 "max": self._max,
                 "p50": self._quantile_locked(0.50),
                 "p90": self._quantile_locked(0.90),
+                "p95": self._quantile_locked(0.95),
                 "p99": self._quantile_locked(0.99),
             }
 

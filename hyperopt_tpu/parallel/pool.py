@@ -344,3 +344,165 @@ class PoolTrials(Trials):
                         self._ensure_pool().submit(self._run_guarded,
                                                    run, doc, ev)
         super().refresh()
+
+
+# ---------------------------------------------------------------------------
+# CompletionQueueEvaluator — the pipelined fmin loop's evaluator stage
+# ---------------------------------------------------------------------------
+
+
+class _EvalItem:
+    """One submitted trial travelling worker-ward: the inserted doc, its
+    pre-built Ctrl, and an opaque scheduling token (the executor's batch
+    record).  ``started``/``cancelled`` are guarded by the evaluator lock
+    so cooperative cancellation cannot race the worker's pickup."""
+
+    __slots__ = ("doc", "ctrl", "token", "started", "cancelled")
+
+    def __init__(self, doc, ctrl, token):
+        self.doc = doc
+        self.ctrl = ctrl
+        self.token = token
+        self.started = False
+        self.cancelled = False
+
+
+_EVAL_STOP = object()
+
+
+class CompletionQueueEvaluator:
+    """Concurrent evaluator stage feeding a completion queue.
+
+    The adapter between ``hyperopt_tpu.pipeline.PipelinedExecutor`` and
+    this module's execution machinery: the executor submits inserted
+    trial docs; ``n_workers`` workers run ONLY ``domain.evaluate`` and
+    push ``(item, kind, payload)`` onto the completion queue, where
+    ``kind`` is ``"ok"`` (payload: result dict), ``"error"`` (payload:
+    the exception) or ``"cancelled"`` (queued item skipped after
+    :meth:`cancel_all`).  Every Trials mutation — state flips, result
+    recording, ``refresh()`` — stays on the submitting thread, so the
+    executor needs no cross-thread locking beyond the queues themselves
+    and recording order with one worker is exactly submission order
+    (the determinism contract tests/test_pipeline.py pins).
+
+    ``execution="process"`` forks one child per trial (the
+    :func:`_child_eval` entry ``PoolTrials`` uses) for objectives that
+    must not share the parent's interpreter; cancellation then
+    SIGTERMs children instead of waiting them out.
+    """
+
+    def __init__(self, domain, n_workers: int = 1, execution: str = "thread",
+                 name: str = "fmin-eval"):
+        if execution not in ("thread", "process"):
+            raise ValueError(
+                f"execution must be 'thread' or 'process', got {execution!r}")
+        import queue as _queue
+
+        self._domain = domain
+        self.execution = execution
+        self._work: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._done: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._empty_exc = _queue.Empty
+        self._lock = threading.Lock()
+        self._outstanding: list = []
+        self._procs: dict = {}            # id(item) -> live child process
+        self._threads = [
+            threading.Thread(target=self._worker, name=f"{name}-{i}",
+                             daemon=True)
+            for i in range(max(1, int(n_workers)))
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- submit side -----------------------------------------------------
+    def submit(self, doc, ctrl, token=None) -> None:
+        item = _EvalItem(doc, ctrl, token)
+        with self._lock:
+            self._outstanding.append(item)
+        self._work.put(item)
+
+    def get(self, timeout=None):
+        """Next completion ``(item, kind, payload)`` or None on timeout."""
+        try:
+            return self._done.get(timeout=timeout)
+        except self._empty_exc:
+            return None
+
+    def task_done(self, item) -> None:
+        with self._lock:
+            try:
+                self._outstanding.remove(item)
+            except ValueError:
+                pass
+
+    def cancel_all(self) -> int:
+        """Cooperatively cancel everything not yet started; returns how
+        many queued items will come back ``"cancelled"``.  Started
+        thread-mode objectives run to completion (threads cannot be
+        killed — the PoolTrials caveat); process-mode children are
+        SIGTERMed and surface as ``"error"`` completions."""
+        n = 0
+        with self._lock:
+            for item in self._outstanding:
+                if not item.started and not item.cancelled:
+                    item.cancelled = True
+                    n += 1
+            procs = list(self._procs.values())
+        for proc in procs:
+            try:
+                proc.terminate()
+            except Exception:  # pragma: no cover - already dead
+                pass
+        return n
+
+    def shutdown(self) -> None:
+        for _ in self._threads:
+            self._work.put(_EVAL_STOP)
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    # -- worker side -----------------------------------------------------
+    def _worker(self) -> None:
+        while True:
+            item = self._work.get()
+            if item is _EVAL_STOP:
+                return
+            with self._lock:
+                if item.cancelled:
+                    self._done.put((item, "cancelled", None))
+                    continue
+                item.started = True
+            EVENTS.emit("trial_start", trial=item.doc["tid"])
+            try:
+                spec = base.spec_from_misc(item.doc["misc"])
+                if self.execution == "process":
+                    result = self._eval_in_child(item, spec)
+                else:
+                    result = self._domain.evaluate(spec, item.ctrl)
+            except Exception as e:  # noqa: BLE001 — marshalled to recorder
+                self._done.put((item, "error", e))
+            else:
+                self._done.put((item, "ok", result))
+
+    def _eval_in_child(self, item, spec):
+        parent_conn, child_conn = multiprocessing.Pipe(duplex=False)
+        proc = multiprocessing.Process(
+            target=_child_eval, args=(self._domain, spec, child_conn),
+            daemon=True)
+        with self._lock:
+            self._procs[id(item)] = proc
+        try:
+            proc.start()
+            child_conn.close()
+            try:
+                msg = parent_conn.recv()
+            except (EOFError, OSError) as e:
+                raise RuntimeError(f"evaluation child died: {e}") from None
+            if msg[0] == "ok":
+                return msg[1]
+            raise RuntimeError(f"{msg[1]}: {msg[2]}")
+        finally:
+            with self._lock:
+                self._procs.pop(id(item), None)
+            parent_conn.close()
+            proc.join(timeout=5.0)

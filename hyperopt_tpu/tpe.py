@@ -86,6 +86,22 @@ _default_linear_forgetting = 25
 _TINY = 1e-12
 _LOG_KINDS = (LOGUNIFORM, QLOGUNIFORM, LOGNORMAL, QLOGNORMAL)
 
+# Histogram bucket bounds in MILLISECONDS for the suggest.*_ms stall
+# series: 50µs .. ~26s, ×2 per bucket (the registry default is in
+# seconds, which would collapse every ms-unit observation into the
+# bottom buckets).
+_MS_BUCKETS = tuple(0.05 * (2.0 ** i) for i in range(20))
+
+
+def _obs_ms(reg, name, ms):
+    """Record a loop-phase duration both ways: the counter keeps the
+    running total ``bench.py`` diffs into ``loop_breakdown``, the
+    same-named histogram gives the pipeline phase p50/p95 stall times
+    (counters and histograms live in separate registry tables, so
+    sharing the name is intentional)."""
+    reg.counter(name).inc(ms)
+    reg.histogram(name, buckets=_MS_BUCKETS).observe(ms)
+
 
 def _pallas_mode() -> str:
     """Select the density-EI execution path.
@@ -1188,7 +1204,7 @@ def suggest_dispatch(new_ids, domain, trials, seed,
     else:
         hv, ha, hl, hok = _padded_history(h, kern.n_cap)
     reg = _metrics_registry()
-    reg.counter("suggest.upload_ms").inc((perf_counter() - t_feed) * 1e3)
+    _obs_ms(reg, "suggest.upload_ms", (perf_counter() - t_feed) * 1e3)
     t_disp = perf_counter()
     seed32 = int(seed) % (2 ** 32)
     if n == 1:
@@ -1207,7 +1223,7 @@ def suggest_dispatch(new_ids, domain, trials, seed,
         # too so the last trial doesn't pay a compile stall (round-3
         # advisor finding).
         _prewarm_async(kern, n=1)
-    reg.counter("suggest.dispatch_ms").inc((perf_counter() - t_disp) * 1e3)
+    _obs_ms(reg, "suggest.dispatch_ms", (perf_counter() - t_disp) * 1e3)
     return ("pending", cs, list(new_ids), arrs, exp_key)
 
 
@@ -1225,8 +1241,8 @@ def _force_rows(handle):
     if tag == "pending":
         t0 = perf_counter()
         rows = np.asarray(rows)   # THE device sync of the suggest step
-        _metrics_registry().counter("suggest.fetch_sync_ms").inc(
-            (perf_counter() - t0) * 1e3)
+        _obs_ms(_metrics_registry(), "suggest.fetch_sync_ms",
+                (perf_counter() - t0) * 1e3)
     else:
         rows = np.asarray(rows)
     if rows.ndim == 1:
@@ -1251,8 +1267,45 @@ def suggest_materialize(handle):
     return base.docs_from_samples(cs, new_ids, rows, acts, exp_key=exp_key)
 
 
+def suggest_start_transfer(handle):
+    """Begin the device→host copy of a pending handle's rows WITHOUT
+    blocking (``jax.Array.copy_to_host_async``).
+
+    The pipelined executor calls this right after dispatch so the fetch
+    sync — ~66 ms per materialize through the axon tunnel — streams
+    while the host evaluates objectives; by the time
+    :func:`suggest_materialize` forces the rows, the bytes are already
+    local.  Only the values array is pre-fetched (the activity mask is
+    rebuilt host-side, the same single-sync contract as
+    ``_force_rows``).  A no-op on ready handles or array types without
+    the method (graceful sync-materialize fallback)."""
+    if handle[0] != "pending":
+        return handle
+    try:
+        handle[3][0].copy_to_host_async()
+    except AttributeError:
+        pass
+    return handle
+
+
+def suggest_handle_ready(handle) -> bool:
+    """True when :func:`suggest_materialize` will not block on device
+    compute or transfer (``jax.Array.is_ready``).  The executor polls
+    this for stall attribution (suggest-bound vs eval-bound) rather
+    than fetch-syncing; handles without the method report ready, which
+    degrades to a blocking materialize."""
+    if handle[0] != "pending":
+        return True
+    try:
+        return bool(handle[3][0].is_ready())
+    except AttributeError:
+        return True
+
+
 suggest.dispatch = suggest_dispatch
 suggest.materialize = suggest_materialize
+suggest.start_transfer = suggest_start_transfer
+suggest.handle_ready = suggest_handle_ready
 
 
 def suggest_quantile(new_ids, domain, trials, seed, **kwargs):
@@ -1272,3 +1325,5 @@ def _quantile_dispatch(new_ids, domain, trials, seed, **kwargs):
 
 suggest_quantile.dispatch = _quantile_dispatch
 suggest_quantile.materialize = suggest_materialize
+suggest_quantile.start_transfer = suggest_start_transfer
+suggest_quantile.handle_ready = suggest_handle_ready

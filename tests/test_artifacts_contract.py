@@ -98,6 +98,43 @@ class TestBenchmarkArtifacts:
                 f"{name} now carries the full header — remove it from "
                 "_LEGACY_ARTIFACTS")
 
+    def test_pipeline_ab_artifact_schema(self):
+        """ISSUE 4 acceptance artifact: per-depth rows with backend/
+        metric/timestamp attribution, the depth-1 parity bit, and the
+        ≥1.5x acceptance headline — written by benchmarks/pipeline_ab.py.
+        """
+        paths = sorted(glob.glob(os.path.join(_BENCH_DIR,
+                                              "pipeline_ab_*.json")))
+        assert paths, "no benchmarks/pipeline_ab_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "pipeline_trials_per_sec", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            assert doc["evaluators"] >= 1
+            assert doc["rows"], f"{name}: empty rows"
+            for r in doc["rows"]:
+                assert {"depth", "objective_ms", "trials_per_sec",
+                        "speedup_vs_depth1"} <= set(r), f"{name}: {r}"
+                assert r["depth"] in doc["depths"]
+                assert r["objective_ms"] in doc["objective_ms"]
+            # every (depth, objective_ms[, fetch_sim_ms]) cell is present
+            sims = doc.get("fetch_sim_ms", [0])
+            assert len(doc["rows"]) == (len(doc["depths"])
+                                        * len(doc["objective_ms"])
+                                        * len(sims)), name
+            assert doc["parity"]["bit_identical"] is True, (
+                f"{name}: depth-1 executor stream diverged from the "
+                "replaced overlap loop")
+            head = doc["headline"]
+            assert head["objective_ms"] == 25
+            assert head["depth2_speedup"] >= 1.5, (
+                f"{name}: depth-2 speedup {head['depth2_speedup']} below "
+                "the 1.5x acceptance bar")
+            assert head["meets_1p5x"] is True
+
     def test_device_ab_artifact_matches_its_bench(self):
         # the r6 device A/B (5 domains x 20 seeds, one conditional space)
         path = os.path.join(_BENCH_DIR, "quality_ab_fmin_vs_fmin_device.json")

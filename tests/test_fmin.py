@@ -403,13 +403,13 @@ class TestOverlapSuggest:
         assert sorted(d["tid"] for d in t) == list(range(36))
 
     def test_clamped_resume_pending_batch(self):
-        """Stop mid-run with a pre-dispatched K-batch still pending, then
-        resume with a smaller budget: the ``[:n_to_enqueue]`` clamp discards
-        the surplus proposals WITH their pre-allocated tids.  The dropped
-        tids leave a gap at the top, which is safe only because
-        ``new_trial_ids`` derives from the max existing tid — this test
-        pins that invariant (round-3 advisor finding): exact trial count,
-        no duplicate tids, and clean continuation after the gap."""
+        """Stop mid-run with a pre-dispatched K-batch still in flight, then
+        resume with a smaller budget.  The pipelined executor discards the
+        un-materialized ring handle at drain time — its pre-allocated tids
+        were never inserted, so the resume re-allocates from the max
+        EXISTING tid with no gap and no duplicates (round-3 advisor
+        finding, re-pinned against the executor): exact trial count,
+        contiguous tids, clean continuation."""
         from hyperopt_tpu.base import Domain
         from hyperopt_tpu.fmin import FMinIter
 
@@ -427,13 +427,14 @@ class TestOverlapSuggest:
                       max_queue_len=4, overlap_suggest=True,
                       show_progressbar=False, early_stop_fn=early_stop)
         # Batch 1: enqueue tids 0-3, pre-dispatch tids 4-7, evaluate,
-        # early-stop fires -> run ends holding the pending 4-batch.
+        # early-stop fires -> the in-flight handle is discarded (its tids
+        # were never inserted).
         it.run(8)
         assert it.n_done() == 4
-        assert it._pending_suggest is not None
+        assert sorted(doc["tid"] for doc in t) == list(range(4))
 
-        # Resume with a SMALLER allowance (2 < K=4): the pending batch is
-        # clamped, tids 6-7 silently dropped.
+        # Resume with a SMALLER allowance (2 < K=4): a fresh dispatch is
+        # sized to the remaining budget.
         it.early_stop_fn = None
         armed["stop"] = False
         it.run(2)

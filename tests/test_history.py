@@ -175,6 +175,48 @@ class TestFeedBitEquality:
         self._check(trials, cs, bad, 32)                # prefix mismatch
         assert _counter("history.rebuilds") == r0 + 2
 
+    def test_multi_slot_fantasy_overlay(self, rng):
+        """A LIST of fantasy slots (one per in-flight pipeline batch) lays
+        out contiguously from row n — bit-identical to one host-side
+        concat of all slots, each keeping its own lie value."""
+        trials, cs = self._T(), object()
+        p = 3
+        h = self._h(rng, 6, p)
+        s1 = (rng.standard_normal((2, p)).astype(np.float32),
+              np.ones((2, p), bool), np.float32(0.5))
+        s2 = (rng.standard_normal((3, p)).astype(np.float32),
+              np.ones((3, p), bool), np.float32(-1.25))
+        got = rhist.device_history(trials, cs, h, 16, fantasies=[s1, s2])
+        want = _padded_history(dict(
+            vals=np.concatenate([h["vals"], s1[0], s2[0]]),
+            active=np.concatenate([h["active"], s1[1], s2[1]]),
+            loss=np.concatenate([h["loss"],
+                                 np.full(2, s1[2], np.float32),
+                                 np.full(3, s2[2], np.float32)]),
+            ok=np.concatenate([h["ok"], np.ones(5, bool)])), 16)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(np.asarray(g), w)
+        # Overlays must not dirty the canonical buffers:
+        self._check(trials, cs, h, 16)
+
+    def test_fantasy_overlay_clips_at_capacity(self, rng):
+        """Slots that would spill past n_cap are clipped (and counted)
+        instead of letting dynamic_update_slice clamp the start index
+        back over REAL history rows."""
+        trials, cs = self._T(), object()
+        p = 2
+        h = self._h(rng, 4, p)
+        c0 = _counter("history.fantasy_clipped")
+        s1 = (rng.standard_normal((2, p)).astype(np.float32),
+              np.ones((2, p), bool), np.float32(0.0))   # fills cap exactly
+        s2 = (rng.standard_normal((2, p)).astype(np.float32),
+              np.ones((2, p), bool), np.float32(1.0))   # no room left
+        got = rhist.device_history(trials, cs, h, 6, fantasies=[s1, s2])
+        assert _counter("history.fantasy_clipped") == c0 + 2
+        hv = np.asarray(got[0])
+        np.testing.assert_array_equal(hv[:4], h["vals"])  # real rows intact
+        np.testing.assert_array_equal(hv[4:6], s1[0])
+
     def test_forget_drops_state(self, rng):
         trials, cs = self._T(), object()
         h = self._h(rng, 3, 2)

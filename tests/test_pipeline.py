@@ -1,0 +1,260 @@
+"""Pipelined executor tests (hyperopt_tpu/pipeline.py — ISSUE 4).
+
+Contracts pinned here:
+
+* **Depth-1 parity** — a seeded ``overlap_suggest=True`` run through the
+  executor is bit-identical (tids, proposal vals, losses) to the
+  REPLACED depth-1 overlap loop, replicated inline as a reference
+  generator with the same rstate draw order (seed before ids, one draw
+  per dispatched batch).
+* **Depth-D determinism** — with one evaluator the completion queue is
+  FIFO, so two identically-seeded depth-D runs produce identical trial
+  histories.
+* **Tid uniqueness under concurrency** — executor-side id allocation
+  plus calling-thread-only insertion means no duplicate tids even with
+  several evaluator threads recording out of order.
+* **Cancellation drains** — timeout / early-stop / objective exception
+  leaves no trial RUNNING: un-materialized handles are discarded, queued
+  evaluations are cancelled, started ones run out and record.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import hyperopt_tpu as ht
+from hyperopt_tpu import hp, rand
+from hyperopt_tpu.base import (
+    Ctrl,
+    Domain,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    spec_from_misc,
+)
+from hyperopt_tpu.obs.metrics import registry
+
+SPACE = {"x": hp.uniform("x", -5, 5), "y": hp.normal("y", 0, 2)}
+ALGO_KW = dict(n_startup_jobs=4, n_EI_candidates=32)
+
+
+def _obj(p):
+    return (p["x"] - 1.0) ** 2 + p["y"] ** 2
+
+
+def _counter(name):
+    return registry().snapshot()["counters"].get(name, 0.0)
+
+
+def _stream(t):
+    """(tid, vals, loss) tuples in storage order — the parity currency."""
+    return [(d["tid"],
+             {k: tuple(v) for k, v in d["misc"]["vals"].items()},
+             d["result"].get("loss"))
+            for d in t.trials]
+
+
+def _reference_overlap_stream(seed, max_evals, Q):
+    """Inline replica of the REPLACED depth-1 ``overlap_suggest`` loop
+    (fmin.run_one_batch at the pre-executor revision): materialize the
+    pending batch (clamped), insert, pre-dispatch the next batch
+    conditioned on the just-inserted NEW trials, then evaluate serially.
+    The rstate draw order — one ``integers(2**31-1)`` per dispatched
+    batch, drawn BEFORE ``new_trial_ids`` — is the parity-critical part.
+    """
+    domain = Domain(_obj, SPACE)
+    trials = ht.Trials()
+    rstate = np.random.default_rng(seed)
+    dispatch = ht.tpe.suggest.dispatch
+    materialize = ht.tpe.suggest.materialize
+    pending = None
+
+    def n_done():
+        return sum(d["state"] in (JOB_STATE_DONE, JOB_STATE_ERROR)
+                   for d in trials._dynamic_trials)
+
+    while n_done() < max_evals:
+        remaining = max_evals - len(trials._dynamic_trials)
+        n_to_enqueue = min(Q, remaining)
+        if pending is not None:
+            docs = materialize(pending)[:n_to_enqueue]
+            pending = None
+        else:
+            s = int(rstate.integers(2 ** 31 - 1))
+            ids = trials.new_trial_ids(n_to_enqueue)
+            trials.refresh()
+            docs = ht.tpe.suggest(ids, domain, trials, s, **ALGO_KW)
+        if not docs:
+            break
+        trials.insert_trial_docs(docs)
+        trials.refresh()
+        if remaining > n_to_enqueue:
+            s = int(rstate.integers(2 ** 31 - 1))
+            ids = trials.new_trial_ids(min(Q, remaining - n_to_enqueue))
+            pending = dispatch(ids, domain, trials, s, **ALGO_KW)
+        for doc in trials._dynamic_trials:
+            if doc["state"] == JOB_STATE_NEW:
+                doc["state"] = JOB_STATE_RUNNING
+                doc["result"] = domain.evaluate(
+                    spec_from_misc(doc["misc"]),
+                    Ctrl(trials, current_trial=doc))
+                doc["state"] = JOB_STATE_DONE
+        trials.refresh()
+    return trials
+
+
+class TestDepth1Parity:
+    @pytest.mark.parametrize("Q,max_evals", [(1, 18), (4, 19)])
+    def test_bit_identical_vs_replaced_overlap_loop(self, Q, max_evals):
+        ref = _reference_overlap_stream(42, max_evals, Q)
+        t = ht.Trials()
+        ht.fmin(_obj, SPACE, algo=ht.partial(ht.tpe.suggest, **ALGO_KW),
+                max_evals=max_evals, max_queue_len=Q, trials=t,
+                rstate=np.random.default_rng(42), show_progressbar=False,
+                overlap_suggest=True)
+        assert _stream(t) == _stream(ref)
+
+    def test_depth1_kwarg_is_the_overlap_alias(self):
+        a, b = ht.Trials(), ht.Trials()
+        kw = dict(algo=ht.partial(ht.tpe.suggest, **ALGO_KW), max_evals=14,
+                  show_progressbar=False)
+        ht.fmin(_obj, SPACE, trials=a, rstate=np.random.default_rng(3),
+                overlap_suggest=True, **kw)
+        ht.fmin(_obj, SPACE, trials=b, rstate=np.random.default_rng(3),
+                overlap_depth=1, **kw)
+        assert _stream(a) == _stream(b)
+
+
+class TestDepthD:
+    def test_deterministic_given_seed(self):
+        runs = []
+        for _ in range(2):
+            t = ht.Trials()
+            ht.fmin(_obj, SPACE, algo=ht.partial(ht.tpe.suggest, **ALGO_KW),
+                    max_evals=24, max_queue_len=2, trials=t,
+                    rstate=np.random.default_rng(9), show_progressbar=False,
+                    overlap_depth=3)
+            runs.append(_stream(t))
+        assert runs[0] == runs[1]
+        assert len(runs[0]) == 24
+
+    def test_no_duplicate_tids_concurrent_recording(self):
+        def bumpy(p):
+            # Deterministic per-trial jitter so evaluator threads finish
+            # out of submission order.
+            time.sleep(0.001 + (abs(p["x"]) % 0.01))
+            return _obj(p)
+
+        t = ht.Trials()
+        ht.fmin(bumpy, SPACE, algo=ht.partial(ht.tpe.suggest, **ALGO_KW),
+                max_evals=30, max_queue_len=2, trials=t,
+                rstate=np.random.default_rng(5), show_progressbar=False,
+                overlap_depth=4, evaluators=3)
+        tids = sorted(d["tid"] for d in t)
+        assert tids == list(range(30))
+        assert all(d["state"] == JOB_STATE_DONE for d in t)
+
+    def test_occupancy_and_stall_metrics(self):
+        t = ht.Trials()
+        ht.fmin(lambda p: (time.sleep(0.002), _obj(p))[1], SPACE,
+                algo=ht.partial(ht.tpe.suggest, **ALGO_KW),
+                max_evals=16, max_queue_len=2, trials=t,
+                rstate=np.random.default_rng(2), show_progressbar=False,
+                overlap_depth=4)
+        snap = registry().snapshot()
+        assert snap["gauges"]["pipeline.occupancy"] == 0.0   # drained
+        assert snap["histograms"]["pipeline.occupancy"]["count"] > 0
+        # suggest.*_ms series now carry p50/p95 (ISSUE 4 satellite):
+        hs = snap["histograms"]["suggest.dispatch_ms"]
+        assert hs["count"] > 0 and hs["p95"] >= hs["p50"] > 0
+
+
+class TestCancellation:
+    def test_timeout_drains_without_orphaned_running(self):
+        def slow(p):
+            time.sleep(0.15)
+            return _obj(p)
+
+        t = ht.Trials()
+        ht.fmin(slow, SPACE, algo=ht.partial(ht.tpe.suggest, **ALGO_KW),
+                max_evals=200, max_queue_len=2, trials=t,
+                rstate=np.random.default_rng(0), show_progressbar=False,
+                overlap_depth=4, evaluators=2, timeout=1.2)
+        states = [d["state"] for d in t]
+        assert JOB_STATE_RUNNING not in states
+        assert JOB_STATE_NEW not in states
+        assert len(t) < 200
+        for d in t:
+            if d["state"] == JOB_STATE_ERROR:
+                assert d["misc"]["error"][0] == "Cancelled"
+
+    def test_early_stop_discards_ring(self):
+        from hyperopt_tpu.utils.early_stop import no_progress_loss
+
+        t = ht.Trials()
+        ht.fmin(_obj, SPACE, algo=ht.partial(ht.tpe.suggest, **ALGO_KW),
+                max_evals=100, trials=t, rstate=np.random.default_rng(7),
+                show_progressbar=False, overlap_depth=4,
+                early_stop_fn=no_progress_loss(5))
+        assert 0 < len(t) < 100
+        assert all(d["state"] == JOB_STATE_DONE for d in t)
+
+    def test_objective_exception_propagates_and_drains(self):
+        def boom(p):
+            raise RuntimeError("boom")
+
+        t = ht.Trials()
+        with pytest.raises(RuntimeError, match="boom"):
+            ht.fmin(boom, SPACE, algo=ht.partial(ht.tpe.suggest, **ALGO_KW),
+                    max_evals=10, trials=t, rstate=np.random.default_rng(1),
+                    show_progressbar=False, overlap_depth=2)
+        assert JOB_STATE_RUNNING not in [d["state"] for d in t]
+
+
+class TestSerialCursor:
+    def test_scan_skipped_counter_proves_o_n(self):
+        """10 single-trial batches: the monotone cursor skips the done
+        prefix each pass (0+1+...+9) plus one full skip in the final
+        block_until_done sweep — 55 avoided doc visits.  The legacy
+        rescans would have re-walked every doc and skipped none."""
+        c0 = _counter("fmin.scan_skipped")
+        t = ht.Trials()
+        ht.fmin(_obj, SPACE, algo=rand.suggest, max_evals=10,
+                max_queue_len=1, trials=t,
+                rstate=np.random.default_rng(0), show_progressbar=False)
+        assert len(t) == 10
+        assert _counter("fmin.scan_skipped") - c0 == sum(range(10)) + 10
+
+
+class TestConfig:
+    def test_env_depth_override(self, monkeypatch):
+        from hyperopt_tpu.fmin import FMinIter
+
+        monkeypatch.setenv("HYPEROPT_TPU_PIPELINE_DEPTH", "3")
+        d = Domain(_obj, SPACE)
+        it = FMinIter(ht.tpe.suggest, d, ht.Trials(),
+                      rstate=np.random.default_rng(0),
+                      show_progressbar=False)
+        assert it.overlap_depth == 3
+        assert it._pipeline is not None and it._pipeline.depth == 3
+
+    def test_env_depth_bad_value_ignored(self, monkeypatch):
+        from hyperopt_tpu.fmin import FMinIter
+
+        monkeypatch.setenv("HYPEROPT_TPU_PIPELINE_DEPTH", "garbage")
+        d = Domain(_obj, SPACE)
+        it = FMinIter(ht.tpe.suggest, d, ht.Trials(),
+                      rstate=np.random.default_rng(0),
+                      show_progressbar=False)
+        assert it.overlap_depth == 0
+        assert it._pipeline is None
+
+    def test_non_dispatch_algo_degrades(self):
+        t = ht.Trials()
+        ht.fmin(_obj, SPACE, algo=rand.suggest, max_evals=8, trials=t,
+                rstate=np.random.default_rng(4), show_progressbar=False,
+                overlap_depth=4, evaluators=2)
+        assert len(t) == 8
+        assert all(d["state"] == JOB_STATE_DONE for d in t)

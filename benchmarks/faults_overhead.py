@@ -1,0 +1,155 @@
+"""CPU A/B: fault-injection hooks disabled vs armed-at-zero-probability.
+
+ISSUE 5's overhead contract: the ``maybe_fail`` hooks live permanently in
+the hot paths (``Domain.evaluate``, every netstore RPC, the file store's
+atomic write, the pipeline dispatch), so the DISABLED path must be
+indistinguishable from not having the subsystem at all.  Two probes:
+
+1. **Microbench** — ``maybe_fail`` ns/op with the registry disarmed (the
+   single module-global boolean check every production call pays) and
+   armed at prob=0.0 (the locked dict-lookup + RNG draw worst case that
+   only chaos runs ever see).
+2. **End-to-end A/B** — the same seeded serial fmin, paired arms run
+   back-to-back: hooks disarmed vs armed with a zero-probability
+   schedule on every core fault point (the maximum-bookkeeping,
+   zero-injection configuration).
+
+Run::
+
+    env JAX_PLATFORMS=cpu python benchmarks/faults_overhead.py
+
+Writes ``benchmarks/faults_overhead_cpu_<stamp>.json``.  The budget note
+lives in DESIGN.md §6.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+N_EVALS = 150
+N_MICRO = 200_000
+SEED = 0
+
+# Arm every core point at prob=0.0: full registry bookkeeping (lock, dict
+# lookup, call counter, RNG draw), zero injections — the worst case a
+# NON-chaos run could ever be configured into by accident.
+_ZERO_PROB = {p: 0.0 for p in ("rpc.send", "rpc.recv", "store.write",
+                               "worker.evaluate", "objective.call",
+                               "pipeline.dispatch")}
+
+
+def _space():
+    import hyperopt_tpu as ho
+
+    hp = ho.hp
+    return {
+        "x": hp.uniform("x", -5, 5),
+        "lr": hp.loguniform("lr", -5, 0),
+        "c": hp.choice("c", [0, 1, 2]),
+    }
+
+
+def _objective(cfg):
+    return float(cfg["x"] ** 2 + 0.1 * cfg["c"])
+
+
+def _micro(armed: bool) -> float:
+    """ns per maybe_fail call."""
+    from hyperopt_tpu import faults
+
+    if armed:
+        faults.configure(_ZERO_PROB, seed=SEED)
+    else:
+        faults.clear()
+    mf = faults.maybe_fail
+    mf("objective.call")  # warm
+    t0 = time.perf_counter()
+    for _ in range(N_MICRO):
+        mf("objective.call")
+    ns = (time.perf_counter() - t0) / N_MICRO * 1e9
+    faults.clear()
+    return ns
+
+
+def _fmin_arm(armed: bool) -> float:
+    """trials/sec for one seeded serial run."""
+    import hyperopt_tpu as ho
+    from hyperopt_tpu import faults
+
+    if armed:
+        faults.configure(_ZERO_PROB, seed=SEED)
+    else:
+        faults.clear()
+    t = ho.Trials()
+    t0 = time.perf_counter()
+    ho.fmin(_objective, _space(), algo=ho.tpe.suggest, max_evals=N_EVALS,
+            trials=t, rstate=np.random.default_rng(SEED),
+            show_progressbar=False)
+    tps = N_EVALS / (time.perf_counter() - t0)
+    faults.clear()
+    assert len(t) == N_EVALS
+    return tps
+
+
+def main():
+    from hyperopt_tpu import faults
+
+    # Warm-up absorbs every compile; then interleave paired arms A/B/A/B
+    # so drift (thermal, background load) cancels instead of biasing one.
+    _fmin_arm(False)
+    reps = 3
+    tps_off, tps_on = [], []
+    for _ in range(reps):
+        tps_off.append(_fmin_arm(False))
+        tps_on.append(_fmin_arm(True))
+
+    ns_off = _micro(False)
+    ns_on = _micro(True)
+    assert not faults.is_active()
+
+    med_off = float(np.median(tps_off))
+    med_on = float(np.median(tps_on))
+    overhead_pct = (med_off - med_on) / med_off * 100.0
+
+    doc = {
+        "metric": "faults_overhead_disabled_vs_armed_zero_prob",
+        "backend": "cpu",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "n_evals": N_EVALS,
+        "reps": reps,
+        "seed": SEED,
+        "headline": {
+            "maybe_fail_disabled_ns": round(ns_off, 1),
+            "maybe_fail_armed_zero_prob_ns": round(ns_on, 1),
+            "fmin_overhead_pct_armed_vs_disabled": round(overhead_pct, 2),
+        },
+        "rows": [
+            {"mode": "faults_disabled",
+             "trials_per_sec_median": round(med_off, 2),
+             "trials_per_sec_all": [round(v, 2) for v in tps_off],
+             "maybe_fail_ns": round(ns_off, 1)},
+            {"mode": "faults_armed_zero_prob",
+             "trials_per_sec_median": round(med_on, 2),
+             "trials_per_sec_all": [round(v, 2) for v in tps_on],
+             "maybe_fail_ns": round(ns_on, 1)},
+        ],
+    }
+    stamp = time.strftime("%Y%m%d")
+    path = os.path.join(_ROOT, "benchmarks",
+                        f"faults_overhead_cpu_{stamp}.json")
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    print(json.dumps(doc, indent=1))
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

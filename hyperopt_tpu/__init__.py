@@ -49,8 +49,12 @@ from .exceptions import (  # noqa: F401
     AllTrialsFailed,
     DuplicateLabel,
     HyperoptTpuError,
+    InjectedFault,
     InvalidTrial,
+    NetstoreUnavailable,
+    TransientEvaluationError,
 )
+from . import faults  # noqa: F401 — seeded fault-injection registry
 from .fmin import (  # noqa: F401
     FMinIter,
     fmin,
@@ -94,4 +98,6 @@ __all__ = [
     "JOB_STATE_NEW", "JOB_STATE_RUNNING", "JOB_STATE_DONE",
     "JOB_STATE_ERROR", "JOB_STATE_CANCEL", "JOB_STATES",
     "AllTrialsFailed", "DuplicateLabel", "HyperoptTpuError", "InvalidTrial",
+    "InjectedFault", "NetstoreUnavailable", "TransientEvaluationError",
+    "faults",
 ]

@@ -598,6 +598,9 @@ class Domain:
         Reference: ``hyperopt/base.py::Domain.evaluate`` (~L850): float results
         become ``{'loss': x, 'status': 'ok'}``; dict results validated.
         """
+        from . import faults as _faults
+
+        _faults.maybe_fail("objective.call")
         if self.pass_expr_memo_ctrl:
             rval = self.fn(expr=self.expr,
                            memo=self.memo_from_config(config), ctrl=ctrl)

@@ -31,3 +31,57 @@ class DuplicateLabel(HyperoptTpuError):
 
 class InvalidAnnotatedParameter(HyperoptTpuError):
     """A search-space leaf is not a recognized hyperparameter expression."""
+
+
+class InjectedFault(HyperoptTpuError):
+    """A seeded fault fired at a named fault point (``hyperopt_tpu.faults``).
+
+    Always deliberate — raised only when a fault schedule is armed, never
+    by production code paths.  Carries the fault-point name so retry logic
+    and chaos tests can attribute the failure.
+    """
+
+    def __init__(self, point, call_no=None):
+        self.point = point
+        self.call_no = call_no
+        suffix = f" (call #{call_no})" if call_no is not None else ""
+        super().__init__(f"injected fault at {point!r}{suffix}")
+
+
+class TransientEvaluationError(HyperoptTpuError):
+    """An objective failure the caller believes is worth retrying.
+
+    Raise this (or a subclass) from an objective to ask the trial loop to
+    re-run the same point, subject to the ``max_trial_retries`` budget.
+    """
+
+
+class NetstoreUnavailable(HyperoptTpuError):
+    """Netstore transport failure that survived the whole retry budget.
+
+    Distinct from server-*reported* errors (which stay ``RuntimeError``:
+    the server was reachable and answered with a fault of its own).  This
+    one means the bytes never made it there and back.
+    """
+
+    def __init__(self, message, attempts=None):
+        self.attempts = attempts
+        super().__init__(message)
+
+
+#: Exception classes the trial loop treats as retryable without charging
+#: the trial a permanent failure.  Deliberately narrow: an arbitrary
+#: objective bug must NOT burn retry budget looping on itself.
+TRANSIENT_ERRORS = (InjectedFault, TransientEvaluationError,
+                    NetstoreUnavailable)
+
+
+def is_transient(exc):
+    """True when ``exc`` is an error the retry budget should absorb."""
+    return isinstance(exc, TRANSIENT_ERRORS)
+
+
+#: The same classification by exception *type name* — for recovery paths
+#: where only the marshalled name survives (a forked evaluation child
+#: reports ``(type_name, message)`` over its pipe, not the object).
+TRANSIENT_ERROR_NAMES = frozenset(c.__name__ for c in TRANSIENT_ERRORS)

@@ -1,0 +1,255 @@
+"""Deterministic, seeded fault injection for the distributed trial loop.
+
+A process-global registry of named **fault points**.  Production code calls
+:func:`maybe_fail` at each point; when a schedule is armed for that point the
+call raises a typed :class:`~hyperopt_tpu.exceptions.InjectedFault`, otherwise
+it returns immediately.  The disabled path is a single module-global boolean
+check — cheap enough to leave the hooks in shipping code (measured in
+``benchmarks/faults_overhead.py``; budget note in DESIGN.md §6).
+
+Fault points wired into the core::
+
+    rpc.send          before a netstore request leaves the client
+    rpc.recv          after the server executed the verb, before the client
+                      reads the reply (the request DID happen — exercises
+                      idempotent replay)
+    store.write       inside FileTrials' atomic document write
+    worker.evaluate   around a worker's domain.evaluate call
+    objective.call    at the top of Domain.evaluate (every execution path)
+    pipeline.dispatch before PipelinedExecutor dispatches a suggest slot
+
+Configuration — programmatic::
+
+    from hyperopt_tpu import faults
+    faults.configure({"rpc.send": {"prob": 0.5, "times": 3}}, seed=7)
+    ...
+    faults.clear()
+
+    with faults.injected("objective.call", prob=1.0, times=2, seed=0):
+        ...   # scoped: cleared on exit
+
+or via the environment (read once at import; re-read with
+:func:`configure_from_env`)::
+
+    HYPEROPT_TPU_FAULTS="rpc.send=0.3,rpc.recv=0.3:5,objective.call=1.0:2@10"
+    HYPEROPT_TPU_FAULTS_SEED=7
+
+Per-point spec is ``prob[:times][@after]``: fire with probability ``prob``
+per call, at most ``times`` injections total (default unlimited), skipping
+the first ``after`` calls (default 0).  Each point draws from its own
+``random.Random`` seeded by ``seed`` + the point name, so one point's call
+pattern never perturbs another's schedule and a fixed seed replays the same
+fault sequence exactly.
+
+Every injection increments ``faults.injected`` plus a per-point
+``faults.injected.<point>`` counter in :mod:`hyperopt_tpu.obs.metrics` and
+emits a ``fault_injected`` event.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import zlib
+
+from .exceptions import InjectedFault
+from .obs import events as _events
+from .obs import metrics as _metrics
+
+__all__ = [
+    "FAULT_POINTS",
+    "maybe_fail",
+    "configure",
+    "configure_from_env",
+    "clear",
+    "is_active",
+    "injected",
+    "injection_counts",
+]
+
+#: Advisory catalog of the points the core instruments.  ``configure``
+#: accepts unknown names (a library user may instrument their own code),
+#: but tests pin the core set against this.
+FAULT_POINTS = frozenset(
+    {
+        "rpc.send",
+        "rpc.recv",
+        "store.write",
+        "worker.evaluate",
+        "objective.call",
+        "pipeline.dispatch",
+    }
+)
+
+_ENV_VAR = "HYPEROPT_TPU_FAULTS"
+_ENV_SEED = "HYPEROPT_TPU_FAULTS_SEED"
+
+
+class _Point:
+    """One armed fault point: seeded RNG + probability/schedule + tallies."""
+
+    __slots__ = ("name", "prob", "times", "after", "calls", "fired", "_rng")
+
+    def __init__(self, name, prob, times=None, after=0, seed=0):
+        import random
+
+        if not 0.0 <= float(prob) <= 1.0:
+            raise ValueError(f"fault prob for {name!r} must be in [0,1], "
+                             f"got {prob}")
+        self.name = name
+        self.prob = float(prob)
+        self.times = None if times is None else int(times)
+        self.after = int(after)
+        self.calls = 0
+        self.fired = 0
+        # Per-point stream: the seed is mixed with a stable hash of the
+        # name so schedules replay exactly regardless of which other
+        # points are armed or how often they are hit.
+        self._rng = random.Random(
+            (int(seed) << 32) ^ zlib.crc32(name.encode()))
+
+    def should_fire(self) -> bool:
+        self.calls += 1
+        if self.calls <= self.after:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self._rng.random() >= self.prob:
+            return False
+        self.fired += 1
+        return True
+
+
+_lock = threading.Lock()
+_points: dict = {}
+_active = False          # fast-path gate: False ⇒ maybe_fail is a no-op
+
+
+def maybe_fail(point: str, **ctx) -> None:
+    """Raise :class:`InjectedFault` if a schedule armed for ``point`` fires.
+
+    ``ctx`` (e.g. ``verb=``, ``tid=``) is attached to the telemetry event,
+    never inspected for the firing decision — determinism depends only on
+    the per-point call count and seeded RNG stream.
+    """
+    if not _active:
+        return
+    with _lock:
+        p = _points.get(point)
+        if p is None or not p.should_fire():
+            return
+        call_no = p.calls
+    _metrics.registry().counter("faults.injected").inc()
+    _metrics.registry().counter(f"faults.injected.{point}").inc()
+    _events.EVENTS.emit("fault_injected", name=point, call_no=call_no, **ctx)
+    raise InjectedFault(point, call_no=call_no)
+
+
+def configure(spec, seed: int = 0) -> None:
+    """Arm fault points from ``spec`` (replaces any previous schedule).
+
+    ``spec`` is either the ``HYPEROPT_TPU_FAULTS`` string form or a dict
+    ``{point: {"prob": p[, "times": n][, "after": k]}}`` (a bare float is
+    shorthand for ``{"prob": p}``).  An empty spec disarms everything.
+    """
+    global _active
+    if isinstance(spec, str):
+        spec = _parse(spec)
+    new = {}
+    for name, cfg in (spec or {}).items():
+        if isinstance(cfg, (int, float)):
+            cfg = {"prob": cfg}
+        new[name] = _Point(name, seed=seed, **cfg)
+    with _lock:
+        _points.clear()
+        _points.update(new)
+        _active = bool(new)
+
+
+def configure_from_env() -> None:
+    """(Re-)read ``HYPEROPT_TPU_FAULTS`` / ``HYPEROPT_TPU_FAULTS_SEED``."""
+    raw = os.environ.get(_ENV_VAR, "")
+    try:
+        seed = int(os.environ.get(_ENV_SEED, "0") or "0")
+    except ValueError:
+        seed = 0
+    configure(raw, seed=seed)
+
+
+def clear() -> None:
+    """Disarm every fault point and reset tallies."""
+    global _active
+    with _lock:
+        _points.clear()
+        _active = False
+
+
+def is_active() -> bool:
+    """True when at least one fault point is armed."""
+    return _active
+
+
+def injection_counts() -> dict:
+    """``{point: {"calls": n, "fired": m}}`` for every armed point."""
+    with _lock:
+        return {name: {"calls": p.calls, "fired": p.fired}
+                for name, p in _points.items()}
+
+
+class injected:
+    """Context manager arming a single point for a ``with`` block.
+
+    Restores the previously armed schedule (if any) on exit, so chaos
+    tests can nest/scope without clobbering each other.
+    """
+
+    def __init__(self, point, prob=1.0, times=None, after=0, seed=0):
+        self._spec = {point: {"prob": prob, "times": times, "after": after}}
+        self._seed = seed
+        self._saved = None
+
+    def __enter__(self):
+        with _lock:
+            self._saved = dict(_points)
+        configure(self._spec, seed=self._seed)
+        return self
+
+    def __exit__(self, *exc):
+        global _active
+        with _lock:
+            _points.clear()
+            _points.update(self._saved)
+            _active = bool(_points)
+        return False
+
+
+def _parse(raw: str) -> dict:
+    """Parse ``"point=prob[:times][@after],..."`` into a spec dict."""
+    spec = {}
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        try:
+            name, rhs = item.split("=", 1)
+            after = 0
+            if "@" in rhs:
+                rhs, after_s = rhs.rsplit("@", 1)
+                after = int(after_s)
+            times = None
+            if ":" in rhs:
+                rhs, times_s = rhs.split(":", 1)
+                times = int(times_s)
+            spec[name.strip()] = {"prob": float(rhs), "times": times,
+                                  "after": after}
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                f"bad {_ENV_VAR} entry {item!r} "
+                "(want point=prob[:times][@after])") from e
+    return spec
+
+
+# Arm from the environment at import so worker subprocesses spawned with
+# HYPEROPT_TPU_FAULTS set participate in the chaos schedule without any
+# code change.  No env var ⇒ configure("") ⇒ stays disarmed.
+configure_from_env()

@@ -34,7 +34,7 @@ from .base import (
     Trials,
     coarse_utcnow,
 )
-from .exceptions import AllTrialsFailed
+from .exceptions import AllTrialsFailed, is_transient
 from .obs import metrics as _metrics
 from .obs.events import EVENTS
 from .space import compile_space
@@ -97,7 +97,8 @@ class FMinIter:
                  poll_interval_secs=0.1, max_evals=None,
                  timeout=None, loss_threshold=None,
                  show_progressbar=True, verbose=False, trace_dir=None,
-                 overlap_suggest=False, overlap_depth=None, evaluators=None):
+                 overlap_suggest=False, overlap_depth=None, evaluators=None,
+                 max_trial_retries=None):
         from .obs import NullTracer, Tracer
         trace_dir = trace_dir or os.environ.get("HYPEROPT_TPU_TRACE_DIR")
         self.tracer = (Tracer(trace_dir, device_trace=True) if trace_dir
@@ -123,6 +124,23 @@ class FMinIter:
         self.start_time = time.time()
         self.show_progressbar = show_progressbar
         self.verbose = verbose
+        # Per-trial transient-failure budget: a trial whose evaluation
+        # dies with a *transient* error (exceptions.is_transient — injected
+        # faults, netstore outages, user-raised TransientEvaluationError)
+        # is re-run on the SAME point up to this many times, with
+        # fail_count bookkeeping on the doc, before it settles as a
+        # permanent failure.  0 (default) = today's fail-fast behavior.
+        if max_trial_retries is None:
+            env_retries = os.environ.get(
+                "HYPEROPT_TPU_MAX_TRIAL_RETRIES", "")
+            try:
+                max_trial_retries = int(env_retries) if env_retries else 0
+            except ValueError:
+                logger.warning("ignoring non-integer "
+                               "HYPEROPT_TPU_MAX_TRIAL_RETRIES=%r",
+                               env_retries)
+                max_trial_retries = 0
+        self.max_trial_retries = max(0, int(max_trial_retries))
         # serial_evaluate's monotone scan cursor: _dynamic_trials is
         # append-only and settled states never revert to NEW, so every
         # batch resumes the NEW-trial scan where the last one stopped
@@ -203,7 +221,22 @@ class FMinIter:
             ctrl = Ctrl(self.trials, current_trial=trial)
             try:
                 spec = base.spec_from_misc(trial["misc"])
-                result = self.domain.evaluate(spec, ctrl)
+                while True:
+                    try:
+                        result = self.domain.evaluate(spec, ctrl)
+                        break
+                    except Exception as e:
+                        fail_count = trial["misc"].get("fail_count", 0)
+                        if not (is_transient(e)
+                                and fail_count < self.max_trial_retries):
+                            raise
+                        # Transient: charge the budget and re-run the SAME
+                        # point instead of losing it to a permanent FAIL.
+                        trial["misc"]["fail_count"] = fail_count + 1
+                        _reg.counter("fmin.trials.retried").inc()
+                        EVENTS.emit("trial_retry", trial=trial["tid"],
+                                    attempt=fail_count + 1,
+                                    error=type(e).__name__)
             except Exception as e:
                 logger.error("job exception: %s", e)
                 trial["state"] = JOB_STATE_ERROR
@@ -394,8 +427,13 @@ class FMinIter:
             else no_progress_callback
         with progress_ctx(initial=self.n_done(), total=self.max_evals) as prog:
             if self._pipeline is not None:
-                self._pipeline.run(prog)
-                return self
+                status = self._pipeline.run(prog)
+                if status != "fallback":
+                    return self
+                # The executor hit its consecutive-slot-failure cap,
+                # drained cleanly, and asked us to finish the run on the
+                # plain synchronous loop (pipeline.py::_FALLBACK_AFTER).
+                logger.warning("pipeline fell back to the synchronous loop")
             while not self._stopped(self.n_done()):
                 before = self.n_done()
                 stopped = self.run_one_batch()
@@ -448,7 +486,7 @@ def fmin(fn, space, algo=None, max_evals=None,
          points_to_evaluate=None, max_queue_len=1,
          show_progressbar=True, early_stop_fn=None,
          trials_save_file="", trace_dir=None, overlap_suggest=False,
-         overlap_depth=None, evaluators=None):
+         overlap_depth=None, evaluators=None, max_trial_retries=None):
     """Minimize ``fn`` over ``space`` using ``algo``.
 
     Reference-parity signature: ``hyperopt/fmin.py::fmin`` (SURVEY.md §2 L5).
@@ -475,6 +513,14 @@ def fmin(fn, space, algo=None, max_evals=None,
     2012).  Requires a dispatch-capable algo (``tpe.suggest`` /
     ``tpe.suggest_quantile``, optionally ``functools.partial``-bound);
     silently degrades to the ordinary loop otherwise.
+
+    Robustness addition: ``max_trial_retries=N`` re-runs a trial on the
+    same point up to N times when its evaluation dies with a *transient*
+    error (``hyperopt_tpu.exceptions.is_transient`` — injected faults,
+    ``NetstoreUnavailable``, user-raised ``TransientEvaluationError``)
+    before it settles as a permanent failure; each retry increments
+    ``fail_count`` in the trial's ``misc``.  Default 0 (fail fast);
+    ``HYPEROPT_TPU_MAX_TRIAL_RETRIES`` sets the process-wide default.
     """
     if algo is None:
         algo = "tpe"
@@ -537,7 +583,8 @@ def fmin(fn, space, algo=None, max_evals=None,
             pass_expr_memo_ctrl=pass_expr_memo_ctrl,
             verbose=verbose, catch_eval_exceptions=catch_eval_exceptions,
             return_argmin=return_argmin, show_progressbar=show_progressbar,
-            early_stop_fn=early_stop_fn, trials_save_file=trials_save_file)
+            early_stop_fn=early_stop_fn, trials_save_file=trials_save_file,
+            max_trial_retries=max_trial_retries)
 
     domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)
 
@@ -550,7 +597,8 @@ def fmin(fn, space, algo=None, max_evals=None,
                     show_progressbar=show_progressbar and verbose,
                     verbose=verbose, trace_dir=trace_dir,
                     overlap_suggest=overlap_suggest,
-                    overlap_depth=overlap_depth, evaluators=evaluators)
+                    overlap_depth=overlap_depth, evaluators=evaluators,
+                    max_trial_retries=max_trial_retries)
     rval.catch_eval_exceptions = catch_eval_exceptions
     rval.exhaust()
     rval._save_trials()

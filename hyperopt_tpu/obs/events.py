@@ -72,6 +72,9 @@ EVENT_TYPES = frozenset(
         "pipeline_dispatch",
         "pipeline_materialize",
         "pipeline_cancel",
+        "pipeline_fallback",
+        "fault_injected",
+        "trial_retry",
     }
 )
 

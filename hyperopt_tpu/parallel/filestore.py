@@ -46,6 +46,8 @@ except ImportError:  # pragma: no cover
     _pickler = pickle
 
 from .. import base
+from .. import faults as _faults
+from ..exceptions import is_transient
 from ..obs import metrics as _metrics
 from ..obs.events import EVENTS
 from ..base import (
@@ -172,6 +174,7 @@ class FileTrials(Trials):
         return os.path.join(self._trials_dir, f"{tid}.claim")
 
     def _write_doc(self, doc) -> None:
+        _faults.maybe_fail("store.write", tid=doc["tid"])
         _atomic_write_json(self._doc_path(doc["tid"]), doc)
 
     def _insert_trial_docs(self, docs):
@@ -370,7 +373,7 @@ class FileTrials(Trials):
                 except (FileNotFoundError, OSError):
                     pass
         if n:
-            _metrics.registry().counter("store.requeue_stale").inc(n)
+            _metrics.registry().counter("store.requeued").inc(n)
             EVENTS.emit("store_requeue", n=n)
             self.refresh()
         return n
@@ -387,7 +390,7 @@ class FileWorker:
     def __init__(self, root, exp_key="default", domain=None,
                  poll_interval=0.1, reserve_timeout=None,
                  max_consecutive_failures=4, workdir=None,
-                 heartbeat_interval=15.0):
+                 heartbeat_interval=15.0, max_trial_retries=0):
         self.trials = self._make_trials(root, exp_key)
         self._domain = domain
         self.poll_interval = poll_interval
@@ -395,6 +398,11 @@ class FileWorker:
         self.max_consecutive_failures = max_consecutive_failures
         self.workdir = workdir
         self.heartbeat_interval = heartbeat_interval
+        # In-place re-evaluations of a claimed trial after a *transient*
+        # failure (exceptions.is_transient) before it is marked ERROR.
+        # The claim and heartbeat stay alive across attempts, so no other
+        # worker can double-evaluate the point meanwhile.
+        self.max_trial_retries = max(0, int(max_trial_retries))
         # uuid suffix: same-process workers (threads) must not share an
         # identity, or owns() could confuse their claims.
         import uuid
@@ -451,7 +459,21 @@ class FileWorker:
                 os.makedirs(wd, exist_ok=True)
                 ctrl.workdir = wd
             spec = base.spec_from_misc(doc["misc"])
-            result = self.domain.evaluate(spec, ctrl)
+            while True:
+                try:
+                    _faults.maybe_fail("worker.evaluate", tid=doc["tid"])
+                    result = self.domain.evaluate(spec, ctrl)
+                    break
+                except Exception as e:
+                    fail_count = doc["misc"].get("fail_count", 0)
+                    if not (is_transient(e)
+                            and fail_count < self.max_trial_retries):
+                        raise
+                    doc["misc"]["fail_count"] = fail_count + 1
+                    _metrics.registry().counter("worker.trial_retries").inc()
+                    EVENTS.emit("trial_retry", trial=doc["tid"],
+                                attempt=fail_count + 1,
+                                error=type(e).__name__)
         except Exception as e:
             logger.error("worker job exception (tid %s): %s", doc["tid"], e)
             doc["state"] = JOB_STATE_ERROR
@@ -479,6 +501,7 @@ class FileWorker:
                     worked = self.run_one()
                 except Exception:
                     failures += 1
+                    _reg.gauge("worker.consecutive_failures").set(failures)
                     if failures >= self.max_consecutive_failures:
                         logger.error("worker exiting after %d consecutive "
                                      "failures", failures)
@@ -487,6 +510,7 @@ class FileWorker:
                 else:
                     if worked:
                         failures = 0
+                        _reg.gauge("worker.consecutive_failures").set(0)
                         n_done += 1
                         _reg.counter("worker.trials").inc()
                 if worked:
@@ -515,12 +539,17 @@ def main(argv=None):
     p.add_argument("--reserve-timeout", type=float, default=None,
                    help="exit after this many idle seconds")
     p.add_argument("--max-consecutive-failures", type=int, default=4)
+    p.add_argument("--max-trial-retries", type=int, default=0,
+                   help="in-place re-evaluations of a trial after a "
+                        "transient failure before it is marked ERROR "
+                        "(default 0 = fail fast)")
     p.add_argument("--workdir", default=None)
     args = p.parse_args(argv)
     worker = FileWorker(args.root, exp_key=args.exp_key,
                         poll_interval=args.poll_interval,
                         reserve_timeout=args.reserve_timeout,
                         max_consecutive_failures=args.max_consecutive_failures,
+                        max_trial_retries=args.max_trial_retries,
                         workdir=args.workdir)
     n = worker.run()
     logger.info("worker done: %d trials evaluated", n)

@@ -54,16 +54,22 @@ import json
 import logging
 import os
 import pickle
+import random
 import threading
 import time
+import uuid
+import zlib
+from collections import OrderedDict
 from collections.abc import MutableMapping
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.error import HTTPError
+from urllib.error import HTTPError, URLError
 from urllib.request import Request, urlopen
 
 from .filestore import FileTrials, FileWorker, _pickler
 from ..base import Trials
+from ..exceptions import InjectedFault, NetstoreUnavailable
 from ..obs import metrics as _metrics
+from .. import faults as _faults
 
 logger = logging.getLogger(__name__)
 
@@ -98,12 +104,33 @@ class StoreServer:
     evaluations — the actual work — happen client-side in the workers).
     """
 
+    #: Bound on the idempotency dedup cache (completed mutating calls kept
+    #: for replay).  Retries arrive within seconds of the original, so a
+    #: few thousand entries is generations of headroom.
+    _IDEM_CAP = 4096
+
     def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
-                 token: str | None = None):
+                 token: str | None = None,
+                 requeue_stale_every: float | None = None,
+                 stale_timeout: float = 60.0):
         self.root = os.path.abspath(root)
         self._trials: dict = {}          # exp_key -> FileTrials
         self._lock = threading.Lock()
         self._token = _resolve_token(token)
+        # Exactly-once under client retry: (exp_key, idem_key) -> the JSON
+        # reply of the first execution.  Stored serialized so a replay can
+        # never alias live server-side state.
+        self._idem: OrderedDict = OrderedDict()
+        self._idem_lock = threading.Lock()
+        # Janitor: requeue crashed-worker claims every S seconds so the
+        # recovery path runs unprompted (``--requeue-stale-every``).
+        self.requeue_stale_every = requeue_stale_every
+        self.stale_timeout = stale_timeout
+        self._janitor: threading.Thread | None = None
+        self._janitor_stop = threading.Event()
+        self._started = False
+        self._closed = False
+        self._lifecycle_lock = threading.Lock()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -173,17 +200,59 @@ class StoreServer:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self):
+        self._started = True
+        self._start_janitor()
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True,
                              name="netstore-server")
         t.start()
         return self.host, self.port
 
     def serve_forever(self):
+        self._started = True
+        self._start_janitor()
         self._httpd.serve_forever()
 
     def shutdown(self):
-        self._httpd.shutdown()
+        """Stop serving and release the socket.
+
+        Idempotent, and safe when ``start()``/``serve_forever()`` never
+        ran (``ThreadingHTTPServer.shutdown`` would otherwise block
+        forever waiting on a serve loop that does not exist).
+        """
+        with self._lifecycle_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._janitor_stop.set()
+        if self._janitor is not None:
+            self._janitor.join(timeout=5.0)
+        if self._started:
+            self._httpd.shutdown()
         self._httpd.server_close()
+
+    def _start_janitor(self):
+        if not self.requeue_stale_every or self._janitor is not None:
+            return
+        self._janitor = threading.Thread(target=self._janitor_loop,
+                                         daemon=True,
+                                         name="netstore-janitor")
+        self._janitor.start()
+
+    def _janitor_loop(self):
+        # wait() (not sleep) so shutdown() interrupts a long period
+        # immediately; first pass only after one full period.
+        while not self._janitor_stop.wait(self.requeue_stale_every):
+            try:
+                with self._lock:
+                    stores = list(self._trials.values())
+                for ft in stores:
+                    with self._lock:
+                        n = ft.requeue_stale(self.stale_timeout)
+                    if n:
+                        logger.info("netstore janitor: requeued %d stale "
+                                    "trial(s) in %r", n, ft._exp_key)
+            except Exception:       # janitor must outlive any bad store
+                logger.exception("netstore janitor: requeue_stale failed")
 
     @property
     def url(self) -> str:
@@ -203,7 +272,25 @@ class StoreServer:
         reg = _metrics.registry()
         t0 = time.perf_counter()
         try:
-            return self._dispatch_verb(verb, req)
+            idem = req.pop("idem", None)
+            if idem is None:
+                return self._dispatch_verb(verb, req)
+            # Mutating verb with an idempotency key: a retry of a call the
+            # server already executed must return the original reply, not
+            # run the verb twice (the client retries blind — it cannot
+            # know whether the loss was on the way in or out).
+            key = (req.get("exp_key", "default"), idem)
+            with self._idem_lock:
+                cached = self._idem.get(key)
+            if cached is not None:
+                reg.counter("netstore.idem.hits").inc()
+                return json.loads(cached)
+            out = self._dispatch_verb(verb, req)
+            with self._idem_lock:
+                self._idem[key] = json.dumps(out)
+                while len(self._idem) > self._IDEM_CAP:
+                    self._idem.popitem(last=False)
+            return out
         finally:
             # Per-verb call count + latency histogram: the contention
             # signal for the single-writer lock under many workers.
@@ -279,34 +366,88 @@ class StoreServer:
 # ---------------------------------------------------------------------------
 
 
+#: Verbs that change server state: each call carries a fresh idempotency
+#: key, reused verbatim across retries so the server executes it once.
+_MUTATING_VERBS = frozenset(
+    {"new_trial_ids", "insert_docs", "reserve", "write_result"})
+
+_BACKOFF_CAP_S = 2.0
+
+
 class _Rpc:
     """One-POST-per-call JSON client (stdlib urllib; connection reuse is not
-    worth a dependency at this call volume)."""
+    worth a dependency at this call volume).
+
+    Transport failures (socket refused/reset/timeout, i.e. ``URLError``
+    without an HTTP reply) are retried up to ``retries`` times with
+    exponential backoff + deterministic jitter; exhaustion raises the typed
+    :class:`~hyperopt_tpu.exceptions.NetstoreUnavailable`.  Server-reported
+    errors (the server answered, with a fault) stay ``RuntimeError`` and
+    are never retried — retrying a deliberate refusal (auth, bad verb)
+    only hammers the server.
+    """
 
     def __init__(self, url: str, exp_key: str, timeout: float = 30.0,
-                 token: str | None = None):
+                 token: str | None = None, retries: int | None = None,
+                 backoff: float | None = None):
         self.url = url.rstrip("/")
         self.exp_key = exp_key
         self.timeout = timeout
         self.token = _resolve_token(token)
+        if retries is None:
+            retries = int(os.environ.get(
+                "HYPEROPT_TPU_NETSTORE_RETRIES", "5") or "5")
+        self.retries = max(0, int(retries))
+        if backoff is None:
+            backoff = float(os.environ.get(
+                "HYPEROPT_TPU_NETSTORE_BACKOFF", "0.05") or "0.05")
+        self.backoff = float(backoff)
+        # Deterministic jitter stream per client identity: spreads thundering
+        # retries across workers without making test runs irreproducible.
+        self._jitter = random.Random(
+            zlib.crc32(f"{self.url}|{exp_key}".encode()))
 
     def __call__(self, verb: str, **kw) -> dict:
         kw.update(verb=verb, exp_key=self.exp_key)
+        if verb in _MUTATING_VERBS:
+            # One key per logical call, shared by every retry of it.
+            kw["idem"] = uuid.uuid4().hex
         headers = {"Content-Type": "application/json"}
         if self.token is not None:
             headers["X-Netstore-Token"] = self.token
-        req = Request(self.url, data=json.dumps(kw).encode(),
-                      headers=headers)
-        try:
-            with urlopen(req, timeout=self.timeout) as resp:
-                out = json.loads(resp.read())
-        except HTTPError as e:
-            # Non-2xx (500 server fault, 401 auth) carries the JSON error
-            # body; surface it as the RuntimeError the callers expect.
+        data = json.dumps(kw).encode()
+        attempts = 0
+        while True:
             try:
-                out = json.loads(e.read())
-            except Exception:
-                out = {"error": f"HTTP {e.code}"}
+                _faults.maybe_fail("rpc.send", verb=verb)
+                req = Request(self.url, data=data, headers=headers)
+                with urlopen(req, timeout=self.timeout) as resp:
+                    raw = resp.read()
+                _faults.maybe_fail("rpc.recv", verb=verb)
+                out = json.loads(raw)
+                break
+            except HTTPError as e:
+                # Non-2xx (500 server fault, 401 auth) carries the JSON
+                # error body; surface it as the RuntimeError the callers
+                # expect.  The server DID answer — no retry.
+                try:
+                    out = json.loads(e.read())
+                except Exception:
+                    out = {"error": f"HTTP {e.code}"}
+                break
+            except (URLError, OSError, InjectedFault) as e:
+                attempts += 1
+                _metrics.registry().counter("netstore.rpc.retry").inc()
+                if attempts > self.retries:
+                    _metrics.registry().counter(
+                        "netstore.rpc.unavailable").inc()
+                    raise NetstoreUnavailable(
+                        f"netstore {self.url} unreachable after "
+                        f"{attempts} attempt(s) ({verb}): {e}",
+                        attempts=attempts) from e
+                delay = min(self.backoff * (2 ** (attempts - 1)),
+                            _BACKOFF_CAP_S)
+                time.sleep(delay * (0.5 + self._jitter.random()))
         if "error" in out:
             raise RuntimeError(f"netstore server: {out['error']}")
         return out
@@ -347,8 +488,10 @@ class NetTrials(Trials):
     asynchronous = True
 
     def __init__(self, url: str, exp_key: str = "default", refresh=True,
-                 timeout: float = 30.0, token: str | None = None):
-        self._rpc = _Rpc(url, exp_key, timeout=timeout, token=token)
+                 timeout: float = 30.0, token: str | None = None,
+                 retries: int | None = None):
+        self._rpc = _Rpc(url, exp_key, timeout=timeout, token=token,
+                         retries=retries)
         super().__init__(exp_key=exp_key, refresh=refresh)
         self.attachments = _NetAttachments(self._rpc)
 
@@ -461,6 +604,19 @@ def main(argv=None):
     p.add_argument("--poll-interval", type=float, default=0.1)
     p.add_argument("--reserve-timeout", type=float, default=None)
     p.add_argument("--max-consecutive-failures", type=int, default=4)
+    p.add_argument("--max-trial-retries", type=int, default=0,
+                   help="worker mode: in-place re-evaluations of a trial "
+                        "after a transient failure before it is marked "
+                        "ERROR (default 0 = fail fast)")
+    p.add_argument("--requeue-stale-every", type=float, default=None,
+                   metavar="S",
+                   help="server mode: janitor period — requeue claims whose "
+                        "heartbeat went stale, every S seconds (default: "
+                        "janitor off; clients may still call requeue_stale)")
+    p.add_argument("--stale-timeout", type=float, default=60.0,
+                   help="server mode: heartbeat age beyond which the "
+                        "janitor treats a claim as crashed (default 60s; "
+                        "keep well above the workers' heartbeat interval)")
     p.add_argument("--workdir", default=None)
     args = p.parse_args(argv)
 
@@ -468,18 +624,38 @@ def main(argv=None):
         if not args.root:
             p.error("--serve requires --root")
         server = StoreServer(args.root, host=args.host, port=args.port,
-                             token=args.token)
+                             token=args.token,
+                             requeue_stale_every=args.requeue_stale_every,
+                             stale_timeout=args.stale_timeout)
         print(f"netstore: serving {args.root} at {server.url}", flush=True)
+
+        # Graceful stop on SIGTERM (systemd/k8s default kill signal):
+        # raise out of serve_forever on the main thread, then shut down in
+        # the finally.  shutdown() must not run inside the handler — it
+        # joins the serve loop that the handler interrupted.
+        import signal
+
+        def _on_sigterm(signo, frame):
+            raise SystemExit(0)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:          # not the main thread (embedded use)
+            pass
         try:
             server.serve_forever()
-        except KeyboardInterrupt:
+        except (KeyboardInterrupt, SystemExit):
+            pass
+        finally:
             server.shutdown()
+            print("netstore: shut down", flush=True)
         return 0
 
     worker = NetWorker(args.worker, exp_key=args.exp_key, token=args.token,
                        poll_interval=args.poll_interval,
                        reserve_timeout=args.reserve_timeout,
                        max_consecutive_failures=args.max_consecutive_failures,
+                       max_trial_retries=args.max_trial_retries,
                        workdir=args.workdir)
     n = worker.run()
     logger.info("net worker done: %d trials evaluated", n)

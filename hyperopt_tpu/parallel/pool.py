@@ -40,6 +40,7 @@ import threading
 from concurrent.futures import ThreadPoolExecutor
 
 from .. import base
+from ..exceptions import TRANSIENT_ERROR_NAMES, is_transient
 from ..obs import metrics as _metrics
 from ..obs.events import EVENTS
 from ..base import (
@@ -99,6 +100,10 @@ class PoolTrials(Trials):
 
     asynchronous = True
 
+    #: Seconds a cancelled process-mode child gets to honor SIGTERM before
+    #: the escalation to SIGKILL (class attribute so tests can shrink it).
+    _TERM_GRACE_S = 5.0
+
     def __init__(self, parallelism: int = 4, trial_timeout=None,
                  execution: str = "thread", exp_key=None, refresh=True):
         if parallelism < 1:
@@ -109,6 +114,7 @@ class PoolTrials(Trials):
         self.parallelism = parallelism
         self.trial_timeout = trial_timeout
         self.execution = execution
+        self.max_trial_retries = 0   # set per-run by fmin()
         self._pool = None
         self._inflight: set = set()
         self._cancel_events: dict = {}   # tid -> threading.Event
@@ -134,6 +140,16 @@ class PoolTrials(Trials):
         self._domain = Domain(fn, space, pass_expr_memo_ctrl=kwargs.get(
             "pass_expr_memo_ctrl"))
         self._draining = False
+        # Transient-retry budget: the pool records results itself (the
+        # asynchronous contract), so FMinIter's serial retry loop never
+        # sees our failures — the budget applies here, per trial.
+        mtr = kwargs.get("max_trial_retries")
+        if mtr is None:
+            mtr = os.environ.get("HYPEROPT_TPU_MAX_TRIAL_RETRIES") or 0
+        try:
+            self.max_trial_retries = max(0, int(mtr))
+        except (TypeError, ValueError):
+            self.max_trial_retries = 0
         # Keep the queue as wide as the pool (the reference's SparkTrials
         # derives max_queue_len from parallelism the same way).
         kwargs.setdefault("max_queue_len", self.parallelism)
@@ -204,10 +220,13 @@ class PoolTrials(Trials):
             proc = self._procs.pop(tid, None)
         if proc is not None and proc.is_alive():
             proc.terminate()
-            proc.join(timeout=5.0)
-            if proc.is_alive():  # pragma: no cover — SIGTERM ignored
+            proc.join(timeout=self._TERM_GRACE_S)
+            if proc.is_alive():
+                # SIGTERM ignored/blocked by the child: escalate to
+                # SIGKILL (tests shrink _TERM_GRACE_S to exercise this).
+                _metrics.registry().counter("pool.cancel.sigkill").inc()
                 proc.kill()
-                proc.join(timeout=5.0)
+                proc.join(timeout=self._TERM_GRACE_S)
         _metrics.registry().counter("pool.cancelled").inc()
         EVENTS.emit("trial_end", trial=tid, state="cancelled", reason=reason)
         return True
@@ -220,7 +239,7 @@ class PoolTrials(Trials):
         if still_running:
             logger.warning("trial %s exceeded trial_timeout=%ss — cancelling",
                            tid, self.trial_timeout)
-            _metrics.registry().counter("pool.trials.timeout").inc()
+            _metrics.registry().counter("pool.trial_timeout").inc()
             self._cancel_trial(
                 tid, f"exceeded trial_timeout={self.trial_timeout}s")
 
@@ -274,7 +293,13 @@ class PoolTrials(Trials):
         ctrl.should_stop = ev.is_set  # cooperative-cancellation hook
         try:
             spec = base.spec_from_misc(doc["misc"])
-            result = self._domain.evaluate(spec, ctrl)
+            while True:
+                try:
+                    result = self._domain.evaluate(spec, ctrl)
+                    break
+                except Exception as e:
+                    if ev.is_set() or not self._charge_retry(doc, e):
+                        raise
         except Exception as e:
             logger.error("pool job exception (tid %s): %s", doc["tid"], e)
             self._finish(doc, ev, timer, JOB_STATE_ERROR,
@@ -282,45 +307,72 @@ class PoolTrials(Trials):
         else:
             self._finish(doc, ev, timer, JOB_STATE_DONE, result=result)
 
+    def _charge_retry(self, doc, exc) -> bool:
+        """Consume one unit of the trial's transient-retry budget;
+        False when the failure must become the trial's ERROR record
+        (non-transient, or budget spent).  ``exc`` may be an exception
+        object or the type *name* a forked child marshalled back."""
+        transient = (exc in TRANSIENT_ERROR_NAMES
+                     if isinstance(exc, str) else is_transient(exc))
+        fail_count = doc["misc"].get("fail_count", 0)
+        if not transient or fail_count >= self.max_trial_retries:
+            return False
+        doc["misc"]["fail_count"] = fail_count + 1
+        _metrics.registry().counter("pool.trial_retries").inc()
+        EVENTS.emit("trial_retry", trial=doc["tid"], attempt=fail_count + 1,
+                    error=exc if isinstance(exc, str) else type(exc).__name__)
+        return True
+
     def _run_trial_process(self, doc, ev, timer):
         """Babysit one forked evaluation child (thread-per-trial, like the
         reference's ``_SparkFMinState`` threads watching Spark jobs)."""
         ctx = multiprocessing.get_context("fork")
-        parent_conn, child_conn = ctx.Pipe(duplex=False)
         spec = base.spec_from_misc(doc["misc"])
-        proc = ctx.Process(target=_child_eval,
-                           args=(self._domain, spec, child_conn), daemon=True)
-        with self._lock:
-            if ev.is_set():  # cancelled before launch
-                return
-            self._procs[doc["tid"]] = proc
-        proc.start()
-        child_conn.close()
-        try:
-            msg = None
-            while msg is None:
-                if parent_conn.poll(0.1):
-                    msg = parent_conn.recv()
-                    break
-                if ev.is_set():
-                    return  # _cancel_trial reaps the child + marks the doc
-                if not proc.is_alive() and not parent_conn.poll(0.0):
-                    self._finish(doc, ev, timer, JOB_STATE_ERROR,
-                                 error=("ChildDied",
-                                        f"exitcode={proc.exitcode}"))
+        # Outer loop: one iteration per fork.  A child that died on a
+        # *transient* error (marshalled back by type name) is re-forked
+        # against the trial's retry budget; anything else finishes the doc.
+        while True:
+            parent_conn, child_conn = ctx.Pipe(duplex=False)
+            proc = ctx.Process(target=_child_eval,
+                               args=(self._domain, spec, child_conn),
+                               daemon=True)
+            with self._lock:
+                if ev.is_set():  # cancelled before launch
+                    parent_conn.close()
+                    child_conn.close()
                     return
-            if msg[0] == "ok":
-                self._finish(doc, ev, timer, JOB_STATE_DONE, result=msg[1],
-                             attachments=msg[2])
-            else:
+                self._procs[doc["tid"]] = proc
+            proc.start()
+            child_conn.close()
+            try:
+                msg = None
+                while msg is None:
+                    if parent_conn.poll(0.1):
+                        msg = parent_conn.recv()
+                        break
+                    if ev.is_set():
+                        return  # _cancel_trial reaps the child + marks doc
+                    if not proc.is_alive() and not parent_conn.poll(0.0):
+                        self._finish(doc, ev, timer, JOB_STATE_ERROR,
+                                     error=("ChildDied",
+                                            f"exitcode={proc.exitcode}"))
+                        return
+                if msg[0] == "ok":
+                    self._finish(doc, ev, timer, JOB_STATE_DONE,
+                                 result=msg[1], attachments=msg[2])
+                    return
+                if self._charge_retry(doc, msg[1]):
+                    continue  # re-fork the same spec
                 self._finish(doc, ev, timer, JOB_STATE_ERROR,
                              error=(msg[1], msg[2]))
-        except (EOFError, OSError) as e:  # pragma: no cover
-            self._finish(doc, ev, timer, JOB_STATE_ERROR,
-                         error=("PipeError", str(e)))
-        finally:
-            parent_conn.close()
-            proc.join(timeout=5.0)
+                return
+            except (EOFError, OSError) as e:  # pragma: no cover
+                self._finish(doc, ev, timer, JOB_STATE_ERROR,
+                             error=("PipeError", str(e)))
+                return
+            finally:
+                parent_conn.close()
+                proc.join(timeout=5.0)
 
     def refresh(self):
         # FMinIter polls refresh() in its async loop; dispatch NEW docs to

@@ -71,12 +71,18 @@ from .base import (
     JOB_STATE_RUNNING,
     coarse_utcnow,
 )
-from .exceptions import AllTrialsFailed
+from . import faults as _faults
+from .exceptions import AllTrialsFailed, is_transient
 from .obs import metrics as _metrics
 from .obs.events import EVENTS
 from .parallel.pool import CompletionQueueEvaluator
 
 logger = logging.getLogger(__name__)
+
+#: Consecutive dispatch/materialize slot failures before the executor
+#: gives up on the pipelined loop and hands the rest of the run back to
+#: FMinIter's synchronous loop (``run`` returns ``"fallback"``).
+_FALLBACK_AFTER = 3
 
 # Bucket bounds in MILLISECONDS (the unit the suggest.*_ms series use):
 # 50µs .. ~26s, ×2 per bucket.
@@ -133,6 +139,11 @@ class PipelinedExecutor:
         self._seq = 0
         # One eval-bound count per wait episode (reset at each feed).
         self._eval_bound_counted = False
+        # Slot-failure recovery: consecutive dispatch/materialize failures
+        # (any success resets); at _FALLBACK_AFTER the run falls back to
+        # the synchronous loop instead of crashing.
+        self._slot_failures = 0
+        self._fallback = False
 
     # -- id allocation ----------------------------------------------------
     def _alloc_ids(self, k):
@@ -185,13 +196,24 @@ class PipelinedExecutor:
                     break
                 if not self._exhausted:
                     self._refill(reg)
+                if self._fallback:
+                    reason = "slot failures"
+                    break
                 while self._ring and self._open <= feed_floor:
                     if not self._consume_head(ev, reg):
                         # Algo returned no docs (or the budget is spent):
-                        # stop dispatching, finish what's in flight.
-                        self._exhausted = True
+                        # stop dispatching, finish what's in flight —
+                        # unless the slot-failure cap tripped, in which
+                        # case the sync loop takes over.
+                        if not self._fallback:
+                            self._exhausted = True
                         break
                     self._refill(reg)
+                    if self._fallback:
+                        break
+                if self._fallback:
+                    reason = "slot failures"
+                    break
                 if self._open == 0:
                     if self._exhausted or not self._ring:
                         reason = "algo exhausted" if self._exhausted else None
@@ -216,13 +238,19 @@ class PipelinedExecutor:
                     break
         finally:
             try:
+                # On fallback (like on an objective exception) queued-but-
+                # unstarted work reverts to NEW so the synchronous loop
+                # picks it up instead of losing it to ERROR("Cancelled").
                 self._drain(ev, prog, reg,
                             reason=reason or "shutdown",
-                            revert_new=stop_exc is not None)
+                            revert_new=stop_exc is not None
+                            or self._fallback)
             finally:
                 ev.shutdown()
         if stop_exc is not None:
             raise stop_exc
+        if self._fallback:
+            return "fallback"
         return self
 
     # -- stages -----------------------------------------------------------
@@ -243,8 +271,19 @@ class PipelinedExecutor:
                 return
             seed = int(it.rstate.integers(2 ** 31 - 1))
             ids = self._alloc_ids(k)
-            with it.tracer.span("dispatch"):
-                handle = self._dispatch(ids, it.domain, trials, seed)
+            try:
+                _faults.maybe_fail("pipeline.dispatch", n=k)
+                with it.tracer.span("dispatch"):
+                    handle = self._dispatch(ids, it.domain, trials, seed)
+            except Exception as e:
+                # Nothing was inserted: roll back the optimistic id
+                # allocation so the retry (or the sync fallback) reuses
+                # the same tids — no gaps, no lost ids.
+                self._next_tid = ids[0]
+                if not self._count_slot_failure(reg, "dispatch", e):
+                    return
+                continue
+            self._slot_failures = 0
             if handle is None:
                 return
             if self._start_transfer is not None:
@@ -262,10 +301,62 @@ class PipelinedExecutor:
             EVENTS.emit("pipeline_dispatch", n=len(ids), slot=span,
                         depth=len(self._ring))
 
+    def _count_slot_failure(self, reg, stage, exc) -> bool:
+        """Charge one dispatch/materialize failure against the consecutive
+        cap.  Returns False once the cap trips (fallback engaged)."""
+        self._slot_failures += 1
+        reg.counter("pipeline.slot.failed").inc()
+        logger.warning("pipeline %s failed (%d consecutive): %s",
+                       stage, self._slot_failures, exc)
+        if self._slot_failures < _FALLBACK_AFTER:
+            return True
+        self._fallback = True
+        reg.counter("pipeline.fallbacks").inc()
+        EVENTS.emit("pipeline_fallback", reason=stage,
+                    failures=self._slot_failures)
+        return False
+
+    def _redispatch(self, slot, reg, stage, exc) -> bool:
+        """Replace a failed head slot: re-dispatch its tids with a fresh
+        seed and push the new handle to the ring front.  Returns False
+        when the consecutive-failure cap engages the fallback (or the
+        algo refuses the re-dispatch)."""
+        it = self.it
+        while True:
+            if not self._count_slot_failure(reg, stage, exc):
+                return False
+            seed = int(it.rstate.integers(2 ** 31 - 1))
+            try:
+                _faults.maybe_fail("pipeline.dispatch", n=len(slot.ids))
+                with it.tracer.span("dispatch"):
+                    handle = self._dispatch(slot.ids, it.domain,
+                                            it.trials, seed)
+                break
+            except Exception as e:
+                stage, exc = "re-dispatch", e
+        if handle is None:
+            return False         # algo refused: run() treats as exhausted
+        if self._start_transfer is not None:
+            try:
+                self._start_transfer(handle)
+            except Exception:
+                logger.debug("start_transfer failed", exc_info=True)
+        self._seq += 1
+        span = f"ps{self._seq}"
+        self._ring.appendleft(_Slot(slot.ids, handle, span))
+        reg.gauge("pipeline.occupancy").set(len(self._ring))
+        reg.counter("pipeline.redispatch").inc()
+        EVENTS.emit("span_begin", name="pipeline.slot", span=span,
+                    n=len(slot.ids))
+        EVENTS.emit("pipeline_dispatch", n=len(slot.ids), slot=span,
+                    depth=len(self._ring), redispatch=True)
+        return True
+
     def _consume_head(self, ev, reg) -> bool:
         """Materialize the oldest handle, insert its docs (clamped to the
         remaining eval budget) and submit them.  Returns False when the
-        algo is exhausted (no docs) or the budget is spent."""
+        algo is exhausted (no docs), the budget is spent, or slot-failure
+        recovery engaged the sync fallback."""
         it = self.it
         trials = it.trials
         slot = self._ring[0]
@@ -273,8 +364,19 @@ class PipelinedExecutor:
         if not ready:
             reg.counter("pipeline.stall.suggest_bound").inc()
         t0 = perf_counter()
-        with it.tracer.span("suggest"):
-            docs = self._materialize(slot.handle)
+        try:
+            with it.tracer.span("suggest"):
+                docs = self._materialize(slot.handle)
+        except Exception as e:
+            # Dead handle: drop the slot and dispatch a replacement for
+            # the SAME tids at the ring head (order and id continuity
+            # preserved — nothing of this slot was inserted).
+            self._ring.popleft()
+            self._eval_bound_counted = False
+            reg.gauge("pipeline.occupancy").set(len(self._ring))
+            EVENTS.emit("span_end", name="pipeline.slot", span=slot.span)
+            return self._redispatch(slot, reg, "materialize", e)
+        self._slot_failures = 0
         if not ready:
             wait_ms = (perf_counter() - t0) * 1e3
             reg.counter("pipeline.stall.suggest_bound_ms").inc(wait_ms)
@@ -327,6 +429,20 @@ class PipelinedExecutor:
             reg.counter("fmin.trials.done").inc()
         else:  # "error"
             e = payload
+            fail_count = doc["misc"].get("fail_count", 0)
+            if (not draining and is_transient(e)
+                    and fail_count < it.max_trial_retries):
+                # Transient: charge the budget and resubmit the SAME doc
+                # to the evaluator — still RUNNING, same batch token, the
+                # open-count unchanged (one completion consumed, one
+                # evaluation re-queued).
+                doc["misc"]["fail_count"] = fail_count + 1
+                reg.counter("fmin.trials.retried").inc()
+                EVENTS.emit("trial_retry", trial=doc["tid"],
+                            attempt=fail_count + 1, error=type(e).__name__)
+                ev.task_done(item)
+                ev.submit(doc, item.ctrl, token=item.token)
+                return None, False
             logger.error("job exception: %s", e)
             doc["state"] = JOB_STATE_ERROR
             doc["misc"]["error"] = (type(e).__name__, str(e))

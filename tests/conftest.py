@@ -54,6 +54,11 @@ def pytest_configure(config):
         "markers",
         "slow: multi-minute statistical sweeps / subprocess fleets — "
         "`pytest -m 'not slow'` is the quick single-core loop")
+    config.addinivalue_line(
+        "markers",
+        "chaos: randomized fault-injection schedules (tests/test_faults.py) "
+        "— the quick tier keeps one bounded smoke; long schedules are "
+        "also marked slow")
 
 
 # -- per-file timing budget (round-3 verdict weak #7) -----------------------

@@ -135,6 +135,36 @@ class TestBenchmarkArtifacts:
                 "the 1.5x acceptance bar")
             assert head["meets_1p5x"] is True
 
+    def test_faults_overhead_artifact_schema(self):
+        """ISSUE 5 acceptance artifact: the fault-injection hooks' paired
+        A/B (disabled vs armed-at-zero-prob) with the maybe_fail
+        microbench — written by benchmarks/faults_overhead.py."""
+        paths = sorted(glob.glob(os.path.join(_BENCH_DIR,
+                                              "faults_overhead_*.json")))
+        assert paths, ("no benchmarks/faults_overhead_*.json artifact "
+                       "checked in")
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == \
+                "faults_overhead_disabled_vs_armed_zero_prob", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            modes = {r["mode"] for r in doc["rows"]}
+            assert modes == {"faults_disabled",
+                             "faults_armed_zero_prob"}, name
+            for r in doc["rows"]:
+                assert r["trials_per_sec_median"] > 0, f"{name}: {r}"
+                assert r["maybe_fail_ns"] > 0, f"{name}: {r}"
+            head = doc["headline"]
+            # the disabled path is the one production always pays: a
+            # single boolean check, sub-microsecond per call
+            assert head["maybe_fail_disabled_ns"] < 1000.0, (
+                f"{name}: disabled maybe_fail costs "
+                f"{head['maybe_fail_disabled_ns']}ns — the always-on hook "
+                "stopped being free")
+
     def test_device_ab_artifact_matches_its_bench(self):
         # the r6 device A/B (5 domains x 20 seeds, one conditional space)
         path = os.path.join(_BENCH_DIR, "quality_ab_fmin_vs_fmin_device.json")

@@ -1,0 +1,170 @@
+"""CPU A/B: fleet-observability context stamping disabled vs armed.
+
+ISSUE r6's overhead contract: the cross-process trace context
+(``obs/context.py``) puts stamping sites in every netstore RPC
+(``_Rpc.__call__``) and the suggest loop's insert path, so the DISABLED
+path must stay in the same cost class as ``faults.maybe_fail``'s
+disarmed gate — one module-global boolean check, budgeted at ~0.2 µs/op.
+Two probes:
+
+1. **Microbench** — ``wire_current`` and ``stamp_misc`` ns/op with the
+   context disarmed (the production fast path) and armed with a bound
+   context (the traced-run worst case: dict copy + string format).
+2. **End-to-end A/B** — the same seeded serial fmin, paired arms run
+   back-to-back: observability fully disabled vs armed via
+   ``trace_dir=`` (event log + context + doc stamping + artifact dump).
+   The jax device profiler is opted out via HYPEROPT_TPU_DEVICE_TRACE=0
+   so the armed arm measures THIS layer, not jax.profiler.start_trace
+   (which imports tensorflow and costs seconds on its own).
+
+Run::
+
+    env JAX_PLATFORMS=cpu python benchmarks/obs_fleet_overhead.py
+
+Writes ``benchmarks/obs_fleet_overhead_cpu_<stamp>.json``.  The budget
+note lives in DESIGN.md §6.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+N_EVALS = 150
+N_MICRO = 200_000
+SEED = 0
+
+# Measure the event/context layer, not the jax device profiler.
+os.environ["HYPEROPT_TPU_DEVICE_TRACE"] = "0"
+
+
+def _space():
+    import hyperopt_tpu as ho
+
+    hp = ho.hp
+    return {
+        "x": hp.uniform("x", -5, 5),
+        "lr": hp.loguniform("lr", -5, 0),
+        "c": hp.choice("c", [0, 1, 2]),
+    }
+
+
+def _objective(cfg):
+    return float(cfg["x"] ** 2 + 0.1 * cfg["c"])
+
+
+def _micro(armed: bool) -> dict:
+    """ns per op for the two hot-path entry points."""
+    from hyperopt_tpu.obs import context as ctx
+
+    if armed:
+        ctx.enable()
+        binder = ctx.bind(trace_id=ctx.new_trace_id(), tid=17)
+        binder.__enter__()
+    else:
+        assert not ctx.armed()
+    misc: dict = {}
+    wc, sm = ctx.wire_current, ctx.stamp_misc
+    wc()  # warm
+    t0 = time.perf_counter()
+    for _ in range(N_MICRO):
+        wc()
+    wire_ns = (time.perf_counter() - t0) / N_MICRO * 1e9
+    t0 = time.perf_counter()
+    for _ in range(N_MICRO):
+        sm(misc, tid=17)
+    stamp_ns = (time.perf_counter() - t0) / N_MICRO * 1e9
+    if armed:
+        binder.__exit__(None, None, None)
+        ctx.disable()
+    return {"wire_current_ns": wire_ns, "stamp_misc_ns": stamp_ns}
+
+
+def _fmin_arm(traced: bool) -> float:
+    """trials/sec for one seeded serial run."""
+    import hyperopt_tpu as ho
+
+    td = tempfile.mkdtemp(prefix="obs_ab_") if traced else None
+    t = ho.Trials()
+    t0 = time.perf_counter()
+    ho.fmin(_objective, _space(), algo=ho.tpe.suggest, max_evals=N_EVALS,
+            trials=t, rstate=np.random.default_rng(SEED),
+            show_progressbar=False, trace_dir=td)
+    tps = N_EVALS / (time.perf_counter() - t0)
+    if td:
+        shutil.rmtree(td, ignore_errors=True)
+    assert len(t) == N_EVALS
+    return tps
+
+
+def main():
+    from hyperopt_tpu.obs import context as ctx
+
+    # Warm-up absorbs every compile; then interleave paired arms A/B/A/B
+    # so drift (thermal, background load) cancels instead of biasing one.
+    _fmin_arm(False)
+    reps = 3
+    tps_off, tps_on = [], []
+    for _ in range(reps):
+        tps_off.append(_fmin_arm(False))
+        tps_on.append(_fmin_arm(True))
+
+    micro_off = _micro(False)
+    micro_on = _micro(True)
+    assert not ctx.armed()
+
+    med_off = float(np.median(tps_off))
+    med_on = float(np.median(tps_on))
+    overhead_pct = (med_off - med_on) / med_off * 100.0
+
+    doc = {
+        "metric": "obs_fleet_overhead_disabled_vs_armed",
+        "backend": "cpu",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "n_evals": N_EVALS,
+        "reps": reps,
+        "seed": SEED,
+        "headline": {
+            "wire_current_disabled_ns": round(micro_off["wire_current_ns"], 1),
+            "stamp_misc_disabled_ns": round(micro_off["stamp_misc_ns"], 1),
+            "wire_current_armed_ns": round(micro_on["wire_current_ns"], 1),
+            "stamp_misc_armed_ns": round(micro_on["stamp_misc_ns"], 1),
+            "fmin_overhead_pct_traced_vs_disabled": round(overhead_pct, 2),
+            # the ~0.2 µs/op acceptance bound on the disabled path
+            "disabled_within_200ns": bool(
+                micro_off["wire_current_ns"] < 200.0
+                and micro_off["stamp_misc_ns"] < 200.0),
+        },
+        "rows": [
+            {"mode": "obs_disabled",
+             "trials_per_sec_median": round(med_off, 2),
+             "trials_per_sec_all": [round(v, 2) for v in tps_off],
+             "wire_current_ns": round(micro_off["wire_current_ns"], 1),
+             "stamp_misc_ns": round(micro_off["stamp_misc_ns"], 1)},
+            {"mode": "obs_armed_trace_dir",
+             "trials_per_sec_median": round(med_on, 2),
+             "trials_per_sec_all": [round(v, 2) for v in tps_on],
+             "wire_current_ns": round(micro_on["wire_current_ns"], 1),
+             "stamp_misc_ns": round(micro_on["stamp_misc_ns"], 1)},
+        ],
+    }
+    stamp = time.strftime("%Y%m%d")
+    path = os.path.join(_ROOT, "benchmarks",
+                        f"obs_fleet_overhead_cpu_{stamp}.json")
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    print(json.dumps(doc, indent=1))
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -141,6 +141,12 @@ def maybe_fail(point: str, **ctx) -> None:
         call_no = p.calls
     _metrics.registry().counter("faults.injected").inc()
     _metrics.registry().counter(f"faults.injected.{point}").inc()
+    # Callers pass the trial id as ``tid=``; the event schema's trial key
+    # is ``trial`` — normalize so fault events attach to trial lanes in
+    # merged traces (obs/events.events_to_chrome anchors on "trial").
+    tid = ctx.pop("tid", None)
+    if tid is not None and "trial" not in ctx:
+        ctx["trial"] = tid
     _events.EVENTS.emit("fault_injected", name=point, call_no=call_no, **ctx)
     raise InjectedFault(point, call_no=call_no)
 

@@ -35,6 +35,7 @@ from .base import (
     coarse_utcnow,
 )
 from .exceptions import AllTrialsFailed, is_transient
+from .obs import context as _context
 from .obs import metrics as _metrics
 from .obs.events import EVENTS
 from .space import compile_space
@@ -221,22 +222,27 @@ class FMinIter:
             ctrl = Ctrl(self.trials, current_trial=trial)
             try:
                 spec = base.spec_from_misc(trial["misc"])
-                while True:
-                    try:
-                        result = self.domain.evaluate(spec, ctrl)
-                        break
-                    except Exception as e:
-                        fail_count = trial["misc"].get("fail_count", 0)
-                        if not (is_transient(e)
-                                and fail_count < self.max_trial_retries):
-                            raise
-                        # Transient: charge the budget and re-run the SAME
-                        # point instead of losing it to a permanent FAIL.
-                        trial["misc"]["fail_count"] = fail_count + 1
-                        _reg.counter("fmin.trials.retried").inc()
-                        EVENTS.emit("trial_retry", trial=trial["tid"],
-                                    attempt=fail_count + 1,
-                                    error=type(e).__name__)
+                # Events emitted inside the objective (faults, compiles,
+                # user instrumentation) attach to this trial via the
+                # ambient context; free when tracing is disarmed.
+                with _context.bind_doc(trial):
+                    while True:
+                        try:
+                            result = self.domain.evaluate(spec, ctrl)
+                            break
+                        except Exception as e:
+                            fail_count = trial["misc"].get("fail_count", 0)
+                            if not (is_transient(e)
+                                    and fail_count < self.max_trial_retries):
+                                raise
+                            # Transient: charge the budget and re-run the
+                            # SAME point instead of losing it to a
+                            # permanent FAIL.
+                            trial["misc"]["fail_count"] = fail_count + 1
+                            _reg.counter("fmin.trials.retried").inc()
+                            EVENTS.emit("trial_retry", trial=trial["tid"],
+                                        attempt=fail_count + 1,
+                                        error=type(e).__name__)
             except Exception as e:
                 logger.error("job exception: %s", e)
                 trial["state"] = JOB_STATE_ERROR
@@ -273,6 +279,17 @@ class FMinIter:
                     # them (reference: SparkTrials cancellation on timeout).
                     self._cancel_inflight("fmin timeout")
                     cancelled = True
+                if cancelled and not callable(
+                        getattr(self.trials, "cancel_inflight", None)):
+                    # The backend can't cancel (file/net stores): trials
+                    # left NEW/RUNNING may never finish — a dead worker
+                    # fleet would park us here forever.  Return with
+                    # best-so-far; the store keeps the stragglers.
+                    logger.warning(
+                        "fmin timeout with %d unfinished trial(s) left "
+                        "in the store",
+                        self.trials.count_by_state_unsynced(unfinished))
+                    break
                 time.sleep(self.poll_interval_secs)
                 self.trials.refresh()
         else:
@@ -322,6 +339,16 @@ class FMinIter:
             if new_trials is None or len(new_trials) == 0:
                 stopped = True
             else:
+                if _context.armed():
+                    # Stamp the run's trace context into each doc so any
+                    # process that later claims it (netstore server, file
+                    # or net workers) attaches its spans to this trial.
+                    for doc in new_trials:
+                        _context.stamp_misc(doc["misc"], tid=doc["tid"],
+                                            trace_id=self.tracer.trace_id)
+                if EVENTS.enabled:
+                    for doc in new_trials:
+                        EVENTS.emit("trial_queued", trial=doc["tid"])
                 with self.tracer.span("store"):
                     trials.insert_trial_docs(new_trials)
                     trials.refresh()
@@ -584,7 +611,7 @@ def fmin(fn, space, algo=None, max_evals=None,
             verbose=verbose, catch_eval_exceptions=catch_eval_exceptions,
             return_argmin=return_argmin, show_progressbar=show_progressbar,
             early_stop_fn=early_stop_fn, trials_save_file=trials_save_file,
-            max_trial_retries=max_trial_retries)
+            max_trial_retries=max_trial_retries, trace_dir=trace_dir)
 
     domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)
 

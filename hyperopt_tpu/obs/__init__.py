@@ -22,10 +22,19 @@ makes telemetry first-class, in three layers:
   (span aggregation + ``jax.profiler`` device traces) which arms the
   event log for the run and writes ``loop_trace.json`` /
   ``loop_events.jsonl`` / ``chrome_trace.json`` into its ``trace_dir``.
+* :mod:`~hyperopt_tpu.obs.context` — **cross-process trace context**
+  (``trace_id``/``span``/``tid``), stamped by the driver into netstore
+  RPC bodies and trial ``misc``, adopted by the server and workers so
+  every process's events attach to the originating trial; armed by the
+  Tracer alongside the event log, one-boolean-check free when disarmed.
 
 Surfacing: ``hyperopt-tpu-show trace <dir>`` renders a per-phase summary
-table from a trace directory; the netstore server exposes the registry
-via a token-gated ``GET /metrics``.
+table from a trace directory; ``hyperopt-tpu-show trace --merge <dirs…>``
+clock-normalizes several processes' ``loop_events.jsonl`` into one
+Perfetto trace with per-trial flow arrows; ``hyperopt-tpu-show live
+<url>`` polls a netstore's fleet metrics into a terminal dashboard; the
+netstore server exposes local + per-worker + merged fleet metrics via a
+token-gated ``GET /metrics``.
 
 Everything here is host-side bookkeeping — nothing in this package ever
 touches the traced/compiled XLA programs.
@@ -33,12 +42,16 @@ touches the traced/compiled XLA programs.
 
 from __future__ import annotations
 
-from .events import EVENTS, EventLog  # noqa: F401
+from . import context  # noqa: F401
+from .events import EVENTS, EventLog, events_to_chrome  # noqa: F401
 from .metrics import (  # noqa: F401
     MetricsRegistry,
     kernel_cache_event,
     kernel_cache_stats,
+    merge_histogram_states,
+    merge_snapshots,
     metrics_enabled,
     registry,
+    summarize_state,
 )
 from .trace import NullTracer, Tracer  # noqa: F401

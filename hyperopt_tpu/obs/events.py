@@ -41,12 +41,16 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import socket
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
 
-__all__ = ["EVENTS", "EventLog", "EVENT_TYPES", "DEFAULT_CAPACITY"]
+from . import context as _context
+
+__all__ = ["EVENTS", "EventLog", "EVENT_TYPES", "DEFAULT_CAPACITY",
+           "events_to_chrome"]
 
 DEFAULT_CAPACITY = 65536
 
@@ -75,6 +79,9 @@ EVENT_TYPES = frozenset(
         "pipeline_fallback",
         "fault_injected",
         "trial_retry",
+        "trial_queued",
+        "store_heartbeat",
+        "rpc",
     }
 )
 
@@ -105,6 +112,28 @@ class EventLog:
         # the two clocks can never disagree about event ordering.
         self._wall0 = time.time()
         self._mono0 = time.perf_counter()
+        # Process identity + clock anchor, exported as the first line of
+        # dump_jsonl() so the cross-process merger (show.py merge_traces)
+        # can clock-normalize and label each lane.  ``skew_s`` is this
+        # process's estimated wall-clock offset *relative to the netstore
+        # server* (set from heartbeat replies); the merger subtracts it.
+        self._meta = {
+            "pid": os.getpid(),
+            "host": socket.gethostname(),
+            "wall0": self._wall0,
+            "mono0": self._mono0,
+            "skew_s": 0.0,
+        }
+
+    # -- process metadata ------------------------------------------------
+    def set_meta(self, **kw) -> None:
+        """Attach/override header fields (worker_id, role, trace_id, skew_s)."""
+        with self._lock:
+            self._meta.update(kw)
+
+    def meta(self) -> dict:
+        with self._lock:
+            return dict(self._meta)
 
     # -- arming ----------------------------------------------------------
     @property
@@ -152,6 +181,18 @@ class EventLog:
         if "span" not in fields and stack:
             rec["span"] = stack[-1]
         rec.update(fields)
+        # Ambient trace context (obs.context): events recorded while a
+        # cross-process context is bound attach to the originating trial
+        # even when the call site doesn't know the tid (fault injections,
+        # RPC dispatch, store writes on behalf of a remote caller).
+        if _context._armed:
+            ctx = getattr(_context._tls, "ctx", None)
+            if ctx:
+                tid = ctx.get("trace_id")
+                if tid is not None and "trace_id" not in rec:
+                    rec["trace_id"] = tid
+                if rec.get("trial") is None and ctx.get("tid") is not None:
+                    rec["trial"] = ctx["tid"]
         with self._lock:
             self._buf.append(rec)
             self.n_emitted += 1
@@ -184,9 +225,17 @@ class EventLog:
             return len(self._buf)
 
     def dump_jsonl(self, path) -> int:
-        """Write one JSON object per line; returns the number written."""
+        """Write one JSON object per line; returns the number of events.
+
+        The first line is a ``{"type": "meta", ...}`` header carrying the
+        process identity and wall/mono clock anchor (plus ``skew_s``, the
+        heartbeat-estimated offset from the server clock) — the merger's
+        clock-normalization input.  Readers that iterate records should
+        skip ``type == "meta"``.
+        """
         events = self.snapshot()
         with open(path, "w") as fh:
+            fh.write(json.dumps({"type": "meta", **self.meta()}) + "\n")
             for rec in events:
                 fh.write(json.dumps(rec) + "\n")
         return len(events)
@@ -202,72 +251,7 @@ class EventLog:
         """
         if events is None:
             events = self.snapshot()
-        pid = os.getpid()
-        tids: dict = {}
-
-        def _tid(thread_name):
-            return tids.setdefault(thread_name, len(tids) + 1)
-
-        open_spans: dict = {}
-        out = []
-        for rec in events:
-            ph_args = {
-                k: v
-                for k, v in rec.items()
-                if k not in ("type", "name", "t_mono", "t_wall", "thread")
-            }
-            ts_us = rec["t_wall"] * 1e6
-            if rec["type"] == "span_begin":
-                open_spans[rec.get("span")] = rec
-            elif rec["type"] == "span_end":
-                begin = open_spans.pop(rec.get("span"), None)
-                if begin is None:
-                    continue  # begin fell out of the ring buffer
-                out.append(
-                    {
-                        "name": begin.get("name", "span"),
-                        "ph": "X",
-                        "ts": begin["t_wall"] * 1e6,
-                        "dur": max(0.0, (rec["t_mono"] - begin["t_mono"]) * 1e6),
-                        "pid": pid,
-                        "tid": _tid(begin["thread"]),
-                        "cat": "hyperopt_tpu",
-                        "args": {
-                            k: v
-                            for k, v in begin.items()
-                            if k not in ("type", "name", "t_mono", "t_wall", "thread")
-                        },
-                    }
-                )
-            else:
-                out.append(
-                    {
-                        "name": rec.get("name", rec["type"]),
-                        "ph": "i",
-                        "s": "t",
-                        "ts": ts_us,
-                        "pid": pid,
-                        "tid": _tid(rec["thread"]),
-                        "cat": "hyperopt_tpu:" + rec["type"],
-                        "args": ph_args,
-                    }
-                )
-        # Spans still open when the log was read: emit as zero-length marks
-        # so the trace stays loadable.
-        for begin in open_spans.values():
-            out.append(
-                {
-                    "name": begin.get("name", "span"),
-                    "ph": "i",
-                    "s": "t",
-                    "ts": begin["t_wall"] * 1e6,
-                    "pid": pid,
-                    "tid": _tid(begin["thread"]),
-                    "cat": "hyperopt_tpu:span_open",
-                    "args": {},
-                }
-            )
-        out.sort(key=lambda e: e["ts"])
+        out, _ = events_to_chrome(events, pid=os.getpid())
         return {"traceEvents": out, "displayTimeUnit": "ms"}
 
     def export_chrome_trace(self, path) -> int:
@@ -275,6 +259,109 @@ class EventLog:
         with open(path, "w") as fh:
             json.dump(trace, fh)
         return len(trace["traceEvents"])
+
+
+def events_to_chrome(events: list, pid: int | None = None, ts_fn=None):
+    """Convert structured event records into Chrome ``trace_event`` dicts.
+
+    The shared conversion core behind :meth:`EventLog.to_chrome_trace`
+    (single process) and ``show.py``'s ``merge_traces`` (many processes):
+
+    * ``pid`` — the lane the events render into (the merger assigns one
+      per source process),
+    * ``ts_fn`` — optional ``rec -> wall seconds`` override; the merger
+      passes each file's own ``wall0 + (t_mono - mono0) - skew_s``
+      normalization so lanes from different machines line up.
+
+    Returns ``(trace_events, anchors)``: ``anchors`` is one
+    ``(ts_us, pid, tid_lane, trial, type)`` tuple per converted record
+    that carries a trial id — the attachment points for the merger's
+    per-trial cross-lane flow arrows.  ``meta`` header records are
+    skipped so a raw ``loop_events.jsonl`` can be fed directly.
+    """
+    if pid is None:
+        pid = os.getpid()
+    if ts_fn is None:
+        ts_fn = lambda rec: rec["t_wall"]  # noqa: E731
+    tids: dict = {}
+
+    def _tid(thread_name):
+        return tids.setdefault(thread_name, len(tids) + 1)
+
+    open_spans: dict = {}
+    out = []
+    anchors = []
+
+    def _anchor(rec, ts_us, lane):
+        if rec.get("trial") is not None:
+            anchors.append((ts_us, pid, lane, rec["trial"], rec["type"]))
+
+    for rec in events:
+        if rec.get("type") == "meta":
+            continue
+        ph_args = {
+            k: v
+            for k, v in rec.items()
+            if k not in ("type", "name", "t_mono", "t_wall", "thread")
+        }
+        ts_us = ts_fn(rec) * 1e6
+        if rec["type"] == "span_begin":
+            open_spans[rec.get("span")] = rec
+        elif rec["type"] == "span_end":
+            begin = open_spans.pop(rec.get("span"), None)
+            if begin is None:
+                continue  # begin fell out of the ring buffer
+            lane = _tid(begin["thread"])
+            begin_us = ts_fn(begin) * 1e6
+            out.append(
+                {
+                    "name": begin.get("name", "span"),
+                    "ph": "X",
+                    "ts": begin_us,
+                    "dur": max(0.0, (rec["t_mono"] - begin["t_mono"]) * 1e6),
+                    "pid": pid,
+                    "tid": lane,
+                    "cat": "hyperopt_tpu",
+                    "args": {
+                        k: v
+                        for k, v in begin.items()
+                        if k not in ("type", "name", "t_mono", "t_wall", "thread")
+                    },
+                }
+            )
+            _anchor(begin, begin_us, lane)
+        else:
+            lane = _tid(rec["thread"])
+            out.append(
+                {
+                    "name": rec.get("name", rec["type"]),
+                    "ph": "i",
+                    "s": "t",
+                    "ts": ts_us,
+                    "pid": pid,
+                    "tid": lane,
+                    "cat": "hyperopt_tpu:" + rec["type"],
+                    "args": ph_args,
+                }
+            )
+            _anchor(rec, ts_us, lane)
+    # Spans still open when the log was read: emit as zero-length marks
+    # so the trace stays loadable.
+    for begin in open_spans.values():
+        out.append(
+            {
+                "name": begin.get("name", "span"),
+                "ph": "i",
+                "s": "t",
+                "ts": ts_fn(begin) * 1e6,
+                "pid": pid,
+                "tid": _tid(begin["thread"]),
+                "cat": "hyperopt_tpu:span_open",
+                "args": {},
+            }
+        )
+    out.sort(key=lambda e: e["ts"])
+    return out, anchors
 
 
 #: Process-global event log; disabled until a Tracer (or a test) arms it.

@@ -64,6 +64,9 @@ __all__ = [
     "metrics_enabled",
     "kernel_cache_event",
     "kernel_cache_stats",
+    "merge_histogram_states",
+    "summarize_state",
+    "merge_snapshots",
 ]
 
 # Log-spaced latency bucket upper bounds (seconds): 100µs .. ~52s, ×2 per
@@ -179,6 +182,24 @@ class Histogram:
                 "p99": self._quantile_locked(0.99),
             }
 
+    def state(self) -> dict:
+        """Mergeable wire form: the full bucket vector plus the scalars.
+
+        Two states with identical ``bounds`` merge losslessly by summing
+        counts (:func:`merge_histogram_states`) — this is what workers
+        piggyback on heartbeats and what the server aggregates into the
+        fleet view.  JSON-serializable by construction.
+        """
+        with self._reg._lock:
+            return {
+                "bounds": list(self.bounds),
+                "counts": list(self._counts),
+                "count": self._count,
+                "sum": self._sum,
+                "min": self._min,
+                "max": self._max,
+            }
+
 
 class MetricsRegistry:
     """Lock-protected name → metric table with one-call snapshot."""
@@ -251,8 +272,14 @@ class MetricsRegistry:
         return out
 
     # -- readout ---------------------------------------------------------
-    def snapshot(self, reset: bool = False) -> dict:
-        """One consistent read of everything, for /metrics and benches."""
+    def snapshot(self, reset: bool = False, states: bool = False) -> dict:
+        """One consistent read of everything, for /metrics and benches.
+
+        ``states=True`` additionally embeds each histogram's mergeable
+        :meth:`Histogram.state` under a ``"state"`` key — the wire form
+        workers piggyback on heartbeats so the server can merge exact
+        bucket counts instead of unmergeable quantile summaries.
+        """
         with self._lock:
             out = {
                 "enabled": self._enabled,
@@ -267,9 +294,15 @@ class MetricsRegistry:
                 },
             }
         # Histogram.summary takes the same lock; collect outside the hold.
-        out["histograms"] = {
-            n: h.summary() for n, h in sorted(self._histograms.items())
-        }
+        if states:
+            out["histograms"] = {
+                n: {**h.summary(), "state": h.state()}
+                for n, h in sorted(self._histograms.items())
+            }
+        else:
+            out["histograms"] = {
+                n: h.summary() for n, h in sorted(self._histograms.items())
+            }
         if reset:
             self.reset()
         return out
@@ -288,6 +321,120 @@ class MetricsRegistry:
                 h._min = None
                 h._max = None
             self._kernel_cache = {"requests": 0, "misses": 0, "by_key": {}}
+
+
+# ---------------------------------------------------------------------------
+# cross-process aggregation (fleet /metrics)
+# ---------------------------------------------------------------------------
+
+
+def merge_histogram_states(states) -> Optional[dict]:
+    """Merge :meth:`Histogram.state` dicts by summing bucket counts.
+
+    The merge is **associative and commutative** (integer bucket sums,
+    float sum accumulation, min/max of extrema — tests pin associativity
+    in tests/test_obs_fleet.py), so the server can fold worker snapshots
+    in any arrival order.  All inputs must share identical ``bounds``;
+    mismatched bucket layouts raise ``ValueError`` rather than silently
+    mis-binning.  Falsy entries are skipped; merging nothing returns None.
+    """
+    states = [s for s in states if s]
+    if not states:
+        return None
+    bounds = list(states[0]["bounds"])
+    counts = [0] * (len(bounds) + 1)
+    count = 0
+    total = 0.0
+    mn = None
+    mx = None
+    for s in states:
+        if list(s["bounds"]) != bounds:
+            raise ValueError(
+                f"cannot merge histograms with different bucket bounds "
+                f"({len(s['bounds'])} vs {len(bounds)} buckets)")
+        for i, c in enumerate(s["counts"]):
+            counts[i] += c
+        count += s["count"]
+        total += s["sum"]
+        if s["min"] is not None and (mn is None or s["min"] < mn):
+            mn = s["min"]
+        if s["max"] is not None and (mx is None or s["max"] > mx):
+            mx = s["max"]
+    return {"bounds": bounds, "counts": counts, "count": count,
+            "sum": total, "min": mn, "max": mx}
+
+
+def _state_quantile(state: dict, q: float):
+    # Same bucket-upper-bound approximation as Histogram._quantile_locked.
+    count = state["count"]
+    if count == 0:
+        return None
+    target = q * count
+    seen = 0
+    bounds = state["bounds"]
+    for i, c in enumerate(state["counts"]):
+        seen += c
+        if seen >= target:
+            return bounds[i] if i < len(bounds) else state["max"]
+    return state["max"]
+
+
+def summarize_state(state: dict) -> dict:
+    """:meth:`Histogram.summary`-schema dict computed from a state
+    (merged or single); same bucket-upper-bound quantile approximation,
+    so a quantile of a merged state is bounded below by the largest
+    member's same-quantile bucket lower bound and above by its upper
+    bound — the invariant the quantile-bounds test pins."""
+    if not state or state["count"] == 0:
+        return {"count": 0}
+    return {
+        "count": state["count"],
+        "sum": state["sum"],
+        "mean": state["sum"] / state["count"],
+        "min": state["min"],
+        "max": state["max"],
+        "p50": _state_quantile(state, 0.50),
+        "p90": _state_quantile(state, 0.90),
+        "p95": _state_quantile(state, 0.95),
+        "p99": _state_quantile(state, 0.99),
+    }
+
+
+def merge_snapshots(snaps) -> dict:
+    """Fold registry snapshots from several processes into one fleet view.
+
+    Counters and gauges **sum** across members (fleet trials/s is the sum
+    of worker rates; occupancy and backlog likewise aggregate by sum —
+    last-write gauges that don't sum meaningfully, like clock skew, are
+    read from the per-worker labels instead).  Histograms merge exactly
+    when members carry ``"state"`` (``snapshot(states=True)``); entries
+    without state are skipped — summaries alone are not mergeable.
+    """
+    counters: dict = {}
+    gauges: dict = {}
+    hstates: dict = {}
+    for snap in snaps:
+        if not snap:
+            continue
+        for k, v in (snap.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0.0) + v
+        for k, v in (snap.get("gauges") or {}).items():
+            gauges[k] = gauges.get(k, 0.0) + v
+        for k, h in (snap.get("histograms") or {}).items():
+            st = h.get("state") if isinstance(h, dict) else None
+            if st:
+                hstates.setdefault(k, []).append(st)
+    histograms = {}
+    for k in sorted(hstates):
+        merged = merge_histogram_states(hstates[k])
+        entry = summarize_state(merged)
+        entry["state"] = merged
+        histograms[k] = entry
+    return {
+        "counters": dict(sorted(counters.items())),
+        "gauges": dict(sorted(gauges.items())),
+        "histograms": histograms,
+    }
 
 
 _REGISTRY = MetricsRegistry()

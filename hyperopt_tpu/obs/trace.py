@@ -32,6 +32,7 @@ from collections import defaultdict
 from contextlib import contextmanager
 from typing import Optional
 
+from . import context as _context
 from .events import EVENTS
 
 __all__ = ["Tracer", "NullTracer"]
@@ -59,6 +60,12 @@ class Tracer:
                  device_trace: bool = False,
                  events=EVENTS):
         self.trace_dir = trace_dir
+        # jax.profiler.start_trace drags in the TF import chain (~5 s) and
+        # dominates short CPU runs; HYPEROPT_TPU_DEVICE_TRACE=0 keeps the
+        # event/context layer while opting out of the device profiler.
+        if os.environ.get("HYPEROPT_TPU_DEVICE_TRACE", "1").lower() in (
+                "0", "false", "no"):
+            device_trace = False
         self.device_trace = device_trace and trace_dir is not None
         self.events = events
         # Span totals are mutated from the main loop AND the
@@ -71,13 +78,23 @@ class Tracer:
         self._depth = threading.local()
         self._started = False
         self._armed_events = False
-        self._t0 = time.perf_counter()
-        self._wall_s = None
+        self._armed_context = False
+        self.trace_id = None
         if trace_dir:
             os.makedirs(trace_dir, exist_ok=True)
             if not self.events.enabled:
                 self.events.enable()
                 self._armed_events = True
+            # Cross-process trace context rides along with the event log:
+            # a traced run stamps its RPCs and trial docs so server and
+            # worker events attach to this run's trials (obs/context.py).
+            if not _context.armed():
+                _context.enable()
+                self._armed_context = True
+            self.trace_id = _context.new_trace_id()
+            self.events.set_meta(trace_id=self.trace_id)
+        self._t0 = time.perf_counter()
+        self._wall_s = None
 
     # -- spans ---------------------------------------------------------------
 
@@ -178,6 +195,9 @@ class Tracer:
             self.events.disable()
             self.events.clear()
             self._armed_events = False
+        if self._armed_context:
+            _context.disable()
+            self._armed_context = False
         return path
 
 

@@ -48,6 +48,7 @@ except ImportError:  # pragma: no cover
 from .. import base
 from .. import faults as _faults
 from ..exceptions import is_transient
+from ..obs import context as _context
 from ..obs import metrics as _metrics
 from ..obs.events import EVENTS
 from ..base import (
@@ -306,11 +307,34 @@ class FileTrials(Trials):
 
         Owner-fenced like :meth:`write_result`: a presumed-dead worker whose
         trial was requeued must not resurrect its stale doc over the new
-        claimant's state."""
+        claimant's state.
+
+        A beat is a liveness stamp ONLY: the stored doc is re-read and just
+        ``refresh_time`` is rewritten.  Writing the caller's snapshot back
+        (as this method once did) let a beat in flight while
+        ``write_result`` landed resurrect the pre-result RUNNING doc — a
+        lost update that left the driver waiting forever on a trial its
+        worker had already finished."""
         if owner is not None and not self.owns(doc, owner):
+            # Name the fenced worker: the requeue/attribution story needs
+            # to show WHO tried to beat on a claim they no longer hold.
+            _metrics.registry().counter("store.heartbeat.fenced").inc()
+            EVENTS.emit("store_heartbeat", trial=doc["tid"], owner=owner,
+                        ok=False)
             return False
-        doc["refresh_time"] = coarse_utcnow()
-        self._write_doc(doc)
+        with self._lock:
+            try:
+                with open(self._doc_path(doc["tid"])) as f:
+                    cur = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                return False
+            if cur["state"] != JOB_STATE_RUNNING:
+                # Finished (or requeued) while this beat was in flight:
+                # nothing to keep alive, and nothing to overwrite.
+                return cur["state"] in (JOB_STATE_DONE, JOB_STATE_ERROR)
+            cur["refresh_time"] = coarse_utcnow()
+            self._write_doc(cur)
+            doc["refresh_time"] = cur["refresh_time"]
         return True
 
     def owns(self, doc, owner: str) -> bool:
@@ -333,8 +357,12 @@ class FileTrials(Trials):
                            doc["tid"], owner)
             _metrics.registry().counter("store.write.fenced").inc()
             return False
-        doc["refresh_time"] = coarse_utcnow()
-        self._write_doc(doc)
+        with self._lock:
+            # Serialized against heartbeat's read-modify-write so an
+            # in-process beat can never interleave with the result write
+            # (the StoreServer handles both on concurrent threads).
+            doc["refresh_time"] = coarse_utcnow()
+            self._write_doc(doc)
         _metrics.registry().counter("store.write.ok").inc()
         EVENTS.emit("store_write", trial=doc["tid"],
                     state=doc.get("state"))
@@ -357,6 +385,10 @@ class FileTrials(Trials):
             if doc["state"] == JOB_STATE_RUNNING:
                 last = doc.get("refresh_time") or doc.get("book_time") or 0
                 if now - last > timeout:
+                    # Capture the abandoned owner BEFORE clearing it: the
+                    # janitor's event log must name who went silent, or a
+                    # chaos run's requeues are unattributable.
+                    owner = doc.get("owner")
                     try:
                         os.unlink(claim)
                     except FileNotFoundError:
@@ -365,16 +397,28 @@ class FileTrials(Trials):
                     doc["owner"] = None
                     self._write_doc(doc)
                     n += 1
+                    EVENTS.emit("store_requeue", trial=doc["tid"],
+                                owner=owner, reason="stale_heartbeat")
             elif doc["state"] == JOB_STATE_NEW:
                 try:
                     if now - os.stat(claim).st_mtime > timeout:
+                        # Orphan claim (worker died between winning the
+                        # claim and persisting RUNNING): the claim file
+                        # itself is the only record of the owner — read
+                        # it before the unlink destroys it.
+                        try:
+                            with open(claim) as f:
+                                owner = f.read()
+                        except OSError:
+                            owner = None
                         os.unlink(claim)
                         n += 1
+                        EVENTS.emit("store_requeue", trial=doc["tid"],
+                                    owner=owner, reason="orphan_claim")
                 except (FileNotFoundError, OSError):
                     pass
         if n:
             _metrics.registry().counter("store.requeued").inc(n)
-            EVENTS.emit("store_requeue", n=n)
             self.refresh()
         return n
 
@@ -390,8 +434,13 @@ class FileWorker:
     def __init__(self, root, exp_key="default", domain=None,
                  poll_interval=0.1, reserve_timeout=None,
                  max_consecutive_failures=4, workdir=None,
-                 heartbeat_interval=15.0, max_trial_retries=0):
+                 heartbeat_interval=15.0, max_trial_retries=0,
+                 trace_dir=None):
         self.trials = self._make_trials(root, exp_key)
+        # Observability: when set, run() arms the event log via a Tracer
+        # and dumps loop_events.jsonl (+ chrome trace) here on exit — one
+        # lane of a `hyperopt-tpu-show trace --merge` fleet trace.
+        self.trace_dir = trace_dir
         self._domain = domain
         self.poll_interval = poll_interval
         self.reserve_timeout = reserve_timeout
@@ -434,22 +483,37 @@ class FileWorker:
         # requeue_stale can tell a live worker from a crashed one.
         stop_hb = threading.Event()
 
+        def _one_beat():
+            try:
+                self.trials.heartbeat(doc, owner=self.owner)
+            except Exception:
+                # Never let one failed beat kill the thread: the main
+                # thread mutates ``doc`` concurrently, so serialization
+                # can raise RuntimeError mid-iteration (not just OSError);
+                # a silently-dead heartbeat would get a live trial
+                # requeued as stale and evaluated twice.
+                logger.debug("heartbeat skipped (tid %s)", doc["tid"],
+                             exc_info=True)
+
         def _beat():
+            # One immediate beat at claim time: announces liveness (and,
+            # over netstore, piggybacks this worker's metrics snapshot)
+            # even when trials finish faster than heartbeat_interval.
+            _one_beat()
             while not stop_hb.wait(self.heartbeat_interval):
-                try:
-                    self.trials.heartbeat(doc, owner=self.owner)
-                except Exception:
-                    # Never let one failed beat kill the thread: the main
-                    # thread mutates ``doc`` concurrently, so serialization
-                    # can raise RuntimeError mid-iteration (not just OSError);
-                    # a silently-dead heartbeat would get a live trial
-                    # requeued as stale and evaluated twice.
-                    logger.debug("heartbeat skipped (tid %s)", doc["tid"],
-                                 exc_info=True)
+                _one_beat()
 
         hb = threading.Thread(target=_beat, daemon=True)
         hb.start()
+        # Adopt the trial's trace context (doc["misc"]["trace"], stamped
+        # by a traced driver at insert; falls back to the bare tid) for
+        # the whole evaluation: every event below — and every RPC this
+        # worker makes while evaluating — attaches to the originating
+        # trial.  No-op shared context manager when tracing is disarmed.
+        trace_ctx = _context.bind_doc(doc)
+        trace_ctx.__enter__()
         try:
+            EVENTS.emit("trial_start", trial=doc["tid"], owner=self.owner)
             if self.workdir:
                 # Per-trial scratch dir, exposed via ctrl (NOT os.chdir —
                 # workers may share a process as threads, and chdir is
@@ -459,37 +523,53 @@ class FileWorker:
                 os.makedirs(wd, exist_ok=True)
                 ctrl.workdir = wd
             spec = base.spec_from_misc(doc["misc"])
-            while True:
-                try:
-                    _faults.maybe_fail("worker.evaluate", tid=doc["tid"])
-                    result = self.domain.evaluate(spec, ctrl)
-                    break
-                except Exception as e:
-                    fail_count = doc["misc"].get("fail_count", 0)
-                    if not (is_transient(e)
-                            and fail_count < self.max_trial_retries):
-                        raise
-                    doc["misc"]["fail_count"] = fail_count + 1
-                    _metrics.registry().counter("worker.trial_retries").inc()
-                    EVENTS.emit("trial_retry", trial=doc["tid"],
-                                attempt=fail_count + 1,
-                                error=type(e).__name__)
+            with EVENTS.span("evaluate", trial=doc["tid"]):
+                while True:
+                    try:
+                        _faults.maybe_fail("worker.evaluate",
+                                           tid=doc["tid"])
+                        result = self.domain.evaluate(spec, ctrl)
+                        break
+                    except Exception as e:
+                        fail_count = doc["misc"].get("fail_count", 0)
+                        if not (is_transient(e)
+                                and fail_count < self.max_trial_retries):
+                            raise
+                        doc["misc"]["fail_count"] = fail_count + 1
+                        _metrics.registry().counter(
+                            "worker.trial_retries").inc()
+                        EVENTS.emit("trial_retry", trial=doc["tid"],
+                                    attempt=fail_count + 1,
+                                    error=type(e).__name__)
         except Exception as e:
             logger.error("worker job exception (tid %s): %s", doc["tid"], e)
             doc["state"] = JOB_STATE_ERROR
             doc["misc"]["error"] = (type(e).__name__, str(e))
             self.trials.write_result(doc, owner=self.owner)
+            EVENTS.emit("trial_end", trial=doc["tid"], state="error",
+                        error=type(e).__name__, owner=self.owner)
             raise
         else:
             doc["state"] = JOB_STATE_DONE
             doc["result"] = result
-            return self.trials.write_result(doc, owner=self.owner)
+            ok = self.trials.write_result(doc, owner=self.owner)
+            EVENTS.emit("trial_end", trial=doc["tid"], state="done",
+                        loss=result.get("loss"), owner=self.owner)
+            return ok
         finally:
             stop_hb.set()
+            trace_ctx.__exit__(None, None, None)
 
     def run(self) -> int:
         """Serve jobs until idle past ``reserve_timeout``; returns #done."""
         _reg = _metrics.registry()
+        tracer = None
+        if self.trace_dir:
+            # Arm the event log (and cross-process context) for the
+            # worker's lifetime; dump one lane's worth of events on exit.
+            from ..obs.trace import Tracer
+            tracer = Tracer(self.trace_dir)
+            EVENTS.set_meta(worker_id=self.owner, role="worker")
         _reg.counter("worker.up").inc()
         EVENTS.emit("worker_up", name=self.owner)
         n_done = 0
@@ -524,6 +604,8 @@ class FileWorker:
         finally:
             _reg.counter("worker.down").inc()
             EVENTS.emit("worker_down", name=self.owner, n_done=n_done)
+            if tracer is not None:
+                tracer.dump()
 
 
 def main(argv=None):
@@ -544,13 +626,17 @@ def main(argv=None):
                         "transient failure before it is marked ERROR "
                         "(default 0 = fail fast)")
     p.add_argument("--workdir", default=None)
+    p.add_argument("--trace-dir", default=None,
+                   help="write this worker's loop_events.jsonl (+ chrome "
+                        "trace) here on exit, for "
+                        "`hyperopt-tpu-show trace --merge`")
     args = p.parse_args(argv)
     worker = FileWorker(args.root, exp_key=args.exp_key,
                         poll_interval=args.poll_interval,
                         reserve_timeout=args.reserve_timeout,
                         max_consecutive_failures=args.max_consecutive_failures,
                         max_trial_retries=args.max_trial_retries,
-                        workdir=args.workdir)
+                        workdir=args.workdir, trace_dir=args.trace_dir)
     n = worker.run()
     logger.info("worker done: %d trials evaluated", n)
     return 0

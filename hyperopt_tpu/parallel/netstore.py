@@ -68,7 +68,9 @@ from urllib.request import Request, urlopen
 from .filestore import FileTrials, FileWorker, _pickler
 from ..base import Trials
 from ..exceptions import InjectedFault, NetstoreUnavailable
+from ..obs import context as _context
 from ..obs import metrics as _metrics
+from ..obs.events import EVENTS
 from .. import faults as _faults
 
 logger = logging.getLogger(__name__)
@@ -122,6 +124,14 @@ class StoreServer:
         # never alias live server-side state.
         self._idem: OrderedDict = OrderedDict()
         self._idem_lock = threading.Lock()
+        # Fleet metrics: worker_id -> {"t": last push wall time, "metrics":
+        # the worker's cumulative registry snapshot}.  Workers piggyback
+        # snapshots on heartbeats (NetTrials.heartbeat); last-write-wins
+        # per worker, merged on read by metrics_payload().  Deliberately
+        # NOT part of the local registry, so registry().snapshot(
+        # reset=True) by a bench/test never drops the per-worker labels.
+        self._fleet: dict = {}
+        self._fleet_lock = threading.Lock()
         # Janitor: requeue crashed-worker claims every S seconds so the
         # recovery path runs unprompted (``--requeue-stale-every``).
         self.requeue_stale_every = requeue_stale_every
@@ -182,13 +192,14 @@ class StoreServer:
             def do_GET(self):
                 # Read-only metrics surface, token-gated like every verb:
                 # ``GET /metrics`` returns the process-global registry
-                # snapshot (counters/gauges/histograms/kernel_cache) so an
+                # snapshot (counters/gauges/histograms/kernel_cache) plus
+                # the ``fleet`` view (per-worker labeled snapshots pushed
+                # on heartbeats + exactly-merged histograms) so an
                 # operator can curl the server a driver and workers feed.
                 if not self._authed():
                     return
                 if self.path.split("?", 1)[0] == "/metrics":
-                    body = json.dumps(
-                        _metrics.registry().snapshot()).encode()
+                    body = json.dumps(server.metrics_payload()).encode()
                     self._send_json(200, body)
                     return
                 self._send_json(404, json.dumps(
@@ -271,26 +282,35 @@ class StoreServer:
         verb = req["verb"]
         reg = _metrics.registry()
         t0 = time.perf_counter()
+        # Trace context stamped by the client (obs/context.py wire form):
+        # adopt it for the duration of the verb so every event this
+        # dispatch emits — store_claim/store_write from the filestore,
+        # fault injections, the rpc instant below — attaches to the
+        # originating trial and trace.
+        ctx = req.pop("ctx", None)
         try:
-            idem = req.pop("idem", None)
-            if idem is None:
-                return self._dispatch_verb(verb, req)
-            # Mutating verb with an idempotency key: a retry of a call the
-            # server already executed must return the original reply, not
-            # run the verb twice (the client retries blind — it cannot
-            # know whether the loss was on the way in or out).
-            key = (req.get("exp_key", "default"), idem)
-            with self._idem_lock:
-                cached = self._idem.get(key)
-            if cached is not None:
-                reg.counter("netstore.idem.hits").inc()
-                return json.loads(cached)
-            out = self._dispatch_verb(verb, req)
-            with self._idem_lock:
-                self._idem[key] = json.dumps(out)
-                while len(self._idem) > self._IDEM_CAP:
-                    self._idem.popitem(last=False)
-            return out
+            with _context.adopt(ctx):
+                EVENTS.emit("rpc", name=verb)
+                idem = req.pop("idem", None)
+                if idem is None:
+                    return self._dispatch_verb(verb, req)
+                # Mutating verb with an idempotency key: a retry of a call
+                # the server already executed must return the original
+                # reply, not run the verb twice (the client retries blind
+                # — it cannot know whether the loss was on the way in or
+                # out).
+                key = (req.get("exp_key", "default"), idem)
+                with self._idem_lock:
+                    cached = self._idem.get(key)
+                if cached is not None:
+                    reg.counter("netstore.idem.hits").inc()
+                    return json.loads(cached)
+                out = self._dispatch_verb(verb, req)
+                with self._idem_lock:
+                    self._idem[key] = json.dumps(out)
+                    while len(self._idem) > self._IDEM_CAP:
+                        self._idem.popitem(last=False)
+                return out
         finally:
             # Per-verb call count + latency histogram: the contention
             # signal for the single-writer lock under many workers.
@@ -298,11 +318,50 @@ class StoreServer:
             reg.histogram(f"netstore.verb.{verb}.s").observe(
                 time.perf_counter() - t0)
 
+    def metrics_payload(self) -> dict:
+        """The ``GET /metrics`` document: local snapshot + fleet view.
+
+        Top level keeps the historical registry-snapshot schema
+        (enabled/counters/gauges/kernel_cache/histograms — now with
+        mergeable ``state`` per histogram, including the server-side
+        per-verb latency histograms ``netstore.verb.<verb>.s`` with
+        p50/p95/p99) and adds ``fleet``:
+
+        * ``workers`` — per-worker labels: each worker's last pushed
+          cumulative snapshot plus ``age_s`` staleness (a worker whose
+          age greatly exceeds its heartbeat interval is presumed dead),
+        * ``merged`` — counters/gauges summed and histograms
+          exactly merged (``obs.metrics.merge_snapshots``) across the
+          server's own registry and every pushed worker snapshot.
+        """
+        snap = _metrics.registry().snapshot(states=True)
+        now = time.time()
+        with self._fleet_lock:
+            fleet = {w: dict(rec) for w, rec in self._fleet.items()}
+        workers = {}
+        members = [snap]
+        for w in sorted(fleet):
+            rec = fleet[w]
+            m = rec.get("metrics") or {}
+            workers[w] = {
+                "age_s": round(now - rec.get("t", now), 3),
+                "counters": m.get("counters") or {},
+                "gauges": m.get("gauges") or {},
+                "histograms": m.get("histograms") or {},
+            }
+            members.append(m)
+        snap["fleet"] = {
+            "n_workers": len(workers),
+            "workers": workers,
+            "merged": _metrics.merge_snapshots(members),
+        }
+        return snap
+
     def _dispatch_verb(self, verb: str, req: dict) -> dict:
         if verb == "metrics":
-            # Registry snapshot (same payload as GET /metrics) so RPC
-            # clients (NetTrials.metrics) don't need a second transport.
-            return {"metrics": _metrics.registry().snapshot()}
+            # Same payload as GET /metrics so RPC clients
+            # (NetTrials.metrics) don't need a second transport.
+            return {"metrics": self.metrics_payload()}
         with self._lock:
             ft = self._store(req.get("exp_key", "default"))
             if verb == "docs":
@@ -316,7 +375,21 @@ class StoreServer:
             if verb == "reserve":
                 return {"doc": ft.reserve(req["owner"])}
             if verb == "heartbeat":
-                return {"ok": ft.heartbeat(req["doc"], owner=req.get("owner"))}
+                # Piggybacked fleet metrics: a worker may attach its
+                # cumulative registry snapshot (last-write-wins per
+                # worker id; merged on read by metrics_payload).  The
+                # reply carries the server wall clock so clients can
+                # estimate their skew for trace stitching.
+                w = req.get("worker")
+                if w is not None and req.get("metrics") is not None:
+                    with self._fleet_lock:
+                        self._fleet[w] = {"t": time.time(),
+                                          "metrics": req["metrics"]}
+                    _metrics.registry().counter(
+                        "netstore.fleet.pushes").inc()
+                return {"ok": ft.heartbeat(req["doc"],
+                                           owner=req.get("owner")),
+                        "t_wall": time.time()}
             if verb == "write_result":
                 return {"ok": ft.write_result(req["doc"],
                                               owner=req.get("owner"))}
@@ -412,11 +485,21 @@ class _Rpc:
         if verb in _MUTATING_VERBS:
             # One key per logical call, shared by every retry of it.
             kw["idem"] = uuid.uuid4().hex
+        # Trace-context stamp (obs/context.py): when the caller runs
+        # inside a bound context (a traced driver batch, a worker
+        # evaluating a stamped doc), the compact wire string rides along
+        # so the server's events attach to the same trial.  Disarmed
+        # cost: one module-global boolean check.
+        if _context.armed():
+            ctx = _context.wire_current()
+            if ctx is not None:
+                kw["ctx"] = ctx
         headers = {"Content-Type": "application/json"}
         if self.token is not None:
             headers["X-Netstore-Token"] = self.token
         data = json.dumps(kw).encode()
         attempts = 0
+        t_start = time.perf_counter()
         while True:
             try:
                 _faults.maybe_fail("rpc.send", verb=verb)
@@ -448,6 +531,11 @@ class _Rpc:
                 delay = min(self.backoff * (2 ** (attempts - 1)),
                             _BACKOFF_CAP_S)
                 time.sleep(delay * (0.5 + self._jitter.random()))
+        # Client-observed RPC latency (retries and backoff included) —
+        # the worker-side twin of the server's per-verb histograms;
+        # piggybacked to the server with the fleet snapshots.
+        _metrics.registry().histogram("netstore.client.rpc.s").observe(
+            time.perf_counter() - t_start)
         if "error" in out:
             raise RuntimeError(f"netstore server: {out['error']}")
         return out
@@ -487,11 +575,18 @@ class NetTrials(Trials):
 
     asynchronous = True
 
+    #: Minimum seconds between cumulative-snapshot piggybacks on heartbeat
+    #: calls (the fleet-metrics push cadence; tests shrink it).  Snapshots
+    #: are cumulative — the server keeps last-write-wins per worker — so
+    #: a lost push costs staleness, never data.
+    metrics_push_interval = 2.0
+
     def __init__(self, url: str, exp_key: str = "default", refresh=True,
                  timeout: float = 30.0, token: str | None = None,
                  retries: int | None = None):
         self._rpc = _Rpc(url, exp_key, timeout=timeout, token=token,
                          retries=retries)
+        self._last_metrics_push = float("-inf")
         super().__init__(exp_key=exp_key, refresh=refresh)
         self.attachments = _NetAttachments(self._rpc)
 
@@ -523,7 +618,30 @@ class NetTrials(Trials):
         return self._rpc("reserve", owner=owner)["doc"]
 
     def heartbeat(self, doc, owner=None) -> bool:
-        return self._rpc("heartbeat", doc=doc, owner=owner)["ok"]
+        kw = {"doc": doc, "owner": owner}
+        now = time.monotonic()
+        if (owner is not None
+                and now - self._last_metrics_push
+                >= self.metrics_push_interval):
+            # Piggyback this process's cumulative metrics snapshot
+            # (histograms in mergeable state form) on the beat — no
+            # extra RPC, and the push cadence is bounded by the
+            # heartbeat interval itself.
+            self._last_metrics_push = now
+            kw["worker"] = owner
+            kw["metrics"] = _metrics.registry().snapshot(states=True)
+        t0 = time.time()
+        out = self._rpc("heartbeat", **kw)
+        t_server = out.get("t_wall")
+        if t_server is not None:
+            # NTP-style midpoint estimate of this process's wall-clock
+            # offset from the server (positive = we are ahead).  Stamped
+            # into the event-log header so `show trace --merge` can
+            # normalize this process's lane onto the server clock.
+            skew = 0.5 * (t0 + time.time()) - t_server
+            _metrics.registry().gauge("clock.skew_s").set(skew)
+            EVENTS.set_meta(skew_s=skew)
+        return out["ok"]
 
     def write_result(self, doc, owner=None) -> bool:
         return self._rpc("write_result", doc=doc, owner=owner)["ok"]
@@ -618,11 +736,21 @@ def main(argv=None):
                         "janitor treats a claim as crashed (default 60s; "
                         "keep well above the workers' heartbeat interval)")
     p.add_argument("--workdir", default=None)
+    p.add_argument("--trace-dir", default=None,
+                   help="arm the structured event log and write "
+                        "loop_events.jsonl (+ chrome trace) here on exit; "
+                        "feed several processes' dirs to "
+                        "`hyperopt-tpu-show trace --merge`")
     args = p.parse_args(argv)
 
     if args.serve:
         if not args.root:
             p.error("--serve requires --root")
+        tracer = None
+        if args.trace_dir:
+            from ..obs.trace import Tracer
+            tracer = Tracer(args.trace_dir)
+            EVENTS.set_meta(role="server")
         server = StoreServer(args.root, host=args.host, port=args.port,
                              token=args.token,
                              requeue_stale_every=args.requeue_stale_every,
@@ -648,6 +776,8 @@ def main(argv=None):
             pass
         finally:
             server.shutdown()
+            if tracer is not None:
+                tracer.dump()
             print("netstore: shut down", flush=True)
         return 0
 
@@ -656,7 +786,7 @@ def main(argv=None):
                        reserve_timeout=args.reserve_timeout,
                        max_consecutive_failures=args.max_consecutive_failures,
                        max_trial_retries=args.max_trial_retries,
-                       workdir=args.workdir)
+                       workdir=args.workdir, trace_dir=args.trace_dir)
     n = worker.run()
     logger.info("net worker done: %d trials evaluated", n)
     return 0

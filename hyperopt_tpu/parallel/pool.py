@@ -41,6 +41,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 from .. import base
 from ..exceptions import TRANSIENT_ERROR_NAMES, is_transient
+from ..obs import context as _context
 from ..obs import metrics as _metrics
 from ..obs.events import EVENTS
 from ..base import (
@@ -293,13 +294,14 @@ class PoolTrials(Trials):
         ctrl.should_stop = ev.is_set  # cooperative-cancellation hook
         try:
             spec = base.spec_from_misc(doc["misc"])
-            while True:
-                try:
-                    result = self._domain.evaluate(spec, ctrl)
-                    break
-                except Exception as e:
-                    if ev.is_set() or not self._charge_retry(doc, e):
-                        raise
+            with _context.bind_doc(doc):
+                while True:
+                    try:
+                        result = self._domain.evaluate(spec, ctrl)
+                        break
+                    except Exception as e:
+                        if ev.is_set() or not self._charge_retry(doc, e):
+                            raise
         except Exception as e:
             logger.error("pool job exception (tid %s): %s", doc["tid"], e)
             self._finish(doc, ev, timer, JOB_STATE_ERROR,
@@ -527,10 +529,11 @@ class CompletionQueueEvaluator:
             EVENTS.emit("trial_start", trial=item.doc["tid"])
             try:
                 spec = base.spec_from_misc(item.doc["misc"])
-                if self.execution == "process":
-                    result = self._eval_in_child(item, spec)
-                else:
-                    result = self._domain.evaluate(spec, item.ctrl)
+                with _context.bind_doc(item.doc):
+                    if self.execution == "process":
+                        result = self._eval_in_child(item, spec)
+                    else:
+                        result = self._domain.evaluate(spec, item.ctrl)
             except Exception as e:  # noqa: BLE001 — marshalled to recorder
                 self._done.put((item, "error", e))
             else:

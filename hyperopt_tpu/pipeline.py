@@ -73,6 +73,7 @@ from .base import (
 )
 from . import faults as _faults
 from .exceptions import AllTrialsFailed, is_transient
+from .obs import context as _context
 from .obs import metrics as _metrics
 from .obs.events import EVENTS
 from .parallel.pool import CompletionQueueEvaluator
@@ -396,6 +397,15 @@ class PipelinedExecutor:
                     slot=slot.span)
         if not docs:
             return False
+        if _context.armed():
+            # Stamp the run's trace context so workers that claim these
+            # docs attach their spans to the originating trial.
+            for doc in docs:
+                _context.stamp_misc(doc["misc"], tid=doc["tid"],
+                                    trace_id=it.tracer.trace_id)
+        if EVENTS.enabled:
+            for doc in docs:
+                EVENTS.emit("trial_queued", trial=doc["tid"])
         with it.tracer.span("store"):
             trials.insert_trial_docs(docs)
             trials.refresh()

@@ -8,6 +8,9 @@ Usage::
     python -m hyperopt_tpu.show --root /shared/exp --exp-key e1
     python -m hyperopt_tpu.show --pickle trials.pkl [--plot history.png]
     python -m hyperopt_tpu.show trace /tmp/trace   # per-phase span table
+    python -m hyperopt_tpu.show trace --merge /tmp/driver /tmp/worker0 \
+        -o merged_trace.json                       # fleet Perfetto trace
+    python -m hyperopt_tpu.show live http://host:8999 [--token ...]
 """
 
 from __future__ import annotations
@@ -17,6 +20,7 @@ import json
 import os
 import pickle
 import sys
+import time
 from collections import Counter, defaultdict
 
 from .base import (
@@ -124,17 +128,287 @@ def summarize_trace(trace_dir: str, out=None) -> None:
               f"chrome://tracing)", file=out)
 
 
+# -- cross-process trace stitching ------------------------------------------
+
+def _load_events_file(path):
+    """Read one ``loop_events.jsonl``: returns ``(meta, events)``.
+
+    The ``{"type": "meta"}`` header (process identity + wall/mono clock
+    anchor + heartbeat-estimated ``skew_s``) is separated from the event
+    records; files written before the header existed yield ``{}``.
+    """
+    meta, events = {}, []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("type") == "meta":
+                meta = rec
+            else:
+                events.append(rec)
+    return meta, events
+
+
+def merge_traces(dirs, out_path=None, out=None) -> dict:
+    """Stitch several processes' ``loop_events.jsonl`` into ONE Chrome
+    trace: one ``pid`` lane per source process, clock-normalized, with
+    per-trial flow arrows crossing lane boundaries.
+
+    Clock normalization: every record's display timestamp is recomputed
+    from its monotonic clock via the file's own meta anchor,
+    ``wall0 + (t_mono - mono0) - skew_s``.  ``skew_s`` is the process's
+    wall offset relative to the netstore server (estimated from heartbeat
+    replies, 0 for the server itself), so all lanes land in the *server's*
+    clock frame even when the machines' wall clocks disagree.
+
+    Flow arrows: any trial whose events appear in ≥2 lanes gets a Chrome
+    flow (``ph: s/t/f`` sharing ``id``) threaded through its anchors —
+    suggest→claim→evaluate→record across process boundaries renders as
+    arrows in Perfetto.
+    """
+    out = out if out is not None else sys.stdout
+    sources = []
+    for d in dirs:
+        path = (d if d.endswith(".jsonl")
+                else os.path.join(d, "loop_events.jsonl"))
+        meta, events = _load_events_file(path)
+        sources.append((path, meta, events))
+
+    from .obs.events import events_to_chrome
+
+    trace_events, all_anchors = [], []
+    for i, (path, meta, events) in enumerate(sources):
+        pid = i + 1  # one Perfetto lane per source process
+        wall0, mono0 = meta.get("wall0"), meta.get("mono0")
+        skew = meta.get("skew_s", 0.0) or 0.0
+        if wall0 is not None and mono0 is not None:
+            def ts_fn(rec, _w=wall0, _m=mono0, _s=skew):
+                return _w + (rec["t_mono"] - _m) - _s
+        else:
+            ts_fn = None  # pre-header file: fall back to recorded t_wall
+        evs, anchors = events_to_chrome(events, pid=pid, ts_fn=ts_fn)
+        label = (meta.get("worker_id") or meta.get("role")
+                 or os.path.basename(os.path.dirname(os.path.abspath(path)))
+                 or f"proc{i}")
+        if meta.get("pid") is not None:
+            label = f"{label} (os pid {meta['pid']})"
+        trace_events.append({"name": "process_name", "ph": "M", "pid": pid,
+                             "tid": 0, "args": {"name": label}})
+        trace_events.extend(evs)
+        all_anchors.extend(anchors)
+
+    # Per-trial flow arrows.  Anchors are deduped to one per (lane pid,
+    # event type) — the earliest — so a trial retried in one process
+    # doesn't spray N arrows; only trials seen in ≥2 lanes get a flow.
+    by_trial = defaultdict(dict)
+    for ts_us, pid, lane, trial, etype in all_anchors:
+        key = (pid, etype)
+        cur = by_trial[trial].get(key)
+        if cur is None or ts_us < cur[0]:
+            by_trial[trial][key] = (ts_us, pid, lane, etype)
+    flows, n_flows = [], 0
+    for trial in sorted(by_trial, key=str):
+        pts = sorted(by_trial[trial].values())
+        if len({p[1] for p in pts}) < 2:
+            continue  # flow arrows only for cross-process trials
+        n_flows += 1
+        for j, (ts_us, pid, lane, etype) in enumerate(pts):
+            ev = {"name": f"trial {trial}", "cat": "trial_flow",
+                  "ph": "s" if j == 0 else
+                        ("f" if j == len(pts) - 1 else "t"),
+                  "id": str(trial), "ts": ts_us, "pid": pid, "tid": lane}
+            if ev["ph"] == "f":
+                ev["bp"] = "e"  # bind the arrowhead to the enclosing slice
+            flows.append(ev)
+
+    doc = {
+        "traceEvents": trace_events + flows,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "merged_from": [p for p, _, _ in sources],
+            "n_lanes": len(sources),
+            "n_trial_flows": n_flows,
+        },
+    }
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(doc, fh)
+        print(f"wrote {out_path}", file=out)
+    print(f"merged {len(sources)} lane(s), "
+          f"{sum(len(e) for _, _, e in sources)} events, "
+          f"{n_flows} cross-process trial flow(s)", file=out)
+    return doc
+
+
+# -- live fleet dashboard ---------------------------------------------------
+
+def fetch_metrics(url: str, token=None, timeout: float = 5.0) -> dict:
+    """GET ``<url>/metrics`` from a netstore server (token-gated)."""
+    import urllib.request
+
+    req = urllib.request.Request(url.rstrip("/") + "/metrics")
+    if token:
+        req.add_header("X-Netstore-Token", token)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _hist_row(name, h):
+    """One per-verb table row from a histogram summary dict (seconds)."""
+    if not h or not h.get("count"):
+        return None
+    ms = lambda v: f"{1e3 * v:8.2f}" if v is not None else "       -"  # noqa: E731
+    return (f"  {name:<28s} {h['count']:>7d} {ms(h.get('p50'))} "
+            f"{ms(h.get('p95'))} {ms(h.get('p99'))}")
+
+
+def render_live(snap: dict, out=None, prev=None) -> dict:
+    """Render one dashboard frame from a ``GET /metrics`` payload.
+
+    ``prev`` is the previous ``(t, counters)`` sample used to derive
+    rates (trials/s); returns this frame's sample for the next call.
+    """
+    out = out if out is not None else sys.stdout
+    now = time.monotonic()
+    fleet = snap.get("fleet", {})
+    merged = fleet.get("merged", {})
+    counters = dict(snap.get("counters", {}))
+    for k, v in merged.get("counters", {}).items():
+        counters[k] = max(counters.get(k, 0), v)  # merged already sums local
+    gauges = snap.get("gauges", {})
+    m_gauges = merged.get("gauges", {})
+
+    done = counters.get("fmin.trials.done", 0) + counters.get(
+        "worker.trials", 0)
+    rate = ""
+    if prev is not None:
+        dt = now - prev[0]
+        if dt > 0:
+            d_done = done - prev[1]
+            rate = f"   {d_done / dt:6.2f} trials/s"
+    print(f"fleet: {fleet.get('n_workers', 0)} worker(s)   "
+          f"trials done {done}{rate}", file=out)
+    occ = gauges.get("pipeline.occupancy", m_gauges.get("pipeline.occupancy"))
+    backlog = gauges.get("pipeline.eval_backlog",
+                         m_gauges.get("pipeline.eval_backlog"))
+    if occ is not None or backlog is not None:
+        print(f"pipeline: occupancy {occ if occ is not None else '-'}   "
+              f"eval backlog {backlog if backlog is not None else '-'}",
+              file=out)
+    faults = counters.get("faults.injected", 0)
+    requeued = counters.get("store.requeued", 0)
+    fenced = (counters.get("store.write.fenced", 0)
+              + counters.get("store.heartbeat.fenced", 0))
+    print(f"faults injected {faults}   requeued {requeued}   "
+          f"fenced {fenced}", file=out)
+
+    # Per-verb server-side latency tails (+ merged client-side RPC time).
+    hists = dict(snap.get("histograms", {}))
+    for k, v in merged.get("histograms", {}).items():
+        hists.setdefault(k, v)
+    rows = []
+    for name in sorted(hists):
+        if name.startswith("netstore.verb.") and name.endswith(".s"):
+            row = _hist_row(name[len("netstore.verb."):], hists[name])
+            if row:
+                rows.append(row)
+    rpc = _hist_row("client.rpc (merged)", hists.get("netstore.client.rpc.s"))
+    if rpc:
+        rows.append(rpc)
+    if rows:
+        print(f"  {'verb':<28s} {'count':>7s} {'p50ms':>8s} "
+              f"{'p95ms':>8s} {'p99ms':>8s}", file=out)
+        for row in rows:
+            print(row, file=out)
+
+    workers = fleet.get("workers", {})
+    if workers:
+        print("workers:", file=out)
+        for wid in sorted(workers):
+            w = workers[wid]
+            age = w.get("age_s", 0.0)
+            wc = w.get("counters", {})
+            wg = w.get("gauges", {})
+            stale = "  STALE" if age > 30.0 else ""
+            print(f"  {wid:<28s} age {age:6.1f}s  trials "
+                  f"{wc.get('worker.trials', 0):>5d}  fails "
+                  f"{wg.get('worker.consecutive_failures', 0)}{stale}",
+                  file=out)
+    return (now, done)
+
+
+def live(url: str, token=None, interval: float = 2.0, once: bool = False,
+         out=None) -> int:
+    """Poll ``GET /metrics`` into a terminal dashboard (ctrl-C to stop)."""
+    out = out if out is not None else sys.stdout
+    prev = None
+    while True:
+        try:
+            snap = fetch_metrics(url, token=token)
+        except Exception as e:
+            print(f"fetch failed: {type(e).__name__}: {e}", file=out)
+            if once:
+                return 1
+            time.sleep(interval)
+            continue
+        if not once and out is sys.stdout and sys.stdout.isatty():
+            print("\x1b[2J\x1b[H", end="", file=out)
+        print(f"-- {url} --", file=out)
+        prev = render_live(snap, out=out, prev=prev)
+        if once:
+            return 0
+        try:
+            time.sleep(interval)
+        except KeyboardInterrupt:
+            return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "trace":
         # Subcommand form (`hyperopt-tpu-show trace <dir>`); the flag-based
         # trials inspection below keeps its historical interface.
         tp = argparse.ArgumentParser(prog="hyperopt-tpu-show trace",
-                                     description="summarize a trace dir")
-        tp.add_argument("trace_dir", help="fmin(..., trace_dir=...) output")
+                                     description="summarize a trace dir, or "
+                                                 "--merge several into one "
+                                                 "Perfetto trace")
+        tp.add_argument("trace_dir", nargs="?", default=None,
+                        help="fmin(..., trace_dir=...) output")
+        tp.add_argument("--merge", nargs="+", metavar="DIR", default=None,
+                        help="stitch these processes' loop_events.jsonl "
+                             "into one clock-normalized Chrome trace")
+        tp.add_argument("-o", "--out", default="merged_trace.json",
+                        help="output path for --merge "
+                             "(default: merged_trace.json)")
         targs = tp.parse_args(argv[1:])
+        if targs.merge:
+            merge_traces(targs.merge, out_path=targs.out)
+            return 0
+        if targs.trace_dir is None:
+            tp.error("a trace dir (or --merge DIR...) is required")
         summarize_trace(targs.trace_dir)
         return 0
+
+    if argv and argv[0] == "live":
+        lp = argparse.ArgumentParser(prog="hyperopt-tpu-show live",
+                                     description="poll a netstore server's "
+                                                 "fleet metrics into a "
+                                                 "terminal dashboard")
+        lp.add_argument("url", help="netstore server url, e.g. "
+                                    "http://host:8999")
+        lp.add_argument("--token", default=None,
+                        help="X-Netstore-Token (or env "
+                             "HYPEROPT_TPU_NETSTORE_TOKEN)")
+        lp.add_argument("--interval", type=float, default=2.0)
+        lp.add_argument("--once", action="store_true",
+                        help="print a single frame and exit")
+        largs = lp.parse_args(argv[1:])
+        token = largs.token or os.environ.get(
+            "HYPEROPT_TPU_NETSTORE_TOKEN") or None
+        return live(largs.url, token=token, interval=largs.interval,
+                    once=largs.once)
 
     p = argparse.ArgumentParser(description="inspect a hyperopt_tpu "
                                             "experiment")

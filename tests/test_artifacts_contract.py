@@ -165,6 +165,80 @@ class TestBenchmarkArtifacts:
                 f"{head['maybe_fail_disabled_ns']}ns — the always-on hook "
                 "stopped being free")
 
+    def test_obs_fleet_overhead_artifact_schema(self):
+        """ISSUE r6 acceptance artifact: the cross-process trace context's
+        paired A/B (obs disabled vs armed via trace_dir) with the
+        wire_current/stamp_misc microbench — written by
+        benchmarks/obs_fleet_overhead.py."""
+        paths = sorted(glob.glob(os.path.join(_BENCH_DIR,
+                                              "obs_fleet_overhead_*.json")))
+        assert paths, ("no benchmarks/obs_fleet_overhead_*.json artifact "
+                       "checked in")
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == \
+                "obs_fleet_overhead_disabled_vs_armed", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            modes = {r["mode"] for r in doc["rows"]}
+            assert modes == {"obs_disabled", "obs_armed_trace_dir"}, name
+            for r in doc["rows"]:
+                assert r["trials_per_sec_median"] > 0, f"{name}: {r}"
+                assert r["wire_current_ns"] > 0, f"{name}: {r}"
+                assert r["stamp_misc_ns"] > 0, f"{name}: {r}"
+            head = doc["headline"]
+            # the disabled path is the one production always pays: the
+            # ~0.2 µs/op stamping budget from the ISSUE acceptance bar
+            assert head["disabled_within_200ns"] is True, (
+                f"{name}: context stamping's disabled path broke its "
+                "200ns/op budget")
+
+    def test_merged_trace_artifact_schema(self):
+        """ISSUE r6 acceptance artifact: the 2-process chaos run's merged
+        Perfetto trace — one lane per process, ≥1 cross-process trial
+        flow — written by `hyperopt-tpu-show trace --merge` and stamped
+        with the r6 attribution header."""
+        paths = sorted(glob.glob(os.path.join(
+            _BENCH_DIR, "obs_fleet_merged_trace_*.json")))
+        assert paths, ("no benchmarks/obs_fleet_merged_trace_*.json "
+                       "artifact checked in")
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "obs_fleet_merged_trace", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            # Chrome trace_event container (extra top-level keys are
+            # legal and ignored by Perfetto / chrome://tracing)
+            evs = doc["traceEvents"]
+            assert isinstance(evs, list) and evs, name
+            other = doc["otherData"]
+            assert other["n_lanes"] >= 2, \
+                f"{name}: merged trace must span ≥2 process lanes"
+            assert other["n_trial_flows"] >= 1, \
+                f"{name}: no trial's spans cross process lanes"
+            # flow arrows are well-formed: per id, starts with ph=s,
+            # ends ph=f, and really crosses lanes
+            flows = [e for e in evs if e.get("cat") == "trial_flow"]
+            assert flows, name
+            by_id = {}
+            for e in flows:
+                by_id.setdefault(e["id"], []).append(e)
+            crossing = 0
+            for fid, es in by_id.items():
+                es.sort(key=lambda e: e["ts"])
+                assert es[0]["ph"] == "s", f"{name}: flow {fid}"
+                assert es[-1]["ph"] == "f", f"{name}: flow {fid}"
+                if len({e["pid"] for e in es}) >= 2:
+                    crossing += 1
+            assert crossing >= 1, name
+            # every lane got a process_name metadata label
+            labeled = {e["pid"] for e in evs if e.get("ph") == "M"}
+            lanes = {e["pid"] for e in evs if e.get("ph") != "M"}
+            assert lanes <= labeled, f"{name}: unlabeled lanes"
+
     def test_device_ab_artifact_matches_its_bench(self):
         # the r6 device A/B (5 domains x 20 seeds, one conditional space)
         path = os.path.join(_BENCH_DIR, "quality_ab_fmin_vs_fmin_device.json")

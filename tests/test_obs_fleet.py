@@ -1,0 +1,646 @@
+"""Fleet observability (ISSUE r6): cross-process trace context, mergeable
+histogram state, heartbeat metrics piggyback + the ``GET /metrics`` fleet
+view, clock-normalized trace stitching with per-trial flow arrows, and the
+live terminal dashboard.
+
+The areas pinned here: trace-context wire format round-trip and its
+disabled-path behavior, histogram bucket-merge associativity + quantile
+bounds, janitor requeue attribution (the ghost-claim chaos case), fleet
+payload auth / per-worker label survival across ``snapshot(reset=True)``,
+cross-process timestamp-skew normalization in ``merge_traces``, and a
+rendered ``live`` frame.
+"""
+
+import io
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp, rand
+from hyperopt_tpu.base import Domain
+from hyperopt_tpu.obs import context as obs_context
+from hyperopt_tpu.obs.events import EventLog
+from hyperopt_tpu.obs.metrics import (
+    MetricsRegistry,
+    merge_histogram_states,
+    merge_snapshots,
+    summarize_state,
+)
+
+
+@pytest.fixture
+def armed_context():
+    """Arm the cross-process context for one test, restore after."""
+    was = obs_context.armed()
+    obs_context.enable()
+    try:
+        yield
+    finally:
+        if not was:
+            obs_context.disable()
+
+
+# ---------------------------------------------------------------------------
+# trace context: wire format + disabled path
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_wire_round_trip(self, armed_context):
+        with obs_context.bind(trace_id="abc123", span=4, tid=7):
+            wire = obs_context.wire_current()
+        assert wire == "abc123/4/7"
+        ctx = obs_context.from_wire(wire)
+        assert ctx == {"trace_id": "abc123", "span": 4, "tid": 7}
+
+    def test_wire_empty_segments(self, armed_context):
+        # Absent fields serialize as empty segments, not placeholders.
+        with obs_context.bind(trace_id="abc123", tid=7):
+            assert obs_context.wire_current() == "abc123//7"
+        assert obs_context.from_wire("abc123//7") == {
+            "trace_id": "abc123", "tid": 7}
+
+    def test_malformed_wire_is_none_not_raise(self):
+        # A hostile/corrupt ctx field must never take down a server verb.
+        for bad in (None, "", "no-slashes", "a/b", "//", 42):
+            assert obs_context.from_wire(bad) is None
+        # Partially-parsable input keeps the good fields.
+        assert obs_context.from_wire("x/notint/3") == {
+            "trace_id": "x", "tid": 3}
+
+    def test_disabled_path_is_inert(self):
+        assert not obs_context.armed()
+        assert obs_context.wire_current() is None
+        misc = {}
+        obs_context.stamp_misc(misc, tid=3, trace_id="t")
+        assert misc == {}  # no stamping while disarmed
+        # bind returns ONE shared no-op context manager — no allocation.
+        assert obs_context.bind(tid=1) is obs_context.bind(tid=2)
+
+    def test_stamp_misc_and_bind_doc(self, armed_context):
+        misc = {}
+        obs_context.stamp_misc(misc, tid=9, trace_id="deadbeef")
+        assert misc["trace"] == "deadbeef//9"
+        doc = {"tid": 9, "misc": misc}
+        with obs_context.bind_doc(doc):
+            cur = obs_context.current()
+            assert cur["trace_id"] == "deadbeef" and cur["tid"] == 9
+
+    def test_bind_doc_falls_back_to_tid(self, armed_context):
+        # An unstamped doc (untraced driver) still attributes by tid.
+        with obs_context.bind_doc({"tid": 5, "misc": {}}):
+            assert obs_context.current()["tid"] == 5
+
+    def test_bind_restores_previous(self, armed_context):
+        with obs_context.bind(trace_id="outer", tid=1):
+            with obs_context.bind(tid=2):
+                cur = obs_context.current()
+                # Layered bind: inherits trace_id, overrides tid.
+                assert cur["trace_id"] == "outer" and cur["tid"] == 2
+            assert obs_context.current()["tid"] == 1
+        assert obs_context.current() is None
+
+    def test_emit_auto_attaches_ambient_context(self, armed_context):
+        log = EventLog(capacity=16)
+        log.enable()
+        with obs_context.bind(trace_id="abc", tid=3):
+            rec = log.emit("rpc", name="reserve")
+        assert rec["trace_id"] == "abc" and rec["trial"] == 3
+        # An explicit trial is never overwritten by the ambient tid.
+        with obs_context.bind(trace_id="abc", tid=3):
+            rec = log.emit("store_claim", trial=11)
+        assert rec["trial"] == 11
+
+
+# ---------------------------------------------------------------------------
+# histogram merge: associativity + quantile bounds
+# ---------------------------------------------------------------------------
+
+
+def _hist_state(values, buckets=None):
+    reg = MetricsRegistry(enabled=True)
+    h = reg.histogram("h", buckets=buckets)
+    for v in values:
+        h.observe(v)
+    return h.state()
+
+
+class TestHistogramMerge:
+    def test_merge_is_associative_and_commutative(self):
+        rng = np.random.default_rng(0)
+        parts = [_hist_state(rng.uniform(0, 0.1, 50)) for _ in range(3)]
+        a, b, c = parts
+        left = merge_histogram_states(
+            [merge_histogram_states([a, b]), c])
+        right = merge_histogram_states(
+            [a, merge_histogram_states([b, c])])
+        swapped = merge_histogram_states([c, a, b])
+        # Bucket counts are integer sums — exactly associative and
+        # commutative; the float ``sum`` field only to rounding.
+        for other in (right, swapped):
+            assert other["counts"] == left["counts"]
+            assert other["count"] == left["count"]
+            assert other["bounds"] == left["bounds"]
+            assert other["min"] == left["min"]
+            assert other["max"] == left["max"]
+            assert other["sum"] == pytest.approx(left["sum"], rel=1e-12)
+        assert left["count"] == 150
+
+    def test_merged_quantiles_bound_true_quantiles(self):
+        # Bucket-boundary quantiles overestimate by at most one bucket:
+        # the reported pXX is an upper bound on the true quantile and is
+        # itself a bucket upper bound that the true value falls under.
+        rng = np.random.default_rng(1)
+        xs = rng.uniform(1e-4, 0.2, 400)
+        merged = merge_histogram_states(
+            [_hist_state(xs[:200]), _hist_state(xs[200:])])
+        s = summarize_state(merged)
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            true_q = float(np.quantile(xs, q))
+            assert s[key] >= true_q  # upper bound
+            assert s[key] <= true_q * 2.0 + 1e-9  # within one 2x bucket
+        assert s["count"] == 400
+        assert s["min"] == pytest.approx(xs.min())
+        assert s["max"] == pytest.approx(xs.max())
+
+    def test_mismatched_bounds_raise(self):
+        a = _hist_state([0.5], buckets=(0.1, 1.0))
+        b = _hist_state([0.5], buckets=(0.2, 2.0))
+        with pytest.raises(ValueError, match="bounds"):
+            merge_histogram_states([a, b])
+
+    def test_empty_and_falsy_inputs(self):
+        assert merge_histogram_states([]) is None
+        assert merge_histogram_states([None, {}]) is None
+        assert summarize_state(None) == {"count": 0}
+
+    def test_merge_snapshots_sums_counters_and_merges_hists(self):
+        def snap(n):
+            reg = MetricsRegistry(enabled=True)
+            reg.counter("c").inc(n)
+            reg.gauge("g").set(n)
+            reg.histogram("h").observe(0.01 * n)
+            return reg.snapshot(states=True)
+
+        merged = merge_snapshots([snap(1), snap(2)])
+        assert merged["counters"]["c"] == 3
+        assert merged["gauges"]["g"] == 3
+        assert merged["histograms"]["h"]["count"] == 2
+        assert "state" in merged["histograms"]["h"]  # re-mergeable
+
+    def test_summary_has_p99(self):
+        reg = MetricsRegistry(enabled=True)
+        h = reg.histogram("h")
+        for v in np.linspace(1e-4, 0.1, 100):
+            h.observe(float(v))
+        s = h.summary()
+        assert {"count", "mean", "p50", "p90", "p95", "p99"} <= set(s)
+        assert s["p99"] >= s["p95"] >= s["p50"]
+
+
+# ---------------------------------------------------------------------------
+# janitor requeue attribution (ghost-claim chaos)
+# ---------------------------------------------------------------------------
+
+
+class TestRequeueAttribution:
+    def test_ghost_claim_requeue_names_owner(self, tmp_path):
+        """A worker that claims a trial and dies must show up BY NAME in
+        the janitor's ``store_requeue`` event (reason=stale_heartbeat)."""
+        from hyperopt_tpu.obs.events import EVENTS
+        from hyperopt_tpu.parallel import FileTrials
+
+        ft = FileTrials(str(tmp_path / "store"), exp_key="e1")
+        ft.insert_trial_docs(_new_docs(ft, 1))
+        doc = ft.reserve("ghost:0:dead")
+        assert doc is not None
+        EVENTS.enable()
+        try:
+            time.sleep(0.06)
+            assert ft.requeue_stale(timeout=0.05) == 1
+            evs = [e for e in EVENTS.snapshot()
+                   if e["type"] == "store_requeue"]
+            assert evs, "janitor emitted no store_requeue event"
+            assert evs[-1]["owner"] == "ghost:0:dead"
+            assert evs[-1]["reason"] == "stale_heartbeat"
+            assert evs[-1]["trial"] == doc["tid"]
+        finally:
+            EVENTS.disable()
+            EVENTS.clear()
+
+    def test_orphan_claim_requeue_reads_claim_file(self, tmp_path):
+        """A worker that died between winning the claim and persisting
+        RUNNING leaves only the claim file — the requeue event must read
+        the owner out of it before the unlink destroys it."""
+        from hyperopt_tpu.obs.events import EVENTS
+        from hyperopt_tpu.parallel import FileTrials
+
+        ft = FileTrials(str(tmp_path / "store"), exp_key="e1")
+        ft.insert_trial_docs(_new_docs(ft, 1))
+        ft.refresh()
+        tid = ft.trials[0]["tid"]
+        claim = ft._claim_path(tid)
+        with open(claim, "w") as f:
+            f.write("ghost:1:crashed-mid-claim")
+        EVENTS.enable()
+        try:
+            time.sleep(0.06)
+            assert ft.requeue_stale(timeout=0.05) == 1
+            evs = [e for e in EVENTS.snapshot()
+                   if e["type"] == "store_requeue"]
+            assert evs[-1]["owner"] == "ghost:1:crashed-mid-claim"
+            assert evs[-1]["reason"] == "orphan_claim"
+            assert evs[-1]["trial"] == tid
+        finally:
+            EVENTS.disable()
+            EVENTS.clear()
+
+
+def _quad(d):
+    return (d["x"] - 3.0) ** 2
+
+
+def _new_docs(trials, n):
+    dom = Domain(_quad, {"x": hp.uniform("x", -5.0, 5.0)})
+    return rand.suggest(trials.new_trial_ids(n), dom, trials, 0)
+
+
+class TestHeartbeatLostUpdate:
+    """A beat in flight while ``write_result`` lands must not resurrect
+    the pre-result doc (the lost update that stalled ``fmin`` over the
+    netstore: driver waits forever on a trial its worker finished)."""
+
+    def test_stale_beat_cannot_clobber_result(self, tmp_path):
+        from hyperopt_tpu.base import JOB_STATE_DONE
+        from hyperopt_tpu.parallel import FileTrials
+
+        ft = FileTrials(str(tmp_path / "store"), exp_key="e1")
+        ft.insert_trial_docs(_new_docs(ft, 1))
+        doc = ft.reserve("w:1")
+        stale = dict(doc)  # the snapshot a beat thread would carry
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"loss": 1.25, "status": "ok"}
+        assert ft.write_result(doc, owner="w:1")
+        # The late beat still holds the claim, so it is NOT fenced —
+        # but it must stamp liveness only, never write its snapshot.
+        ft.heartbeat(stale, owner="w:1")
+        ft.refresh()
+        cur = ft.trials[0]
+        assert cur["state"] == JOB_STATE_DONE
+        assert cur["result"]["loss"] == 1.25
+
+    def test_beat_on_running_trial_still_stamps(self, tmp_path):
+        from hyperopt_tpu.base import JOB_STATE_RUNNING
+        from hyperopt_tpu.parallel import FileTrials
+
+        ft = FileTrials(str(tmp_path / "store"), exp_key="e1")
+        ft.insert_trial_docs(_new_docs(ft, 1))
+        doc = ft.reserve("w:1")
+        before = doc.get("refresh_time")
+        time.sleep(0.01)
+        assert ft.heartbeat(doc, owner="w:1")
+        ft.refresh()
+        cur = ft.trials[0]
+        assert cur["state"] == JOB_STATE_RUNNING
+        assert cur["refresh_time"] >= before
+
+
+class TestTimeoutWithDeadFleet:
+    def test_fmin_timeout_returns_without_workers(self, tmp_path):
+        """Async fmin over a store with NO workers must return at its
+        timeout instead of waiting out NEW trials forever (the backend
+        cannot cancel; best-so-far plus a warning is the contract)."""
+        from hyperopt_tpu import fmin
+        from hyperopt_tpu.exceptions import AllTrialsFailed
+        from hyperopt_tpu.parallel import FileTrials
+
+        ft = FileTrials(str(tmp_path / "store"), exp_key="e1")
+        t0 = time.monotonic()
+        # Nothing ever completes, so fmin ends with AllTrialsFailed —
+        # the point is that it ENDS, at the timeout, not never.
+        with pytest.raises(AllTrialsFailed):
+            fmin(_quad, {"x": hp.uniform("x", -5.0, 5.0)},
+                 algo=rand.suggest, max_evals=4, trials=ft,
+                 rstate=np.random.default_rng(0), show_progressbar=False,
+                 verbose=False, timeout=1.0, return_argmin=False)
+        assert time.monotonic() - t0 < 15.0
+        # The un-run trials stay in the store for a future fleet.
+        ft.refresh()
+        assert len(ft.trials) >= 1
+
+
+# ---------------------------------------------------------------------------
+# fleet metrics: heartbeat piggyback + GET /metrics
+# ---------------------------------------------------------------------------
+
+
+class TestFleetMetrics:
+    def _server(self, tmp_path, **kw):
+        from hyperopt_tpu.parallel.netstore import StoreServer
+
+        srv = StoreServer(str(tmp_path / "store"), **kw)
+        srv.start()
+        return srv
+
+    def test_metrics_get_auth_and_fleet_key(self, tmp_path, monkeypatch):
+        from urllib.error import HTTPError
+        from urllib.request import Request, urlopen
+
+        monkeypatch.delenv("HYPEROPT_TPU_NETSTORE_TOKEN", raising=False)
+        srv = self._server(tmp_path, token="s3kr1t")
+        try:
+            with pytest.raises(HTTPError) as ei:
+                urlopen(Request(srv.url + "/metrics"), timeout=10.0)
+            assert ei.value.code == 401
+            req = Request(srv.url + "/metrics",
+                          headers={"X-Netstore-Token": "s3kr1t"})
+            with urlopen(req, timeout=10.0) as resp:
+                snap = json.loads(resp.read())
+            # Historical keys preserved + the new fleet view.
+            assert {"enabled", "counters", "gauges", "histograms",
+                    "fleet"} <= set(snap)
+            assert snap["fleet"]["n_workers"] == 0
+            assert snap["fleet"]["workers"] == {}
+        finally:
+            srv.shutdown()
+
+    def test_heartbeat_piggyback_labels_and_reset_survival(self, tmp_path):
+        """A worker's heartbeat pushes its labeled snapshot; the label
+        survives a server-side ``snapshot(reset=True)`` because the fleet
+        store is deliberately NOT part of the local registry."""
+        from hyperopt_tpu.obs import metrics as _metrics
+        from hyperopt_tpu.parallel import NetTrials
+
+        srv = self._server(tmp_path)
+        try:
+            nt = NetTrials(srv.url, exp_key="e1")
+            nt.metrics_push_interval = 0.0  # push on every beat
+            nt.insert_trial_docs(_new_docs(nt, 1))
+            doc = nt.reserve("w1:1:abcd1234")
+            assert doc is not None
+            assert nt.heartbeat(doc, owner="w1:1:abcd1234") is True
+
+            payload = srv.metrics_payload()
+            fleet = payload["fleet"]
+            assert fleet["n_workers"] == 1
+            assert "w1:1:abcd1234" in fleet["workers"]
+            w = fleet["workers"]["w1:1:abcd1234"]
+            assert w["age_s"] < 30.0
+            assert "counters" in w and "histograms" in w
+            # The merged view is itself a snapshot-shaped doc.
+            assert "counters" in fleet["merged"]
+
+            # Reset the LOCAL registry: per-worker labels must survive.
+            _metrics.registry().snapshot(reset=True)
+            fleet2 = srv.metrics_payload()["fleet"]
+            assert "w1:1:abcd1234" in fleet2["workers"]
+
+            # Heartbeat replies carry the server wall clock; the client
+            # turned it into a skew estimate (~0 on one machine).
+            skew = _metrics.registry().gauge("clock.skew_s").value
+            assert abs(skew) < 5.0
+        finally:
+            srv.shutdown()
+
+    def test_fleet_round_trips_through_nettrials_metrics(self, tmp_path):
+        """The ``metrics`` RPC verb is the ``GET /metrics`` twin: the
+        merged fleet histograms survive the JSON round-trip with counts
+        intact."""
+        from hyperopt_tpu.parallel import NetTrials
+
+        srv = self._server(tmp_path)
+        try:
+            nt = NetTrials(srv.url, exp_key="e1")
+            nt.metrics_push_interval = 0.0
+            nt.insert_trial_docs(_new_docs(nt, 1))
+            doc = nt.reserve("w2:9:ffff0000")
+            nt.heartbeat(doc, owner="w2:9:ffff0000")
+            via_rpc = nt.metrics()
+            assert via_rpc["fleet"]["n_workers"] == 1
+            merged = via_rpc["fleet"]["merged"]
+            hist = merged["histograms"].get("netstore.client.rpc.s")
+            if hist is not None:  # registry armed in this process
+                assert hist["count"] >= 1
+                assert "state" in hist  # still mergeable downstream
+        finally:
+            srv.shutdown()
+
+    def test_rpc_bodies_carry_ctx_when_armed(self, tmp_path,
+                                             armed_context):
+        """Client RPCs stamp the ambient context; the server adopts it so
+        server-side events attach to the originating trial."""
+        from hyperopt_tpu.obs.events import EVENTS
+        from hyperopt_tpu.parallel import NetTrials
+
+        srv = self._server(tmp_path)
+        try:
+            nt = NetTrials(srv.url, exp_key="e1")
+            EVENTS.enable()
+            with obs_context.bind(trace_id="feedface", tid=123):
+                nt.refresh()  # any verb will do
+            rpcs = [e for e in EVENTS.snapshot() if e["type"] == "rpc"
+                    and e.get("name") == "docs"]
+            assert rpcs, "server emitted no rpc event"
+            assert rpcs[-1]["trace_id"] == "feedface"
+            assert rpcs[-1]["trial"] == 123
+        finally:
+            EVENTS.disable()
+            EVENTS.clear()
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# trace stitching: skew normalization + flow arrows
+# ---------------------------------------------------------------------------
+
+
+def _write_events_file(path, meta, events):
+    with open(path, "w") as f:
+        f.write(json.dumps({"type": "meta", **meta}) + "\n")
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+
+
+class TestMergeTraces:
+    def test_skew_normalization_regression(self, tmp_path):
+        """Two processes log the same wall instant; the worker's clock is
+        50s ahead (and its meta says so).  After merging, both lanes land
+        on the server clock frame within a millisecond."""
+        server = tmp_path / "server.jsonl"
+        worker = tmp_path / "worker.jsonl"
+        # Server frame: event at mono 5 -> wall 1005.
+        _write_events_file(server, {"pid": 1, "wall0": 1000.0,
+                                    "mono0": 0.0, "skew_s": 0.0},
+                           [{"type": "store_claim", "trial": 7,
+                             "t_mono": 5.0, "t_wall": 1005.0,
+                             "thread": "MainThread"}])
+        # Worker clock 50s ahead: its wall anchor reads 1055 at the same
+        # true instant the server read 1005; its heartbeat skew estimate
+        # recorded skew_s=50.
+        _write_events_file(worker, {"pid": 2, "wall0": 1055.0,
+                                    "mono0": 100.0, "skew_s": 50.0},
+                           [{"type": "trial_start", "trial": 7,
+                             "t_mono": 105.0, "t_wall": 1060.0,
+                             "thread": "MainThread"}])
+        from hyperopt_tpu.show import merge_traces
+
+        doc = merge_traces([str(server), str(worker)],
+                           out=io.StringIO())
+        evs = [e for e in doc["traceEvents"]
+               if e.get("cat", "").startswith("hyperopt_tpu")]
+        by_pid = {e["pid"]: e["ts"] for e in evs}
+        assert by_pid[1] == pytest.approx(1005.0 * 1e6, abs=1e3)
+        assert by_pid[2] == pytest.approx(1010.0 * 1e6, abs=1e3)
+        # Without the correction the worker lane would sit 50s off.
+        assert abs(by_pid[2] - by_pid[1]) < 10.0 * 1e6
+
+    def test_cross_process_flow_arrows(self, tmp_path):
+        """A trial whose events appear in two lanes gets one flow (s..f
+        sharing an id) threaded across them; a single-lane trial gets
+        none."""
+        a = tmp_path / "a.jsonl"
+        b = tmp_path / "b.jsonl"
+        meta = {"wall0": 0.0, "mono0": 0.0, "skew_s": 0.0}
+        _write_events_file(a, dict(meta, pid=10, role="server"), [
+            {"type": "trial_queued", "trial": 1, "t_mono": 1.0,
+             "t_wall": 1.0, "thread": "MainThread"},
+            {"type": "store_write", "trial": 1, "t_mono": 4.0,
+             "t_wall": 4.0, "thread": "MainThread"},
+            {"type": "trial_queued", "trial": 2, "t_mono": 1.5,
+             "t_wall": 1.5, "thread": "MainThread"},
+        ])
+        _write_events_file(b, dict(meta, pid=11,
+                                   worker_id="w:1:beef"), [
+            {"type": "trial_start", "trial": 1, "t_mono": 2.0,
+             "t_wall": 2.0, "thread": "MainThread"},
+            {"type": "trial_end", "trial": 1, "t_mono": 3.0,
+             "t_wall": 3.0, "thread": "MainThread"},
+        ])
+        from hyperopt_tpu.show import merge_traces
+
+        doc = merge_traces([str(a), str(b)], out=io.StringIO())
+        assert doc["otherData"]["n_trial_flows"] == 1
+        flows = [e for e in doc["traceEvents"]
+                 if e.get("cat") == "trial_flow"]
+        assert all(e["id"] == "1" for e in flows)
+        phases = [e["ph"] for e in sorted(flows, key=lambda e: e["ts"])]
+        assert phases[0] == "s" and phases[-1] == "f"
+        assert {e["pid"] for e in flows} == {1, 2}
+        # Lanes are labeled from the meta header.
+        names = [e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M"]
+        assert any("server" in n for n in names)
+        assert any("w:1:beef" in n for n in names)
+
+    def test_merge_writes_loadable_artifact(self, tmp_path):
+        a = tmp_path / "a.jsonl"
+        _write_events_file(a, {"pid": 1, "wall0": 0.0, "mono0": 0.0,
+                               "skew_s": 0.0},
+                           [{"type": "suggest", "t_mono": 1.0,
+                             "t_wall": 1.0, "thread": "MainThread",
+                             "n": 4}])
+        out_path = tmp_path / "merged.json"
+        from hyperopt_tpu.show import merge_traces
+
+        merge_traces([str(a)], out_path=str(out_path), out=io.StringIO())
+        with open(out_path) as f:
+            doc = json.load(f)
+        assert isinstance(doc["traceEvents"], list)
+        assert doc["otherData"]["merged_from"] == [str(a)]
+
+
+# ---------------------------------------------------------------------------
+# live dashboard
+# ---------------------------------------------------------------------------
+
+
+class TestLiveDashboard:
+    def _payload(self):
+        return {
+            "enabled": True,
+            "counters": {"fmin.trials.done": 10, "faults.injected": 2,
+                         "store.requeued": 1},
+            "gauges": {"pipeline.occupancy": 3.0,
+                       "pipeline.eval_backlog": 2.0},
+            "histograms": {
+                "netstore.verb.reserve.s": {
+                    "count": 12, "sum": 0.1, "mean": 0.008,
+                    "min": 0.001, "max": 0.02,
+                    "p50": 0.008, "p90": 0.015, "p95": 0.018,
+                    "p99": 0.02},
+            },
+            "fleet": {
+                "n_workers": 1,
+                "workers": {"w:1:beef": {
+                    "age_s": 1.2,
+                    "counters": {"worker.trials": 4},
+                    "gauges": {"worker.consecutive_failures": 0},
+                    "histograms": {}}},
+                "merged": {"counters": {"worker.trials": 4},
+                           "gauges": {}, "histograms": {}},
+            },
+        }
+
+    def test_render_live_frame(self):
+        from hyperopt_tpu.show import render_live
+
+        buf = io.StringIO()
+        sample = render_live(self._payload(), out=buf)
+        text = buf.getvalue()
+        assert "1 worker(s)" in text
+        assert "reserve" in text and "p99ms" in text
+        assert "w:1:beef" in text
+        assert "faults injected 2" in text
+        assert "occupancy 3.0" in text
+        # Second frame with a prev sample derives a rate.
+        buf2 = io.StringIO()
+        render_live(self._payload(), out=buf2,
+                    prev=(sample[0] - 2.0, sample[1] - 4))
+        assert "trials/s" in buf2.getvalue()
+
+    def test_live_once_against_real_server(self, tmp_path):
+        from hyperopt_tpu.parallel.netstore import StoreServer
+        from hyperopt_tpu.show import live
+
+        srv = StoreServer(str(tmp_path / "store"))
+        srv.start()
+        try:
+            buf = io.StringIO()
+            rc = live(srv.url, once=True, out=buf)
+            assert rc == 0
+            assert "0 worker(s)" in buf.getvalue()
+        finally:
+            srv.shutdown()
+
+    def test_live_once_fetch_failure_is_rc_1(self):
+        from hyperopt_tpu.show import live
+
+        buf = io.StringIO()
+        rc = live("http://127.0.0.1:9", once=True, out=buf)
+        assert rc == 1
+        assert "fetch failed" in buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# disabled-path overhead (context stamping budget)
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledOverhead:
+    def test_context_disabled_path_bound(self):
+        """wire_current/stamp_misc while disarmed must stay in the same
+        cost class as faults.maybe_fail's disarmed gate (sub-µs); the
+        budgeted bound here is deliberately loose for CI noise."""
+        assert not obs_context.armed()
+        misc = {}
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            obs_context.wire_current()
+            obs_context.stamp_misc(misc)
+        per_op = (time.perf_counter() - t0) / (2 * n)
+        assert per_op < 5e-6, f"{per_op * 1e9:.0f} ns/op"
+        assert misc == {}

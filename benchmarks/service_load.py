@@ -1,0 +1,282 @@
+"""Service load: 1000 simulated workers, 4 tenants, 30%+ RPC loss.
+
+The PR 7 acceptance harness for the suggestion service: an in-process
+:class:`~hyperopt_tpu.service.server.ServiceServer` (WAL-durable,
+multi-tenant) is driven by
+
+* **4 tenant drivers** — one ``fmin`` per tenant over a ``NetTrials``
+  bound to that tenant's token, proposals generated SERVER-side through
+  the ``suggest`` verb (``server_suggest`` in the algo slot: the thin-
+  client protocol — the driver never runs the algorithm locally);
+* **1000 logical workers** — 250 distinct worker identities per tenant,
+  multiplexed over a small OS-thread pool per tenant.  Each identity
+  completes exactly one reserve→evaluate→write_result cycle, so owner
+  fencing sees 1000 distinct owners;
+* **chaos** — every RPC (client→server and reply) is subjected to a
+  combined ≥30% injected loss (``rpc.send``/``rpc.recv`` fault points);
+  clients retry with tight backoff, the idempotency layer dedupes.
+
+Every tenant shares the SAME ``exp_key``, so the tid ranges collide by
+construction — the leakage check then has teeth: each worker stamps its
+tenant name into the result it writes, and any document in tenant T's
+namespace carrying another tenant's stamp (or a tid outside 0..249, or
+a loss outside T's offset band) is a cross-tenant leak.  The acceptance
+bar is zero.
+
+Run::
+
+    env JAX_PLATFORMS=cpu python benchmarks/service_load.py
+
+Writes ``benchmarks/service_load_cpu_<stamp>.json`` with per-verb
+p50/p95/p99 server latencies, per-tenant totals, chaos + WAL stats and
+the headline gates (≥1000 workers, ≥4 tenants, ≥30% loss, completed,
+zero leakage).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import sys
+import tempfile
+import threading
+import time
+from functools import partial
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+N_TENANTS = 4
+WORKERS_PER_TENANT = 250          # = trials per tenant: one cycle each
+THREADS_PER_TENANT = 6
+MAX_QUEUE_LEN = 25                # suggest batch size per fmin step
+SEND_P, RECV_P = 0.25, 0.10       # combined loss 1-(.75*.90) = 0.325
+SEED = 0
+OFFSET = 1000.0                   # per-tenant loss band separation
+
+
+def _objective(cfg, offset=0.0):
+    return float(offset + cfg["x"] ** 2)
+
+
+def _space():
+    import hyperopt_tpu as ho
+
+    return {"x": ho.hp.uniform("x", -5, 5)}
+
+
+def _worker_pool(url, tenant_idx, token, stop, stats, lock):
+    """One tenant's worker fleet: THREADS_PER_TENANT OS threads draining
+    a queue of WORKERS_PER_TENANT distinct owner identities — a claim
+    cycle consumes an identity; an empty reserve puts it back."""
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+    from hyperopt_tpu.exceptions import NetstoreUnavailable
+    from hyperopt_tpu.parallel.netstore import NetTrials
+
+    tname = f"tenant-{tenant_idx}"
+    ids: queue.Queue = queue.Queue()
+    for i in range(WORKERS_PER_TENANT):
+        ids.put(f"{tname}-w{i:03d}")
+
+    def loop():
+        nt = NetTrials(url, exp_key="exp", token=token, refresh=False)
+        while not stop.is_set():
+            try:
+                owner = ids.get(timeout=0.05)
+            except queue.Empty:
+                return                      # all identities consumed
+            try:
+                doc = nt.reserve(owner)
+            except NetstoreUnavailable:
+                ids.put(owner)
+                continue
+            if doc is None:
+                ids.put(owner)
+                time.sleep(0.01)
+                continue
+            x = doc["misc"]["vals"]["x"][0]
+            doc["state"] = JOB_STATE_DONE
+            # The tenant stamp IS the leakage probe: a worker can only
+            # compute with its own tenant's offset, so a doc that shows
+            # up in the wrong namespace carries the wrong stamp/band.
+            doc["result"] = {"status": STATUS_OK,
+                             "loss": _objective({"x": x},
+                                                tenant_idx * OFFSET),
+                             "tenant": tname}
+            try:
+                ok = nt.write_result(doc, owner=owner)
+            except NetstoreUnavailable:
+                ids.put(owner)
+                continue
+            with lock:
+                stats["completed" if ok else "fenced"] += 1
+
+    threads = [threading.Thread(target=loop, daemon=True,
+                                name=f"{tname}-pool{j}")
+               for j in range(THREADS_PER_TENANT)]
+    for t in threads:
+        t.start()
+    return threads
+
+
+def main():
+    os.environ.setdefault("HYPEROPT_TPU_NETSTORE_RETRIES", "30")
+    os.environ.setdefault("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.002")
+
+    from hyperopt_tpu import faults
+    from hyperopt_tpu.obs import metrics as _metrics
+    from hyperopt_tpu.parallel.netstore import NetTrials, server_suggest
+    from hyperopt_tpu.service import Tenant, TenantTable
+    from hyperopt_tpu.service import wal as wal_mod
+    from hyperopt_tpu.service.server import ServiceServer
+
+    _metrics.registry().snapshot(reset=True)
+    wal_dir = tempfile.mkdtemp(prefix="service_load_wal_")
+    tenants = TenantTable([
+        Tenant(f"tenant-{i}", f"tok-{i}", max_claims=64,
+               trials_per_s=500.0, burst=300.0)
+        for i in range(N_TENANTS)])
+    srv = ServiceServer(wal_dir, tenants=tenants, fsync="batch",
+                        snapshot_every=2000)
+    srv.start()
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    stats = [{"completed": 0, "fenced": 0} for _ in range(N_TENANTS)]
+    pools = []
+    t0 = time.perf_counter()
+    faults.configure({"rpc.send": SEND_P, "rpc.recv": RECV_P}, seed=SEED)
+    try:
+        for i in range(N_TENANTS):
+            pools += _worker_pool(srv.url, i, f"tok-{i}", stop,
+                                  stats[i], lock)
+
+        def drive(i):
+            nt = NetTrials(srv.url, exp_key="exp", token=f"tok-{i}")
+            nt.fmin(partial(_objective, offset=i * OFFSET), _space(),
+                    algo=partial(server_suggest, algo="rand"),
+                    max_evals=WORKERS_PER_TENANT,
+                    max_queue_len=MAX_QUEUE_LEN,
+                    rstate=np.random.default_rng(SEED + i),
+                    show_progressbar=False)
+
+        drivers = [threading.Thread(target=drive, args=(i,),
+                                    name=f"driver-{i}")
+                   for i in range(N_TENANTS)]
+        for d in drivers:
+            d.start()
+        for d in drivers:
+            d.join()
+    finally:
+        faults.clear()
+        stop.set()
+        for t in pools:
+            t.join(timeout=10)
+    wall_s = time.perf_counter() - t0
+
+    # -- leakage + per-tenant audit (chaos off: clean reads) ----------------
+    tenant_rows, leaks = [], 0
+    for i in range(N_TENANTS):
+        nt = NetTrials(srv.url, exp_key="exp", token=f"tok-{i}")
+        nt.refresh()
+        docs = nt._dynamic_trials
+        tids = sorted(d["tid"] for d in docs)
+        lo, hi = i * OFFSET, i * OFFSET + 25.0
+        t_leaks = sum(
+            1 for d in docs
+            if d["result"].get("tenant") != f"tenant-{i}"
+            or not (lo <= d["result"]["loss"] <= hi))
+        leaks += t_leaks
+        if tids != list(range(WORKERS_PER_TENANT)):
+            leaks += 1              # lost/foreign tids are leakage too
+        tenant_rows.append({
+            "tenant": f"tenant-{i}",
+            "trials": len(docs),
+            "workers": WORKERS_PER_TENANT,
+            "completed": stats[i]["completed"],
+            "fenced_writes": stats[i]["fenced"],
+            "tid_range_ok": tids == list(range(WORKERS_PER_TENANT)),
+            "leaks": t_leaks,
+            "best_loss": min(d["result"]["loss"] for d in docs),
+        })
+
+    snap = srv.metrics_payload()
+    counters = snap.get("counters", {})
+    verb_rows = []
+    for name, h in sorted(snap.get("histograms", {}).items()):
+        if name.startswith("netstore.verb.") and name.endswith(".s") \
+                and h.get("count"):
+            verb_rows.append({
+                "verb": name[len("netstore.verb."):-len(".s")],
+                "count": h["count"],
+                "p50_ms": round(1e3 * h["p50"], 3),
+                "p95_ms": round(1e3 * h["p95"], 3),
+                "p99_ms": round(1e3 * h["p99"], 3),
+            })
+
+    wal_info = wal_mod.inspect(wal_dir)
+    total = N_TENANTS * WORKERS_PER_TENANT
+    completed = sum(s["completed"] for s in stats)
+    doc = {
+        "metric": "service_load_multitenant_chaos",
+        "backend": "cpu",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "config": {
+            "tenants": N_TENANTS,
+            "workers_per_tenant": WORKERS_PER_TENANT,
+            "threads_per_tenant": THREADS_PER_TENANT,
+            "max_queue_len": MAX_QUEUE_LEN,
+            "algo": "rand (server-side suggest verb)",
+            "fsync": "batch",
+            "rpc_loss": {"send_p": SEND_P, "recv_p": RECV_P,
+                         "combined": round(1 - (1 - SEND_P) * (1 - RECV_P),
+                                           4)},
+        },
+        "rows": verb_rows,
+        "tenants": tenant_rows,
+        "chaos": {
+            "faults_injected": counters.get("faults.injected", 0),
+            "rpc_retries": counters.get("netstore.rpc.retry", 0),
+            "rpc_unavailable": counters.get("netstore.rpc.unavailable", 0),
+            "idem_hits": counters.get("netstore.idem.hits", 0),
+            "idem_evicted": counters.get("netstore.idem.evicted", 0),
+        },
+        "wal": {
+            "appends": counters.get("wal.appends", 0),
+            "fsyncs": counters.get("wal.fsyncs", 0),
+            "snapshots": counters.get("wal.snapshots", 0),
+            "bytes": counters.get("wal.bytes", 0),
+            "tail_records": wal_info["records"],
+            "torn_tail": wal_info["torn_tail"],
+        },
+        "headline": {
+            "workers": total,
+            "tenants": N_TENANTS,
+            "rpc_loss_combined": round(1 - (1 - SEND_P) * (1 - RECV_P), 4),
+            "trials_total": total,
+            "trials_completed": completed,
+            "completed": completed == total,
+            "zero_leakage": leaks == 0,
+            "wall_s": round(wall_s, 2),
+            "trials_per_sec": round(total / wall_s, 2),
+        },
+    }
+    srv.shutdown()
+
+    stamp = time.strftime("%Y%m%d")
+    out_path = os.path.join(_ROOT, "benchmarks",
+                            f"service_load_cpu_{stamp}.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc["headline"], indent=1))
+    print(f"wrote {out_path}")
+    if not (doc["headline"]["completed"] and doc["headline"]["zero_leakage"]):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -56,6 +56,17 @@ class TransientEvaluationError(HyperoptTpuError):
     """
 
 
+class QuotaExceeded(HyperoptTpuError):
+    """A tenant exceeded one of its service quotas (max concurrent claims
+    or trials/s admission rate) and the server refused the verb.
+
+    Deliberately NOT transient: a caller looping on quota rejections is
+    over its budget by construction — backing off blindly would mask
+    starvation.  Callers that can wait should sleep past the refill
+    window and retry explicitly.
+    """
+
+
 class NetstoreUnavailable(HyperoptTpuError):
     """Netstore transport failure that survived the whole retry budget.
 

@@ -17,6 +17,8 @@ Fault points wired into the core::
     worker.evaluate   around a worker's domain.evaluate call
     objective.call    at the top of Domain.evaluate (every execution path)
     pipeline.dispatch before PipelinedExecutor dispatches a suggest slot
+    wal.write         before a service-server WAL record is appended
+    wal.replay        per record during WAL replay at server recovery
 
 Configuration — programmatic::
 
@@ -78,6 +80,8 @@ FAULT_POINTS = frozenset(
         "worker.evaluate",
         "objective.call",
         "pipeline.dispatch",
+        "wal.write",
+        "wal.replay",
     }
 )
 

@@ -255,6 +255,22 @@ class FileTrials(Trials):
         with open(os.path.join(self._exp_dir, _DOMAIN_FILE), "rb") as f:
             return pickle.load(f)
 
+    def put_domain_blob(self, blob: bytes) -> None:
+        """Store the already-pickled domain bytes (netstore put_domain
+        verb: the server must not unpickle what it merely relays)."""
+        path = os.path.join(self._exp_dir, _DOMAIN_FILE)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    def get_domain_blob(self) -> Optional[bytes]:
+        try:
+            with open(os.path.join(self._exp_dir, _DOMAIN_FILE), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
     def fmin(self, fn, space, algo, max_evals, **kwargs):
         from ..base import Domain
         try:

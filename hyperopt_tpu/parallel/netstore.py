@@ -66,8 +66,8 @@ from urllib.error import HTTPError, URLError
 from urllib.request import Request, urlopen
 
 from .filestore import FileTrials, FileWorker, _pickler
-from ..base import Trials
-from ..exceptions import InjectedFault, NetstoreUnavailable
+from ..base import JOB_STATE_RUNNING, Trials
+from ..exceptions import InjectedFault, NetstoreUnavailable, QuotaExceeded
 from ..obs import context as _context
 from ..obs import metrics as _metrics
 from ..obs.events import EVENTS
@@ -106,24 +106,39 @@ class StoreServer:
     evaluations — the actual work — happen client-side in the workers).
     """
 
-    #: Bound on the idempotency dedup cache (completed mutating calls kept
-    #: for replay).  Retries arrive within seconds of the original, so a
-    #: few thousand entries is generations of headroom.
+    #: Bounds on the idempotency dedup cache (completed mutating calls
+    #: kept for replay): LRU capacity + TTL, both env-tunable.  Retries
+    #: arrive within seconds of the original, so thousands of entries /
+    #: minutes of TTL are generations of headroom — the bound exists so
+    #: a long-running fleet's cache cannot grow without limit.
     _IDEM_CAP = 4096
+    _IDEM_TTL_S = 900.0
 
     def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
                  token: str | None = None,
                  requeue_stale_every: float | None = None,
-                 stale_timeout: float = 60.0):
+                 stale_timeout: float = 60.0,
+                 tenants=None):
         self.root = os.path.abspath(root)
-        self._trials: dict = {}          # exp_key -> FileTrials
-        self._lock = threading.Lock()
+        self._trials: dict = {}          # (tenant_name, exp_key) -> store
+        self._lock = threading.RLock()
         self._token = _resolve_token(token)
-        # Exactly-once under client retry: (exp_key, idem_key) -> the JSON
-        # reply of the first execution.  Stored serialized so a replay can
-        # never alias live server-side state.
+        # Multi-tenant mode: a service.tenancy.TenantTable (anything with
+        # .resolve(token) -> tenant).  When set, every verb authenticates
+        # as SOME tenant and the dispatch layer namespaces exp_keys into
+        # the tenant's own store subtree — the store key derives from the
+        # authenticated identity, never from the request body.
+        self._tenants = tenants
+        # Exactly-once under client retry: (tenant, exp_key, idem_key) ->
+        # (t_monotonic, JSON reply) of the first execution.  Stored
+        # serialized so a replay can never alias live server-side state;
+        # LRU + TTL bounded (netstore.idem.evicted counts expulsions).
         self._idem: OrderedDict = OrderedDict()
         self._idem_lock = threading.Lock()
+        self._idem_cap = int(os.environ.get(
+            "HYPEROPT_TPU_NETSTORE_IDEM_CAP", "") or self._IDEM_CAP)
+        self._idem_ttl = float(os.environ.get(
+            "HYPEROPT_TPU_NETSTORE_IDEM_TTL", "") or self._IDEM_TTL_S)
         # Fleet metrics: worker_id -> {"t": last push wall time, "metrics":
         # the worker's cumulative registry snapshot}.  Workers piggyback
         # snapshots on heartbeats (NetTrials.heartbeat); last-write-wins
@@ -154,24 +169,39 @@ class StoreServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _authed(self) -> bool:
-                # Auth gate BEFORE the body is parsed or any verb runs:
-                # constant-time compare so the secret can't be recovered
-                # byte-by-byte from response timing.  The request body is
-                # still drained (keep-alive correctness) but never
-                # dispatched.
-                if server._token is None:
-                    return True
-                got = self.headers.get("X-Netstore-Token", "")
-                if hmac.compare_digest(got.encode(),
-                                       server._token.encode()):
-                    return True
+            def _reject(self):
                 _metrics.registry().counter("netstore.auth.rejected").inc()
                 self.rfile.read(
                     int(self.headers.get("Content-Length", "0")))
                 self._send_json(401, json.dumps(
                     {"error": "AuthError: missing or bad "
                      "X-Netstore-Token"}).encode())
+
+            def _authed(self) -> bool:
+                # Auth gate BEFORE the body is parsed or any verb runs:
+                # constant-time compare so the secret can't be recovered
+                # byte-by-byte from response timing.  The request body is
+                # still drained (keep-alive correctness) but never
+                # dispatched.  Multi-tenant mode resolves the token to a
+                # Tenant (itself a full-table constant-time scan); the
+                # tenant identity then namespaces every verb of this
+                # request — it comes from the header, never the body.
+                self._tenant = None
+                if server._tenants is not None:
+                    got = self.headers.get("X-Netstore-Token", "")
+                    tenant = server._tenants.resolve(got)
+                    if tenant is None:
+                        self._reject()
+                        return False
+                    self._tenant = tenant
+                    return True
+                if server._token is None:
+                    return True
+                got = self.headers.get("X-Netstore-Token", "")
+                if hmac.compare_digest(got.encode(),
+                                       server._token.encode()):
+                    return True
+                self._reject()
                 return False
 
             def do_POST(self):
@@ -180,7 +210,7 @@ class StoreServer:
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(n) or b"{}")
-                    out = server._dispatch(req)
+                    out = server._dispatch(req, tenant=self._tenant)
                     body = json.dumps(out).encode()
                     code = 200
                 except Exception as e:  # surface server faults to the client
@@ -254,16 +284,21 @@ class StoreServer:
         # immediately; first pass only after one full period.
         while not self._janitor_stop.wait(self.requeue_stale_every):
             try:
-                with self._lock:
-                    stores = list(self._trials.values())
-                for ft in stores:
-                    with self._lock:
-                        n = ft.requeue_stale(self.stale_timeout)
-                    if n:
-                        logger.info("netstore janitor: requeued %d stale "
-                                    "trial(s) in %r", n, ft._exp_key)
+                self._janitor_pass()
             except Exception:       # janitor must outlive any bad store
                 logger.exception("netstore janitor: requeue_stale failed")
+
+    def _janitor_pass(self):
+        # Overridable: the WAL-backed ServiceServer routes these requeues
+        # through its log so replay reproduces the janitor's decisions.
+        with self._lock:
+            stores = list(self._trials.values())
+        for ft in stores:
+            with self._lock:
+                n = ft.requeue_stale(self.stale_timeout)
+            if n:
+                logger.info("netstore janitor: requeued %d stale "
+                            "trial(s) in %r", n, ft._exp_key)
 
     @property
     def url(self) -> str:
@@ -271,14 +306,51 @@ class StoreServer:
 
     # -- verbs ---------------------------------------------------------------
 
-    def _store(self, exp_key: str) -> FileTrials:
-        ft = self._trials.get(exp_key)
+    def _store(self, exp_key: str, tenant=None) -> FileTrials:
+        # Tenant namespacing happens HERE and only here: the store key
+        # pairs the authenticated tenant name with the client's exp_key,
+        # and each tenant's files live under their own subtree.  The
+        # exp_key inside the documents stays the client's own (the doc
+        # filter `_exp_key in (None, d["exp_key"])` must keep matching).
+        tname = getattr(tenant, "name", tenant)
+        key = (tname, exp_key)
+        ft = self._trials.get(key)
         if ft is None:
-            ft = self._trials[exp_key] = FileTrials(self.root,
-                                                    exp_key=exp_key)
+            root = os.path.join(self.root, tname) if tname else self.root
+            ft = self._trials[key] = FileTrials(root, exp_key=exp_key)
         return ft
 
-    def _dispatch(self, req: dict) -> dict:
+    def _idem_get(self, key):
+        with self._idem_lock:
+            hit = self._idem.get(key)
+            if hit is None:
+                return None
+            t, payload = hit
+            if time.monotonic() - t > self._idem_ttl:
+                del self._idem[key]
+                _metrics.registry().counter("netstore.idem.evicted").inc()
+                return None
+            self._idem.move_to_end(key)      # LRU touch
+            return payload
+
+    def _idem_put(self, key, payload: str):
+        evicted = 0
+        with self._idem_lock:
+            self._idem[key] = (time.monotonic(), payload)
+            self._idem.move_to_end(key)
+            # Expire from the cold end: TTL first, then LRU overflow.
+            now = time.monotonic()
+            while self._idem:
+                k, (t, _) = next(iter(self._idem.items()))
+                if now - t > self._idem_ttl or len(self._idem) > self._idem_cap:
+                    self._idem.popitem(last=False)
+                    evicted += 1
+                else:
+                    break
+        if evicted:
+            _metrics.registry().counter("netstore.idem.evicted").inc(evicted)
+
+    def _dispatch(self, req: dict, tenant=None) -> dict:
         verb = req["verb"]
         reg = _metrics.registry()
         t0 = time.perf_counter()
@@ -288,28 +360,26 @@ class StoreServer:
         # fault injections, the rpc instant below — attaches to the
         # originating trial and trace.
         ctx = req.pop("ctx", None)
+        tname = getattr(tenant, "name", None)
         try:
             with _context.adopt(ctx):
                 EVENTS.emit("rpc", name=verb)
                 idem = req.pop("idem", None)
                 if idem is None:
-                    return self._dispatch_verb(verb, req)
+                    return self._dispatch_verb(verb, req, tenant=tenant)
                 # Mutating verb with an idempotency key: a retry of a call
                 # the server already executed must return the original
                 # reply, not run the verb twice (the client retries blind
                 # — it cannot know whether the loss was on the way in or
                 # out).
-                key = (req.get("exp_key", "default"), idem)
-                with self._idem_lock:
-                    cached = self._idem.get(key)
+                key = (tname, req.get("exp_key", "default"), idem)
+                cached = self._idem_get(key)
                 if cached is not None:
                     reg.counter("netstore.idem.hits").inc()
                     return json.loads(cached)
-                out = self._dispatch_verb(verb, req)
-                with self._idem_lock:
-                    self._idem[key] = json.dumps(out)
-                    while len(self._idem) > self._IDEM_CAP:
-                        self._idem.popitem(last=False)
+                out = self._dispatch_verb(verb, req, tenant=tenant,
+                                          idem=idem)
+                self._idem_put(key, json.dumps(out))
                 return out
         finally:
             # Per-verb call count + latency histogram: the contention
@@ -317,6 +387,13 @@ class StoreServer:
             reg.counter(f"netstore.verb.{verb}.calls").inc()
             reg.histogram(f"netstore.verb.{verb}.s").observe(
                 time.perf_counter() - t0)
+            if tname is not None:
+                # Per-tenant labels for `show live` and quota forensics.
+                reg.counter(
+                    f"netstore.tenant.{tname}.verb.{verb}.calls").inc()
+                reg.histogram(
+                    f"netstore.tenant.{tname}.verb.{verb}.s").observe(
+                    time.perf_counter() - t0)
 
     def metrics_payload(self) -> dict:
         """The ``GET /metrics`` document: local snapshot + fleet view.
@@ -357,23 +434,72 @@ class StoreServer:
         }
         return snap
 
-    def _dispatch_verb(self, verb: str, req: dict) -> dict:
+    # -- tenant quotas -------------------------------------------------------
+
+    def _charge_admission(self, tenant, n: int) -> None:
+        """Charge ``n`` trial creations against the tenant's rate quota
+        (token bucket); raises :class:`QuotaExceeded` on refusal.  Runs
+        BEFORE any WAL append or execution — a refused call leaves no
+        trace in durable state."""
+        admit = getattr(tenant, "admit_trials", None)
+        if admit is None or admit(int(n)):
+            return
+        tname = getattr(tenant, "name", "?")
+        _metrics.registry().counter(
+            f"netstore.tenant.{tname}.quota.rate_rejected").inc()
+        raise QuotaExceeded(
+            f"tenant {tname!r}: trials/s admission quota exceeded "
+            f"(rate={getattr(tenant, 'trials_per_s', None)}, asked {n})")
+
+    def _claims_quota_hit(self, tenant) -> bool:
+        """True when the tenant already holds ``max_claims`` RUNNING
+        trials across all of its experiments (reserve must answer
+        queue-empty so stock workers back off via their poll loop)."""
+        limit = getattr(tenant, "max_claims", None)
+        if limit is None:
+            return False
+        tname = getattr(tenant, "name", None)
+        held = 0
+        for (tn, _), ft in self._trials.items():
+            if tn != tname:
+                continue
+            ft.refresh()
+            held += sum(1 for d in ft._dynamic_trials
+                        if d["state"] == JOB_STATE_RUNNING)
+        reg = _metrics.registry()
+        reg.gauge(f"netstore.tenant.{tname}.claims_held").set(held)
+        if held >= limit:
+            reg.counter(
+                f"netstore.tenant.{tname}.quota.claims_rejected").inc()
+            return True
+        return False
+
+    def _dispatch_verb(self, verb: str, req: dict, tenant=None,
+                       idem=None) -> dict:
         if verb == "metrics":
             # Same payload as GET /metrics so RPC clients
             # (NetTrials.metrics) don't need a second transport.
             return {"metrics": self.metrics_payload()}
         with self._lock:
-            ft = self._store(req.get("exp_key", "default"))
+            ft = self._store(req.get("exp_key", "default"), tenant=tenant)
             if verb == "docs":
+                export = getattr(ft, "export_docs", None)
+                if export is not None:
+                    return {"docs": export()}
                 ft.refresh()
                 return {"docs": ft._dynamic_trials}
             if verb == "insert_docs":
+                self._charge_admission(tenant, len(req["docs"]))
                 return {"tids": ft._insert_trial_docs(req["docs"])}
             if verb == "new_trial_ids":
                 ft.refresh()
                 return {"tids": ft.new_trial_ids(int(req["n"]))}
             if verb == "reserve":
+                if self._claims_quota_hit(tenant):
+                    return {"doc": None, "quota": "max_claims"}
                 return {"doc": ft.reserve(req["owner"])}
+            if verb == "suggest":
+                return self._suggest_verb(ft, req, tenant)
             if verb == "heartbeat":
                 # Piggybacked fleet metrics: a worker may attach its
                 # cumulative registry snapshot (last-write-wins per
@@ -399,19 +525,13 @@ class StoreServer:
                 ft.delete_all()
                 return {"ok": True}
             if verb == "put_domain":
-                path = os.path.join(ft._exp_dir, "domain.pkl")
-                tmp = f"{path}.tmp.{os.getpid()}"
-                with open(tmp, "wb") as f:
-                    f.write(base64.b64decode(req["blob"]))
-                os.replace(tmp, path)
+                ft.put_domain_blob(base64.b64decode(req["blob"]))
                 return {"ok": True}
             if verb == "get_domain":
-                path = os.path.join(ft._exp_dir, "domain.pkl")
-                try:
-                    with open(path, "rb") as f:
-                        return {"blob": base64.b64encode(f.read()).decode()}
-                except FileNotFoundError:
+                blob = ft.get_domain_blob()
+                if blob is None:
                     return {"blob": None}
+                return {"blob": base64.b64encode(blob).decode()}
             if verb == "att_set":
                 ft.attachments[req["key"]] = pickle.loads(
                     base64.b64decode(req["blob"]))
@@ -433,6 +553,118 @@ class StoreServer:
                 return {"keys": list(ft.attachments)}
             raise ValueError(f"unknown verb {verb!r}")
 
+    # -- server-side suggest -------------------------------------------------
+
+    #: Keyword arguments a suggest request may forward to the algorithm.
+    #: A whitelist, not **kw passthrough: the wire is untrusted relative
+    #: to the algorithm signatures, and an unknown key should 500 here
+    #: with a clear message rather than TypeError deep inside TPE.
+    _SUGGEST_KW = frozenset({
+        "prior_weight", "n_startup_jobs", "n_EI_candidates", "gamma",
+        "linear_forgetting", "split", "multivariate", "startup",
+        "cat_prior"})
+
+    _ALGOS = None
+
+    @classmethod
+    def _server_algos(cls):
+        """Lazy algorithm table (imports tpe/rand/etc. on first suggest,
+        keeping plain-store servers free of the JAX import).
+
+        The TPE entry is dispatch + immediate materialize — by
+        construction the same computation as client-side ``tpe.suggest``
+        (which IS ``suggest_dispatch`` + force, tpe.py), so server and
+        client proposals are bit-identical for the same (history, seed).
+        """
+        if cls._ALGOS is None:
+            from .. import anneal, qmc, rand, tpe
+
+            def _tpe(new_ids, domain, trials, seed, **kw):
+                handle = tpe.suggest_dispatch(new_ids, domain, trials,
+                                              seed, verbose=False, **kw)
+                return tpe.suggest_materialize(handle)
+
+            def _tpe_quantile(new_ids, domain, trials, seed, **kw):
+                kw.setdefault("split", "quantile")
+                return _tpe(new_ids, domain, trials, seed, **kw)
+
+            cls._ALGOS = {
+                "tpe": _tpe,
+                "tpe_quantile": _tpe_quantile,
+                "rand": rand.suggest,
+                "random": rand.suggest,
+                "qmc": qmc.suggest,
+                "halton": qmc.suggest_halton,
+                "anneal": anneal.suggest,
+            }
+        return cls._ALGOS
+
+    @staticmethod
+    def _domain_for(ft):
+        """Unpickle the store's published domain, cached on the store by
+        (len, crc32) of the blob so repeated suggests don't re-unpickle —
+        but a re-published domain (new blob) invalidates naturally."""
+        blob = ft.get_domain_blob()
+        if blob is None:
+            raise FileNotFoundError(
+                "suggest: no domain published for "
+                f"exp_key={ft._exp_key!r} (driver must save_domain first)")
+        sig = (len(blob), zlib.crc32(blob))
+        cached = getattr(ft, "_srv_domain", None)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        domain = pickle.loads(blob)
+        ft._srv_domain = (sig, domain)
+        return domain
+
+    def _suggest_verb(self, ft, req: dict, tenant=None) -> dict:
+        """Server-side proposal: run the algorithm against the server's
+        own store (which feeds the device-resident history ring exactly
+        like a client-side Trials would) and optionally insert the docs.
+
+        Thin-client protocol: the driver only needs ``suggest`` (with
+        insert), ``docs`` and the result verbs — no JAX client-side.
+        """
+        algo_name = req.get("algo", "tpe")
+        algo = self._server_algos().get(algo_name)
+        if algo is None:
+            raise ValueError(
+                f"suggest: unknown algo {algo_name!r} "
+                f"(have {sorted(self._server_algos())})")
+        if "seed" not in req:
+            raise ValueError("suggest: 'seed' is required — the server "
+                             "must not invent entropy the driver cannot "
+                             "reproduce")
+        kw = {k: req[k] for k in self._SUGGEST_KW if k in req}
+        bad = set(req) - self._SUGGEST_KW - {
+            "verb", "exp_key", "algo", "seed", "n", "new_ids", "insert"}
+        if bad:
+            raise ValueError(f"suggest: unknown argument(s) {sorted(bad)}")
+        new_ids = req.get("new_ids")
+        if new_ids is None:
+            # Server-allocated ids default to inserting (the enqueue
+            # form); explicit ids default to proposal-only (the driver
+            # owns the insert, e.g. fmin's algo adapter).
+            insert = bool(req.get("insert", True))
+            ft.refresh()
+            new_ids = ft.new_trial_ids(int(req.get("n", 1)))
+        else:
+            insert = bool(req.get("insert", False))
+            new_ids = [int(t) for t in new_ids]
+        if insert:
+            self._charge_admission(tenant, len(new_ids))
+        domain = self._domain_for(ft)
+        ft.refresh()
+        docs = algo(new_ids, domain, ft, int(req["seed"]), **kw)
+        # JSON roundtrip now, inside the lock: the reply the client sees
+        # is exactly what a WAL replay would re-insert, and the docs the
+        # server stores are plain JSON types like every other doc.
+        docs = json.loads(json.dumps(docs))
+        tids = list(new_ids)
+        if insert and docs:
+            tids = ft._insert_trial_docs(docs)
+        return {"docs": docs, "tids": tids, "inserted": bool(insert)}
+
 
 # ---------------------------------------------------------------------------
 # client
@@ -442,7 +674,7 @@ class StoreServer:
 #: Verbs that change server state: each call carries a fresh idempotency
 #: key, reused verbatim across retries so the server executes it once.
 _MUTATING_VERBS = frozenset(
-    {"new_trial_ids", "insert_docs", "reserve", "write_result"})
+    {"new_trial_ids", "insert_docs", "reserve", "write_result", "suggest"})
 
 _BACKOFF_CAP_S = 2.0
 
@@ -537,6 +769,11 @@ class _Rpc:
         _metrics.registry().histogram("netstore.client.rpc.s").observe(
             time.perf_counter() - t_start)
         if "error" in out:
+            if out["error"].startswith("QuotaExceeded"):
+                # Typed so drivers can back off deliberately; NOT in
+                # TRANSIENT_ERRORS — blind retry of a rate refusal is
+                # exactly the traffic the quota exists to shed.
+                raise QuotaExceeded(f"netstore server: {out['error']}")
             raise RuntimeError(f"netstore server: {out['error']}")
         return out
 
@@ -653,6 +890,32 @@ class NetTrials(Trials):
         """Server-side metrics registry snapshot (``GET /metrics`` twin)."""
         return self._rpc("metrics")["metrics"]
 
+    # -- server-side suggest -------------------------------------------------
+
+    def suggest(self, seed: int, n: int | None = None, new_ids=None,
+                algo: str = "tpe", insert: bool | None = None, **kw):
+        """Ask the SERVER to propose trials (thin-client protocol).
+
+        The server runs the algorithm against its own store — for TPE,
+        ``suggest_dispatch`` + materialize over the device-resident
+        history ring, bit-identical to client-side ``tpe.suggest`` for
+        the same (history, seed).  Two forms:
+
+        * ``suggest(seed, n=8)`` — server allocates ids and INSERTS the
+          proposals (one RPC enqueues a whole batch); returns the docs.
+        * ``suggest(seed, new_ids=[...], insert=False)`` — proposal
+          only, driver owns the insert (what :func:`server_suggest`
+          uses to slot into ``fmin`` as an algo).
+        """
+        req = dict(seed=int(seed), algo=algo, **kw)
+        if new_ids is not None:
+            req["new_ids"] = [int(t) for t in new_ids]
+        elif n is not None:
+            req["n"] = int(n)
+        if insert is not None:
+            req["insert"] = bool(insert)
+        return self._rpc("suggest", **req)["docs"]
+
     # -- domain shipping -----------------------------------------------------
 
     def save_domain(self, domain) -> None:
@@ -676,6 +939,22 @@ class NetTrials(Trials):
             logger.warning("objective not picklable (%s); workers must be "
                            "given the domain explicitly", e)
         return super().fmin(fn, space, algo, max_evals, **kwargs)
+
+
+def server_suggest(new_ids, domain, trials, seed, algo: str = "tpe", **kw):
+    """``fmin``-shaped algo that delegates the proposal to the server.
+
+    Drop-in for ``algo=`` against a :class:`NetTrials`: the domain
+    argument is ignored (the server uses the blob the driver published
+    via ``save_domain``), ids and seed flow through unchanged, and the
+    returned docs are exactly what the server computed — so a pinned
+    seeded run matches client-side ``tpe.suggest`` document-for-document.
+    """
+    if not isinstance(trials, NetTrials):
+        raise TypeError("server_suggest needs a NetTrials "
+                        f"(got {type(trials).__name__})")
+    return trials.suggest(seed, new_ids=new_ids, algo=algo, insert=False,
+                          **kw)
 
 
 class NetWorker(FileWorker):

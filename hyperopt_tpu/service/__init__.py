@@ -1,0 +1,34 @@
+"""Suggestion-as-a-service: multi-tenant, WAL-durable netstore with
+server-side TPE.
+
+Layers (each usable alone):
+
+* :mod:`.tenancy` — per-tenant tokens (timing-safe resolution) + quotas
+  (concurrent claims, trials/s admission);
+* :mod:`.store` — :class:`MemTrials`, the RAM store with the filestore's
+  claim/heartbeat/requeue verb semantics and a deterministic-replay
+  clock;
+* :mod:`.wal` — write-ahead log + snapshot/compaction + offline
+  ``inspect`` (the ``hyperopt-tpu-show wal`` backend);
+* :mod:`.server` — :class:`ServiceServer`, the StoreServer subclass
+  wiring the three together (append-before-execute, crash recovery,
+  server-side ``suggest`` decomposed into physical records).
+"""
+
+from .store import MemTrials
+from .tenancy import Tenant, TenantTable, TokenBucket
+from .wal import Wal, inspect, read_wal
+
+__all__ = [
+    "MemTrials", "ServiceServer", "Tenant", "TenantTable", "TokenBucket",
+    "Wal", "inspect", "read_wal",
+]
+
+
+def __getattr__(name):
+    # ServiceServer lazily: importing .server pulls in the netstore (and
+    # through suggest, potentially JAX) — tenancy/wal users shouldn't pay.
+    if name == "ServiceServer":
+        from .server import ServiceServer
+        return ServiceServer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,373 @@
+"""Suggestion-as-a-service: the WAL-backed, multi-tenant store server.
+
+:class:`ServiceServer` is the netstore's :class:`~..parallel.netstore.
+StoreServer` with three substitutions (everything else — transport,
+auth, idempotency, fleet metrics, the janitor — is inherited):
+
+* **stores are RAM** — each (tenant, exp_key) pair owns a
+  :class:`~.store.MemTrials`; a verb is a dict operation, not a JSON
+  file rewrite;
+* **durability is the WAL** — every mutating verb is appended to
+  ``wal.jsonl`` *before* it executes, under the dispatch lock, carrying
+  the second-resolution clock the verb then runs with
+  (``MemTrials.now_override``).  Recovery = load snapshot + re-execute
+  the tail records with their logged clocks → a byte-identical store
+  (:meth:`state_bytes`), including claim tables and requeue decisions;
+* **suggest is decomposed** — server-side ``suggest`` with insert is
+  logged as its *physical outcome* (a ``new_trial_ids`` allocation
+  record plus an ``insert_docs`` record holding the proposed docs
+  verbatim), never as "re-run TPE": replay must not depend on an
+  accelerator, and the docs are the already-decided result.
+
+Quota checks run BEFORE the WAL append: a refused verb leaves no trace
+in durable state, so replay never needs tenant quota context (it gets
+the tenant as a plain name string, whose duck-typed quota hooks are
+absent).
+
+The idempotency key of the original client call rides in each record;
+replay repopulates the exactly-once reply cache so a client retry that
+straddles a server crash still dedupes instead of double-executing.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+
+from .. import faults as _faults
+from ..base import JOB_STATE_RUNNING, coarse_utcnow
+from ..obs import metrics as _metrics
+from ..obs.events import EVENTS
+from ..parallel.netstore import StoreServer
+from .store import MemTrials
+from .wal import Wal, read_wal
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["ServiceServer", "main"]
+
+
+def _strip_req(req: dict) -> dict:
+    """The request as logged: drop the verb echo and the heartbeat's
+    piggybacked fleet-metrics payload (ephemeral, and enormous) — replay
+    only needs what changes store state."""
+    return {k: v for k, v in req.items()
+            if k not in ("verb", "metrics", "worker")}
+
+
+class ServiceServer(StoreServer):
+    """Multi-tenant, WAL-durable suggestion service.
+
+    ``wal_dir`` holds ``wal.jsonl`` + ``snapshot.json`` and is the only
+    thing that must survive a crash: a new ServiceServer pointed at the
+    same directory replays to the exact pre-crash store.
+    """
+
+    #: Verbs whose execution changes store state → append-before-execute.
+    #: Reads (docs, get_domain, att_get/att_keys, metrics) bypass the log.
+    _WAL_VERBS = frozenset({
+        "insert_docs", "new_trial_ids", "reserve", "heartbeat",
+        "write_result", "requeue_stale", "delete_all", "put_domain",
+        "att_set", "att_del", "suggest"})
+
+    def __init__(self, wal_dir: str, host: str = "127.0.0.1", port: int = 0,
+                 token: str | None = None, tenants=None,
+                 fsync: str = "always", snapshot_every: int | None = None,
+                 requeue_stale_every: float | None = None,
+                 stale_timeout: float = 60.0):
+        self.wal_root = os.path.abspath(wal_dir)
+        self._replaying = False
+        self._wal = Wal(self.wal_root, fsync=fsync)
+        self._snapshot_every = snapshot_every
+        self._snap_seq = 0
+        super().__init__(self.wal_root, host=host, port=port, token=token,
+                         requeue_stale_every=requeue_stale_every,
+                         stale_timeout=stale_timeout, tenants=tenants)
+        self._recover()
+
+    # -- stores are RAM ------------------------------------------------------
+
+    def _store(self, exp_key: str, tenant=None) -> MemTrials:
+        tname = getattr(tenant, "name", tenant)
+        key = (tname, exp_key)
+        ft = self._trials.get(key)
+        if ft is None:
+            ft = self._trials[key] = MemTrials(exp_key=exp_key)
+        return ft
+
+    # -- append-before-execute dispatch --------------------------------------
+
+    def _dispatch_verb(self, verb: str, req: dict, tenant=None,
+                       idem=None) -> dict:
+        if self._replaying or verb not in self._WAL_VERBS:
+            return super()._dispatch_verb(verb, req, tenant=tenant,
+                                          idem=idem)
+        tname = getattr(tenant, "name", tenant)
+        exp_key = req.get("exp_key", "default")
+        with self._lock:
+            t = coarse_utcnow()
+            if verb == "suggest":
+                return self._suggest_walled(req, tenant, tname, exp_key,
+                                            idem, t)
+            # Quota gates mirror the base dispatch but run BEFORE the
+            # append — a refused verb must leave no durable trace.
+            if verb == "insert_docs":
+                self._charge_admission(tenant, len(req["docs"]))
+            if verb == "reserve" and self._claims_quota_hit(tenant):
+                return {"doc": None, "quota": "max_claims"}
+            self._wal.append({"t": t, "verb": verb, "tenant": tname,
+                              "exp_key": exp_key, "req": _strip_req(req),
+                              "idem": idem})
+            out = self._execute(verb, req, tenant, t)
+            self._maybe_snapshot()
+            return out
+
+    def _execute(self, verb: str, req: dict, tenant, t: float) -> dict:
+        """Run the verb with the WAL record's clock.  The tenant is
+        passed down as its bare NAME: the store key resolves identically,
+        and the duck-typed quota hooks (absent on a string) are skipped —
+        quotas were already charged before the append, and replay has no
+        quota context by design."""
+        tname = getattr(tenant, "name", tenant)
+        ft = self._store(req.get("exp_key", "default"), tenant=tname)
+        ft.now_override = t
+        try:
+            return super()._dispatch_verb(verb, req, tenant=tname)
+        finally:
+            ft.now_override = None
+
+    def _suggest_walled(self, req: dict, tenant, tname, exp_key,
+                        idem, t: float) -> dict:
+        """Server-side suggest, decomposed into physical records.
+
+        The id allocation (when the server picks the ids) and the insert
+        (when requested) each get their own WAL record; the TPE/algo
+        computation itself is NOT logged — its outcome (the docs) is.
+        The insert record carries the client call's idempotency key plus
+        an ``orig: suggest`` marker so replay can reconstruct the
+        original reply for the dedup cache.
+        """
+        req = dict(req)
+        new_ids = req.get("new_ids")
+        if new_ids is None:
+            insert = bool(req.get("insert", True))
+            alloc = {"exp_key": exp_key, "n": int(req.get("n", 1))}
+            self._wal.append({"t": t, "verb": "new_trial_ids",
+                              "tenant": tname, "exp_key": exp_key,
+                              "req": alloc, "idem": None})
+            new_ids = self._execute("new_trial_ids", alloc, tenant,
+                                    t)["tids"]
+            req["new_ids"] = new_ids
+        else:
+            insert = bool(req.get("insert", False))
+            new_ids = [int(x) for x in new_ids]
+        req["insert"] = False
+        out = self._execute("suggest", req, tenant, t)   # pure compute
+        docs, tids = out["docs"], list(new_ids)
+        if insert and docs:
+            self._charge_admission(tenant, len(docs))
+            ins = {"exp_key": exp_key, "docs": docs}
+            self._wal.append({"t": t, "verb": "insert_docs",
+                              "tenant": tname, "exp_key": exp_key,
+                              "req": ins, "idem": idem,
+                              "orig": "suggest"})
+            tids = self._execute("insert_docs", ins, tenant, t)["tids"]
+        self._maybe_snapshot()
+        return {"docs": docs, "tids": tids, "inserted": bool(insert)}
+
+    # -- janitor through the log ---------------------------------------------
+
+    def _janitor_pass(self):
+        """Requeue stale claims *through the WAL dispatch* so replay
+        reproduces the janitor's decisions (a peek avoids logging no-op
+        passes every period)."""
+        with self._lock:
+            for (tname, exp_key), ft in list(self._trials.items()):
+                now = coarse_utcnow()
+                stale = any(
+                    d["state"] == JOB_STATE_RUNNING
+                    and now - (d.get("refresh_time")
+                               or d.get("book_time") or 0)
+                    > self.stale_timeout
+                    for d in ft._by_tid.values())
+                if not stale:
+                    continue
+                out = self._dispatch_verb(
+                    "requeue_stale",
+                    {"exp_key": exp_key, "timeout": self.stale_timeout},
+                    tenant=tname)
+                if out["n"]:
+                    logger.info("service janitor: requeued %d stale "
+                                "trial(s) in %s/%r", out["n"],
+                                tname or "-", exp_key)
+
+    # -- snapshot / recovery -------------------------------------------------
+
+    def state_payload(self) -> dict:
+        """Everything a snapshot persists: each store's canonical state
+        plus the idempotency reply cache (keys + payloads; ages restart
+        fresh on load — a crash must not shorten a retry's dedup
+        window)."""
+        with self._lock:
+            stores = []
+            for key in sorted(self._trials,
+                              key=lambda k: (k[0] or "", k[1])):
+                tname, exp_key = key
+                state = self._trials[key].state_dict()
+                if not (state["docs"] or state["allocated"]
+                        or state["claims"] or state["domain_blob"]
+                        or state["attachments"]):
+                    # A store only ever touched by reads: semantically
+                    # absent — replay of the (write-only) log would not
+                    # recreate it, and it must not break byte-identity.
+                    continue
+                stores.append({"tenant": tname, "exp_key": exp_key,
+                               "state": state})
+            with self._idem_lock:
+                idem = [[list(k), payload]
+                        for k, (_, payload) in self._idem.items()]
+            return {"stores": stores, "idem": idem}
+
+    def state_bytes(self) -> bytes:
+        """Canonical bytes of all store state (NOT the idem cache, whose
+        eviction clock is wall-time-dependent): two servers are
+        byte-identical iff these are equal — the replay acceptance bar.
+        """
+        payload = {"stores": self.state_payload()["stores"]}
+        return json.dumps(payload, sort_keys=True).encode()
+
+    def snapshot(self) -> None:
+        """Persist current state and truncate the log (compaction)."""
+        with self._lock:
+            self._wal.snapshot(self.state_payload())
+            self._snap_seq = self._wal.seq
+
+    def _maybe_snapshot(self) -> None:
+        if (self._snapshot_every
+                and self._wal.seq - self._snap_seq >= self._snapshot_every):
+            self.snapshot()
+
+    def _recover(self) -> None:
+        snap, records, n_torn = read_wal(self.wal_root)
+        if snap is None and not records:
+            return
+        reg = _metrics.registry()
+        if snap is not None:
+            for s in snap.get("stores", []):
+                ft = self._store(s["exp_key"], tenant=s.get("tenant"))
+                ft.load_state(s["state"])
+            with self._idem_lock:
+                for k, payload in snap.get("idem", []):
+                    self._idem[tuple(k)] = (time.monotonic(), payload)
+            self._wal.seq = snap["seq"]
+        self._replaying = True
+        try:
+            for rec in records:
+                _faults.maybe_fail("wal.replay", verb=rec["verb"])
+                tname = rec.get("tenant")
+                req = dict(rec["req"], exp_key=rec["exp_key"])
+                ft = self._store(rec["exp_key"], tenant=tname)
+                ft.now_override = rec["t"]
+                try:
+                    out = self._dispatch_verb(rec["verb"], req,
+                                              tenant=tname)
+                finally:
+                    ft.now_override = None
+                self._wal.seq = rec["seq"]
+                reg.counter("wal.replayed").inc()
+                if rec.get("idem"):
+                    if rec.get("orig") == "suggest":
+                        # Reconstruct the client-visible suggest reply
+                        # from the physical insert record.
+                        out = {"docs": rec["req"]["docs"],
+                               "tids": out["tids"], "inserted": True}
+                    self._idem_put((tname, rec["exp_key"], rec["idem"]),
+                                   json.dumps(out))
+        finally:
+            self._replaying = False
+        self._snap_seq = self._wal.seq if snap is None else snap["seq"]
+        logger.info("service: recovered %d store(s), replayed %d "
+                    "record(s), %d torn tail line(s) dropped",
+                    len(self._trials), len(records), n_torn)
+        EVENTS.emit("wal_recover", replayed=len(records), torn=n_torn)
+
+    def shutdown(self):
+        super().shutdown()
+        self._wal.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None):
+    """``python -m hyperopt_tpu.service.server --serve --wal-dir DIR``:
+    host a WAL-durable multi-tenant suggestion service (recovers from
+    DIR on start; SIGTERM-graceful like the plain netstore)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="hyperopt_tpu suggestion service (WAL-durable, "
+                    "multi-tenant netstore)")
+    p.add_argument("--serve", action="store_true", required=True,
+                   help="serve --wal-dir on --host:--port")
+    p.add_argument("--wal-dir", required=True,
+                   help="durability directory (wal.jsonl + snapshot.json); "
+                        "the only state that must survive a crash")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8418)
+    p.add_argument("--token", default=None,
+                   help="single shared secret (ignored when "
+                        "--tenants-file is given)")
+    p.add_argument("--tenants-file", default=None,
+                   help="JSON tenant table: [{name, token, max_claims, "
+                        "trials_per_s, burst}, ...] — enables "
+                        "multi-tenant auth + quotas")
+    p.add_argument("--fsync", default="always",
+                   choices=("always", "batch", "never"),
+                   help="WAL durability/throughput knob (DESIGN.md §7)")
+    p.add_argument("--snapshot-every", type=int, default=None, metavar="N",
+                   help="compact the WAL into a snapshot every N appends "
+                        "(default: only on demand)")
+    p.add_argument("--requeue-stale-every", type=float, default=None,
+                   metavar="S")
+    p.add_argument("--stale-timeout", type=float, default=60.0)
+    args = p.parse_args(argv)
+
+    tenants = None
+    if args.tenants_file:
+        from .tenancy import TenantTable
+        tenants = TenantTable.from_file(args.tenants_file)
+
+    server = ServiceServer(args.wal_dir, host=args.host, port=args.port,
+                           token=args.token, tenants=tenants,
+                           fsync=args.fsync,
+                           snapshot_every=args.snapshot_every,
+                           requeue_stale_every=args.requeue_stale_every,
+                           stale_timeout=args.stale_timeout)
+    print(f"service: serving {args.wal_dir} at {server.url}", flush=True)
+
+    import signal
+
+    def _on_sigterm(signo, frame):
+        raise SystemExit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:              # not the main thread (embedded use)
+        pass
+    try:
+        server.serve_forever()
+    except (KeyboardInterrupt, SystemExit):
+        pass
+    finally:
+        server.shutdown()
+        print("service: shut down", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

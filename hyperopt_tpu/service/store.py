@@ -1,0 +1,261 @@
+"""In-memory trial store with the FileTrials verb surface.
+
+The suggestion service keeps every tenant's trials in RAM — a verb is a
+dict operation instead of a JSON-file rewrite — and gets durability from
+the write-ahead log (:mod:`hyperopt_tpu.service.wal`) instead of from
+per-document disk writes.  For replay to reconstruct a byte-identical
+store, every time-dependent mutation reads the clock through
+:meth:`MemTrials._now`, which the server overrides with the timestamp it
+logged in the WAL record — live execution and replay therefore see the
+exact same clock.
+
+Semantics mirror :class:`~hyperopt_tpu.parallel.filestore.FileTrials`
+verb by verb (reserve claim commit, heartbeat as a stamp-refresh-only
+liveness signal, owner fencing on write, stale requeue) minus the
+orphan-claim shape: in memory the claim and the RUNNING flip commit
+atomically under one lock, so a claim can never outlive its doc state.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import List, Optional
+
+from ..base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    Trials,
+    coarse_utcnow,
+)
+from ..exceptions import InvalidTrial
+from ..obs import metrics as _metrics
+from ..obs.events import EVENTS
+
+__all__ = ["MemTrials"]
+
+
+class MemTrials(Trials):
+    """Server-resident ``Trials`` with the claim/heartbeat/requeue verbs.
+
+    ``asynchronous = True``: like the file and network stores, this is a
+    queue that external workers drain — ``fmin`` against it only
+    enqueues.  One instance per (tenant, exp_key) lives inside the
+    service server; the server's dispatch lock serializes all access.
+    """
+
+    asynchronous = True
+
+    def __init__(self, exp_key: str = "default", refresh=True):
+        # Claim table: tid -> owner (the .claim files of the filestore).
+        self._claims: dict = {}
+        # tids handed out by new_trial_ids but possibly not yet inserted
+        # (the filestore's exclusive-create marker files).
+        self._allocated: set = set()
+        self._by_tid: dict = {}
+        self._domain_blob: bytes | None = None
+        # Deterministic-replay clock: when set, _now() returns this value
+        # instead of the wall clock.  The service server points it at the
+        # WAL record's logged timestamp around every mutating verb.
+        self.now_override: float | None = None
+        super().__init__(exp_key=exp_key, refresh=refresh)
+
+    def _now(self) -> float:
+        return (self.now_override if self.now_override is not None
+                else coarse_utcnow())
+
+    # -- document IO ---------------------------------------------------------
+
+    def _insert_trial_docs(self, docs) -> List[int]:
+        # Duplicate guard lives HERE (not only in the validated public
+        # wrapper): the netstore dispatch inserts through this hook, and
+        # appending a duplicate tid would corrupt the in-memory list where
+        # the filestore would merely rewrite the same file.
+        for d in docs:
+            if d["tid"] in self._by_tid:
+                raise InvalidTrial(f"duplicate tid {d['tid']}")
+        for d in docs:
+            self._by_tid[d["tid"]] = d
+            self._allocated.add(d["tid"])
+            self._ids.add(d["tid"])
+        self._dynamic_trials = sorted(self._by_tid.values(),
+                                      key=lambda d: d["tid"])
+        return [d["tid"] for d in docs]
+
+    def refresh(self):
+        with self._lock:
+            self._dynamic_trials = sorted(self._by_tid.values(),
+                                          key=lambda d: d["tid"])
+            super().refresh()
+
+    def export_docs(self) -> list:
+        """Reply-safe snapshot: per-doc shallow copies, so the server can
+        serialize the reply outside the store lock while later verbs
+        mutate top-level keys of the live docs."""
+        self.refresh()
+        return [dict(d) for d in self._dynamic_trials]
+
+    def new_trial_ids(self, n):
+        with self._lock:
+            base = max([max(self._allocated, default=-1),
+                        max(self._ids, default=-1)]) + 1
+            out = list(range(base, base + n))
+            self._allocated.update(out)
+            return out
+
+    def delete_all(self):
+        with self._lock:
+            self._claims = {}
+            self._allocated = set()
+            self._by_tid = {}
+            self._domain_blob = None
+            super().delete_all()
+
+    # -- domain shipping -----------------------------------------------------
+
+    def put_domain_blob(self, blob: bytes) -> None:
+        self._domain_blob = bytes(blob)
+
+    def get_domain_blob(self) -> Optional[bytes]:
+        return self._domain_blob
+
+    def save_domain(self, domain) -> None:
+        from ..parallel.filestore import _pickler
+        self.put_domain_blob(_pickler.dumps(domain))
+
+    def load_domain(self):
+        import pickle
+        if self._domain_blob is None:
+            raise FileNotFoundError("no domain published for "
+                                    f"exp_key={self._exp_key!r}")
+        return pickle.loads(self._domain_blob)
+
+    # -- reservation / claim lifecycle --------------------------------------
+
+    def reserve(self, owner: str) -> Optional[dict]:
+        """Claim the first NEW trial for ``owner`` (claim + RUNNING flip
+        commit atomically under the lock); None when the queue is empty."""
+        with self._lock:
+            self.refresh()
+            for doc in self._trials:
+                if doc["state"] != JOB_STATE_NEW:
+                    continue
+                if doc["tid"] in self._claims:
+                    _metrics.registry().counter(
+                        "store.claim.contended").inc()
+                    continue
+                self._claims[doc["tid"]] = owner
+                doc["state"] = JOB_STATE_RUNNING
+                doc["owner"] = owner
+                doc["book_time"] = self._now()
+                doc["refresh_time"] = doc["book_time"]
+                _metrics.registry().counter("store.claim.won").inc()
+                EVENTS.emit("store_claim", trial=doc["tid"], owner=owner)
+                return dict(doc)
+            return None
+
+    def owns(self, doc, owner: str) -> bool:
+        return self._claims.get(doc["tid"]) == owner
+
+    def heartbeat(self, doc, owner: Optional[str] = None) -> bool:
+        """Liveness stamp only: re-read the stored doc and rewrite just
+        ``refresh_time`` (the filestore's lost-update fix, verbatim)."""
+        with self._lock:
+            if owner is not None and not self.owns(doc, owner):
+                _metrics.registry().counter("store.heartbeat.fenced").inc()
+                EVENTS.emit("store_heartbeat", trial=doc["tid"],
+                            owner=owner, ok=False)
+                return False
+            cur = self._by_tid.get(doc["tid"])
+            if cur is None:
+                return False
+            if cur["state"] != JOB_STATE_RUNNING:
+                return cur["state"] in (JOB_STATE_DONE, JOB_STATE_ERROR)
+            cur["refresh_time"] = self._now()
+            doc["refresh_time"] = cur["refresh_time"]
+            return True
+
+    def write_result(self, doc, owner: Optional[str] = None) -> bool:
+        with self._lock:
+            if owner is not None and not self.owns(doc, owner):
+                _metrics.registry().counter("store.write.fenced").inc()
+                return False
+            stored = dict(doc)
+            stored["refresh_time"] = self._now()
+            self._by_tid[stored["tid"]] = stored
+            self._ids.add(stored["tid"])
+            self._allocated.add(stored["tid"])
+        _metrics.registry().counter("store.write.ok").inc()
+        EVENTS.emit("store_write", trial=stored["tid"],
+                    state=stored.get("state"))
+        return True
+
+    def requeue_stale(self, timeout: float) -> int:
+        """Requeue RUNNING trials whose heartbeat went silent (the only
+        stale shape in memory — orphan claims cannot exist here)."""
+        n = 0
+        with self._lock:
+            now = self._now()
+            for doc in self._by_tid.values():
+                if doc["state"] != JOB_STATE_RUNNING:
+                    continue
+                last = doc.get("refresh_time") or doc.get("book_time") or 0
+                if now - last > timeout:
+                    owner = doc.get("owner")
+                    self._claims.pop(doc["tid"], None)
+                    doc["state"] = JOB_STATE_NEW
+                    doc["owner"] = None
+                    n += 1
+                    EVENTS.emit("store_requeue", trial=doc["tid"],
+                                owner=owner, reason="stale_heartbeat")
+            if n:
+                _metrics.registry().counter("store.requeued").inc(n)
+                self.refresh()
+        return n
+
+    # -- durable state (snapshot / byte-identity) ----------------------------
+
+    def state_dict(self) -> dict:
+        """Canonical JSON-serializable state: everything replay must
+        reconstruct.  Deterministically ordered so two stores are equal
+        iff their ``json.dumps(..., sort_keys=True)`` bytes are equal."""
+        with self._lock:
+            return {
+                "exp_key": self._exp_key,
+                "docs": sorted((dict(d) for d in self._by_tid.values()),
+                               key=lambda d: d["tid"]),
+                "claims": {str(t): o
+                           for t, o in sorted(self._claims.items())},
+                "allocated": sorted(self._allocated),
+                "domain_blob": (None if self._domain_blob is None else
+                                base64.b64encode(
+                                    self._domain_blob).decode()),
+                "attachments": {
+                    str(k): base64.b64encode(self._att_blob(k)).decode()
+                    for k in sorted(self.attachments, key=str)},
+            }
+
+    def state_bytes(self) -> bytes:
+        return json.dumps(self.state_dict(), sort_keys=True).encode()
+
+    def _att_blob(self, key) -> bytes:
+        from ..parallel.filestore import _pickler
+        return _pickler.dumps(self.attachments[key])
+
+    def load_state(self, state: dict) -> None:
+        import pickle
+        with self._lock:
+            self._by_tid = {d["tid"]: dict(d) for d in state["docs"]}
+            self._claims = {int(t): o
+                            for t, o in state.get("claims", {}).items()}
+            self._allocated = set(state.get("allocated", []))
+            self._ids = set(self._by_tid)
+            blob = state.get("domain_blob")
+            self._domain_blob = (None if blob is None
+                                 else base64.b64decode(blob))
+            self.attachments = {
+                k: pickle.loads(base64.b64decode(b))
+                for k, b in state.get("attachments", {}).items()}
+            self.refresh()

@@ -1,0 +1,208 @@
+"""Write-ahead log + snapshot/compaction for the suggestion service.
+
+Durability model — *logical* WAL, append-before-execute:
+
+* Every mutating verb is serialized to one JSON line in ``wal.jsonl``
+  **before** it executes, under the same lock that executes it, so the
+  log order IS the execution order.
+* Each record carries the second-resolution timestamp ``t`` the server
+  then uses as the verb's clock (``MemTrials.now_override``) — replay
+  re-executes the verb with the logged clock and reconstructs the store
+  **byte-identically** (``MemTrials.state_bytes``), including claim
+  tables and requeue decisions.
+* Server-side ``suggest`` with insert is rewritten to a *physical*
+  ``insert_docs`` record (the proposed docs, verbatim): replay must
+  never re-run TPE — the docs are already the decided outcome, and a
+  recovery should not depend on an accelerator being attached.
+* The idempotency key of the original client call rides in the record,
+  so replay also repopulates the exactly-once reply cache: a client
+  retry that straddles a server crash still dedupes instead of
+  double-executing.
+
+Crash safety: a record is a single ``write`` of one line; a crash mid-
+append leaves at most one torn final line, which replay detects, counts
+(``wal.torn_tail``) and drops — the verb it described was never acked.
+
+Fsync policy (the throughput knob, DESIGN.md §7):
+
+* ``always``  — fsync per append: an acked verb survives SIGKILL *and*
+  power loss.  The durability bar; the default.
+* ``batch``   — fsync every ``batch_every`` appends: survives process
+  death (the OS has the bytes) but a machine crash can lose the tail.
+* ``never``   — leave flushing to the OS; benchmark mode.
+
+Snapshot + compaction: ``snapshot()`` atomically writes the full server
+state (every store's ``state_dict`` + the idem cache) tagged with the
+last applied ``seq``, then truncates ``wal.jsonl`` — recovery loads the
+snapshot and replays only records with ``seq`` greater than it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+
+from .. import faults as _faults
+from ..exceptions import InjectedFault
+from ..obs import metrics as _metrics
+
+__all__ = ["Wal", "read_wal", "inspect"]
+
+_WAL_FILE = "wal.jsonl"
+_SNAP_FILE = "snapshot.json"
+
+#: When set to ``kill``, an injected ``wal.write`` fault escalates to
+#: SIGKILL of the current process — the chaos harness's way of dying
+#: *exactly* at the append boundary, with no Python teardown running.
+_CRASH_ENV = "HYPEROPT_TPU_WAL_CRASH"
+
+
+class Wal:
+    """Appender half: owns the open ``wal.jsonl`` of one server."""
+
+    def __init__(self, root: str, fsync: str = "always",
+                 batch_every: int = 64):
+        if fsync not in ("always", "batch", "never"):
+            raise ValueError(f"fsync policy {fsync!r}: "
+                             "want always|batch|never")
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.fsync = fsync
+        self.batch_every = max(1, int(batch_every))
+        self.path = os.path.join(self.root, _WAL_FILE)
+        self.snap_path = os.path.join(self.root, _SNAP_FILE)
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._since_sync = 0
+        self.seq = 0                    # last seq handed out; set by recovery
+
+    def append(self, rec: dict) -> int:
+        """Serialize ``rec`` (gets ``seq`` assigned here), write + flush
+        per policy, and return the seq.  Raises before any byte is
+        written when a ``wal.write`` fault fires."""
+        try:
+            _faults.maybe_fail("wal.write", verb=rec.get("verb"))
+        except InjectedFault:
+            if os.environ.get(_CRASH_ENV) == "kill":
+                # Die at the append boundary with zero teardown — the
+                # SIGKILL the chaos suite uses to prove replay.
+                self._fh.flush()
+                os.kill(os.getpid(), signal.SIGKILL)
+            raise
+        self.seq += 1
+        rec = dict(rec, seq=self.seq)
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        self._fh.write(line)
+        self._fh.flush()
+        self._since_sync += 1
+        if self.fsync == "always" or (self.fsync == "batch"
+                                      and self._since_sync
+                                      >= self.batch_every):
+            os.fsync(self._fh.fileno())
+            self._since_sync = 0
+            _metrics.registry().counter("wal.fsyncs").inc()
+        reg = _metrics.registry()
+        reg.counter("wal.appends").inc()
+        reg.counter("wal.bytes").inc(len(line))
+        return self.seq
+
+    def snapshot(self, payload: dict) -> None:
+        """Atomically persist ``payload`` (stamped with the current seq)
+        and truncate the log — records at or below ``seq`` are folded in.
+        """
+        payload = dict(payload, seq=self.seq, t_wall=time.time())
+        tmp = f"{self.snap_path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        # Compaction: everything the snapshot covers leaves the log.
+        self._fh.close()
+        self._fh = open(self.path, "w", encoding="utf-8")
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._since_sync = 0
+        _metrics.registry().counter("wal.snapshots").inc()
+
+    def close(self) -> None:
+        try:
+            self._fh.flush()
+            if self.fsync != "never":
+                os.fsync(self._fh.fileno())
+        except (OSError, ValueError):
+            pass
+        self._fh.close()
+
+
+def read_wal(root: str):
+    """Recovery read: ``(snapshot | None, records, n_torn)``.
+
+    ``records`` are the log lines with ``seq`` greater than the
+    snapshot's (compaction may leave already-folded lines behind if a
+    crash hit between snapshot write and truncate — they are skipped
+    here, which makes the snapshot-then-truncate pair crash-safe in
+    either order).  A torn (truncated) final line is dropped and
+    counted; torn *interior* lines are real corruption and raise.
+    """
+    snap = None
+    snap_path = os.path.join(root, _SNAP_FILE)
+    if os.path.exists(snap_path):
+        with open(snap_path, encoding="utf-8") as f:
+            snap = json.load(f)
+    min_seq = snap["seq"] if snap else 0
+    records, n_torn = [], 0
+    wal_path = os.path.join(root, _WAL_FILE)
+    if os.path.exists(wal_path):
+        with open(wal_path, encoding="utf-8") as f:
+            lines = f.readlines()
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                if i == len(lines) - 1:
+                    n_torn += 1     # crash mid-append: verb never acked
+                    break
+                raise ValueError(
+                    f"{wal_path}: corrupt record at line {i + 1} "
+                    "(not the torn tail)")
+            if rec["seq"] > min_seq:
+                records.append(rec)
+    if n_torn:
+        _metrics.registry().counter("wal.torn_tail").inc(n_torn)
+    return snap, records, n_torn
+
+
+def inspect(root: str) -> dict:
+    """Offline summary of a WAL directory (``hyperopt-tpu-show wal``)."""
+    snap, records, n_torn = read_wal(root)
+    per_verb: dict = {}
+    per_store: dict = {}
+    for r in records:
+        per_verb[r["verb"]] = per_verb.get(r["verb"], 0) + 1
+        key = f"{r.get('tenant') or '-'}/{r.get('exp_key', 'default')}"
+        per_store[key] = per_store.get(key, 0) + 1
+    wal_path = os.path.join(root, _WAL_FILE)
+    snap_path = os.path.join(root, _SNAP_FILE)
+    return {
+        "root": os.path.abspath(root),
+        "snapshot": None if snap is None else {
+            "seq": snap["seq"],
+            "stores": len(snap.get("stores", [])),
+            "idem_entries": len(snap.get("idem", [])),
+            "t_wall": snap.get("t_wall"),
+            "bytes": os.path.getsize(snap_path),
+        },
+        "records": len(records),
+        "seq_range": ([records[0]["seq"], records[-1]["seq"]]
+                      if records else None),
+        "per_verb": dict(sorted(per_verb.items())),
+        "per_store": dict(sorted(per_store.items())),
+        "torn_tail": n_torn,
+        "wal_bytes": (os.path.getsize(wal_path)
+                      if os.path.exists(wal_path) else 0),
+    }

@@ -11,6 +11,7 @@ Usage::
     python -m hyperopt_tpu.show trace --merge /tmp/driver /tmp/worker0 \
         -o merged_trace.json                       # fleet Perfetto trace
     python -m hyperopt_tpu.show live http://host:8999 [--token ...]
+    python -m hyperopt_tpu.show wal /srv/wal-dir    # WAL/snapshot summary
 """
 
 from __future__ import annotations
@@ -323,6 +324,35 @@ def render_live(snap: dict, out=None, prev=None) -> dict:
         for row in rows:
             print(row, file=out)
 
+    # Per-tenant lane of the suggestion service (netstore.tenant.<t>.*):
+    # verb volume, quota refusals and held claims, labeled by tenant.
+    tenants = {}
+    for k, v in counters.items():
+        if not k.startswith("netstore.tenant."):
+            continue
+        rest = k[len("netstore.tenant."):]
+        tname, _, metric = rest.partition(".")
+        rec = tenants.setdefault(tname, {"calls": 0, "rate_rej": 0,
+                                         "claims_rej": 0})
+        if metric.startswith("verb.") and metric.endswith(".calls"):
+            rec["calls"] += v
+        elif metric == "quota.rate_rejected":
+            rec["rate_rej"] += v
+        elif metric == "quota.claims_rejected":
+            rec["claims_rej"] += v
+    if tenants:
+        print(f"  {'tenant':<20s} {'calls':>8s} {'claims':>7s} "
+              f"{'rate.rej':>9s} {'claim.rej':>10s}", file=out)
+        for tname in sorted(tenants):
+            rec = tenants[tname]
+            held = gauges.get(f"netstore.tenant.{tname}.claims_held",
+                              m_gauges.get(
+                                  f"netstore.tenant.{tname}.claims_held"))
+            print(f"  {tname:<20s} {rec['calls']:>8d} "
+                  f"{held if held is not None else '-':>7} "
+                  f"{rec['rate_rej']:>9d} {rec['claims_rej']:>10d}",
+                  file=out)
+
     workers = fleet.get("workers", {})
     if workers:
         print("workers:", file=out)
@@ -365,6 +395,51 @@ def live(url: str, token=None, interval: float = 2.0, once: bool = False,
             return 0
 
 
+# -- WAL inspection ---------------------------------------------------------
+
+def show_wal(wal_dir: str, as_json: bool = False, out=None) -> int:
+    """Offline summary of a :class:`~.service.server.ServiceServer` WAL
+    directory: snapshot coverage, unsnapshotted tail records per verb and
+    per (tenant, exp_key) store, torn-tail count."""
+    out = out if out is not None else sys.stdout
+    from .service.wal import inspect as wal_inspect
+
+    info = wal_inspect(wal_dir)
+    if as_json:
+        json.dump(info, out, indent=2, sort_keys=True)
+        print(file=out)
+        return 0
+    print(f"wal dir: {info['root']}", file=out)
+    snap = info["snapshot"]
+    if snap is None:
+        print("snapshot: (none)", file=out)
+    else:
+        age = ""
+        if snap.get("t_wall"):
+            age = f", written {time.time() - snap['t_wall']:.0f}s ago"
+        print(f"snapshot: seq {snap['seq']}, {snap['stores']} store(s), "
+              f"{snap['idem_entries']} idem entr(ies), "
+              f"{snap['bytes']} bytes{age}", file=out)
+    rng = info["seq_range"]
+    print(f"tail: {info['records']} record(s)"
+          + (f" (seq {rng[0]}..{rng[1]})" if rng else "")
+          + f", {info['wal_bytes']} bytes", file=out)
+    if info["per_verb"]:
+        print("  per verb:", file=out)
+        for verb, n in sorted(info["per_verb"].items(),
+                              key=lambda kv: -kv[1]):
+            print(f"    {verb:<16s} {n}", file=out)
+    if info["per_store"]:
+        print("  per store (tenant/exp_key):", file=out)
+        for key, n in sorted(info["per_store"].items(),
+                             key=lambda kv: -kv[1]):
+            print(f"    {key:<24s} {n}", file=out)
+    if info["torn_tail"]:
+        print(f"torn tail: {info['torn_tail']} line(s) dropped "
+              "(crash mid-append; the verb was never acked)", file=out)
+    return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "trace":
@@ -390,6 +465,17 @@ def main(argv=None):
             tp.error("a trace dir (or --merge DIR...) is required")
         summarize_trace(targs.trace_dir)
         return 0
+
+    if argv and argv[0] == "wal":
+        wp = argparse.ArgumentParser(prog="hyperopt-tpu-show wal",
+                                     description="summarize a suggestion-"
+                                                 "service WAL directory "
+                                                 "(snapshot + tail records)")
+        wp.add_argument("wal_dir", help="ServiceServer --wal-dir")
+        wp.add_argument("--json", action="store_true",
+                        help="emit the raw inspect() dict")
+        wargs = wp.parse_args(argv[1:])
+        return show_wal(wargs.wal_dir, as_json=wargs.json)
 
     if argv and argv[0] == "live":
         lp = argparse.ArgumentParser(prog="hyperopt-tpu-show live",

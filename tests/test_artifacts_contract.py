@@ -239,6 +239,51 @@ class TestBenchmarkArtifacts:
             lanes = {e["pid"] for e in evs if e.get("ph") != "M"}
             assert lanes <= labeled, f"{name}: unlabeled lanes"
 
+    def test_service_load_artifact_schema(self):
+        """ISSUE 7 acceptance artifact: ≥1000 simulated workers across
+        ≥4 tenants completing fmin through the suggestion service under
+        ≥30% injected RPC loss, with per-verb p50/p95/p99 server
+        latencies and zero cross-tenant leakage — written by
+        benchmarks/service_load.py."""
+        paths = sorted(glob.glob(os.path.join(_BENCH_DIR,
+                                              "service_load_*.json")))
+        assert paths, "no benchmarks/service_load_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "service_load_multitenant_chaos", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            # per-verb server latency rows; the claim/complete verbs and
+            # the server-side suggest must all have been exercised
+            verbs = {r["verb"] for r in doc["rows"]}
+            assert {"reserve", "write_result", "suggest"} <= verbs, name
+            for r in doc["rows"]:
+                assert {"verb", "count", "p50_ms", "p95_ms",
+                        "p99_ms"} <= set(r), f"{name}: {r}"
+                assert r["count"] > 0, f"{name}: {r}"
+                assert 0 <= r["p50_ms"] <= r["p95_ms"] <= r["p99_ms"], \
+                    f"{name}: {r}"
+            # every tenant finished its full fleet with nothing leaking
+            assert len(doc["tenants"]) >= 4, name
+            for t in doc["tenants"]:
+                assert t["leaks"] == 0, f"{name}: {t}"
+                assert t["tid_range_ok"] is True, f"{name}: {t}"
+                assert t["completed"] == t["workers"], f"{name}: {t}"
+            head = doc["headline"]
+            assert head["workers"] >= 1000, name
+            assert head["tenants"] >= 4, name
+            assert head["rpc_loss_combined"] >= 0.30, (
+                f"{name}: chaos too gentle — "
+                f"{head['rpc_loss_combined']} < 0.30 RPC loss")
+            assert head["completed"] is True, name
+            assert head["zero_leakage"] is True, (
+                f"{name}: cross-tenant leakage detected")
+            # durability really engaged: every mutation hit the WAL
+            assert doc["wal"]["appends"] > 0, name
+            assert doc["wal"]["torn_tail"] == 0, name
+
     def test_device_ab_artifact_matches_its_bench(self):
         # the r6 device A/B (5 domains x 20 seeds, one conditional space)
         path = os.path.join(_BENCH_DIR, "quality_ab_fmin_vs_fmin_device.json")

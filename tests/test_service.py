@@ -1,0 +1,661 @@
+"""Suggestion-as-a-service: tenancy, WAL durability, server-side TPE.
+
+Covers the service subsystem end to end:
+
+* :class:`MemTrials` verb parity with the filestore semantics + canonical
+  state round-trip;
+* per-tenant auth (timing-safe token resolution), exp_key namespacing
+  (zero cross-tenant visibility), and both quota shapes;
+* the bounded idempotency reply cache (LRU + TTL + eviction counter) and
+  the timing-safe single-token compare;
+* server-side ``suggest`` proven BIT-IDENTICAL to client-side
+  ``tpe.suggest`` on seeded histories (the thin-client contract);
+* WAL append-before-execute: crash → replay reconstructs the store
+  byte-identically (``state_bytes``), snapshot+compaction, torn-tail
+  tolerance, and idempotency-cache repopulation across a crash;
+* a SIGKILL chaos run (subprocess server killed mid-``write_result`` via
+  the ``wal.write`` fault point) proving zero lost/duplicated tids;
+* the ``hyperopt-tpu-show wal`` subcommand and the per-tenant ``live``
+  dashboard section.
+"""
+
+import hmac
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import base, hp
+from hyperopt_tpu.base import (
+    JOB_STATE_DONE,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    STATUS_OK,
+)
+from hyperopt_tpu.exceptions import InvalidTrial, NetstoreUnavailable, \
+    QuotaExceeded
+from hyperopt_tpu.obs import metrics as _metrics
+from hyperopt_tpu.parallel.netstore import NetTrials, StoreServer, \
+    server_suggest
+from hyperopt_tpu.service import MemTrials, Tenant, TenantTable, TokenBucket
+from hyperopt_tpu.service import wal as wal_mod
+from hyperopt_tpu.service.server import ServiceServer
+
+
+def _counter(name: str) -> float:
+    return _metrics.registry().snapshot().get("counters", {}).get(name, 0)
+
+
+def _mk_docs(tids, exp_key, xs):
+    docs = []
+    for tid, x in zip(tids, xs):
+        d = base.new_trial_doc(tid, exp_key, None)
+        d["misc"]["idxs"] = {"x": [tid]}
+        d["misc"]["vals"] = {"x": [float(x)]}
+        docs.append(d)
+    return docs
+
+
+def _complete(doc, loss):
+    doc["state"] = JOB_STATE_DONE
+    doc["result"] = {"status": STATUS_OK, "loss": float(loss)}
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# MemTrials
+# ---------------------------------------------------------------------------
+
+
+class TestMemTrials:
+    def test_insert_refresh_and_duplicate_guard(self):
+        mt = MemTrials(exp_key="e")
+        mt._insert_trial_docs(_mk_docs([0, 1], "e", [0.1, 0.2]))
+        mt.refresh()
+        assert [d["tid"] for d in mt._dynamic_trials] == [0, 1]
+        with pytest.raises(InvalidTrial):
+            mt._insert_trial_docs(_mk_docs([1], "e", [0.3]))
+
+    def test_new_trial_ids_monotonic_past_allocations(self):
+        mt = MemTrials(exp_key="e")
+        assert mt.new_trial_ids(2) == [0, 1]
+        # allocated-but-not-inserted ids are never reissued
+        assert mt.new_trial_ids(1) == [2]
+        mt._insert_trial_docs(_mk_docs([7], "e", [0.5]))
+        assert mt.new_trial_ids(1) == [8]
+
+    def test_claim_lifecycle_and_fencing(self):
+        mt = MemTrials(exp_key="e")
+        mt._insert_trial_docs(_mk_docs([0, 1], "e", [0.1, 0.2]))
+        doc = mt.reserve("w0")
+        assert doc["tid"] == 0 and doc["state"] == JOB_STATE_RUNNING
+        assert mt.heartbeat(doc, owner="w0")
+        assert not mt.heartbeat(doc, owner="imposter")   # fenced
+        assert not mt.write_result(_complete(dict(doc), 1.0),
+                                   owner="imposter")     # fenced
+        assert mt.write_result(_complete(dict(doc), 1.0), owner="w0")
+        mt.refresh()
+        assert mt._by_tid[0]["state"] == JOB_STATE_DONE
+        # second reserve gets the remaining NEW trial, not the done one
+        assert mt.reserve("w1")["tid"] == 1
+
+    def test_requeue_stale_uses_override_clock(self):
+        mt = MemTrials(exp_key="e")
+        mt._insert_trial_docs(_mk_docs([0], "e", [0.1]))
+        mt.now_override = 1000.0
+        doc = mt.reserve("w0")
+        assert doc["book_time"] == 1000.0
+        mt.now_override = 1100.0
+        assert mt.requeue_stale(timeout=50.0) == 1
+        mt.refresh()
+        assert mt._by_tid[0]["state"] == JOB_STATE_NEW
+        assert 0 not in mt._claims
+
+    def test_state_roundtrip_is_byte_identical(self):
+        mt = MemTrials(exp_key="e")
+        mt._insert_trial_docs(_mk_docs([0, 1, 2], "e", [0.1, 0.2, 0.3]))
+        mt.now_override = 500.0
+        doc = mt.reserve("w0")
+        mt.write_result(_complete(dict(doc), 2.5), owner="w0")
+        mt.reserve("w1")
+        mt.put_domain_blob(b"\x00blob")
+        other = MemTrials(exp_key="e")
+        other.load_state(json.loads(json.dumps(mt.state_dict())))
+        assert other.state_bytes() == mt.state_bytes()
+        # the claim table survives (claims outlive completion, filestore
+        # parity): w0 keeps tid 0, w1 still owns the RUNNING tid 1
+        assert other._claims == {0: "w0", 1: "w1"}
+
+
+# ---------------------------------------------------------------------------
+# tenancy: tokens, namespacing, quotas
+# ---------------------------------------------------------------------------
+
+
+class TestTenancy:
+    def test_token_bucket(self):
+        b = TokenBucket(rate=10.0, burst=2.0)
+        assert b.take(2, now=0.0)
+        assert not b.take(1, now=0.0)         # drained
+        assert b.take(1, now=0.2)             # 0.2s * 10/s = 2 refilled
+
+    def test_resolve_is_timing_safe_full_scan(self):
+        tt = TenantTable([Tenant("a", "tok-a"), Tenant("b", "tok-b"),
+                          Tenant("c", "tok-c")])
+        with mock.patch("hmac.compare_digest",
+                        wraps=hmac.compare_digest) as spy:
+            assert tt.resolve("tok-a").name == "a"
+            # full scan, no early exit on the first-position match
+            assert spy.call_count == 3
+            spy.reset_mock()
+            assert tt.resolve("nope") is None
+            assert spy.call_count == 3
+
+    def test_bad_tenant_rejected(self):
+        with pytest.raises(ValueError):
+            Tenant("a/b", "tok")
+        with pytest.raises(ValueError):
+            Tenant("a", "")
+        with pytest.raises(ValueError):
+            TenantTable([Tenant("a", "x"), Tenant("a", "y")])
+
+    def test_tenant_isolation_and_auth(self, tmp_path):
+        tt = TenantTable([Tenant("acme", "tok-a"), Tenant("bob", "tok-b")])
+        srv = ServiceServer(str(tmp_path / "wal"), tenants=tt)
+        srv.start()
+        try:
+            na = NetTrials(srv.url, exp_key="e1", token="tok-a")
+            nb = NetTrials(srv.url, exp_key="e1", token="tok-b")
+            na._insert_trial_docs(_mk_docs([0, 1], "e1", [0.1, 0.2]))
+            na.refresh(), nb.refresh()
+            assert len(na._dynamic_trials) == 2
+            # same exp_key, different tenant: zero visibility, and tid 0
+            # does NOT collide across the namespace boundary
+            assert len(nb._dynamic_trials) == 0
+            nb._insert_trial_docs(_mk_docs([0], "e1", [0.9]))
+            na.refresh(), nb.refresh()
+            assert len(na._dynamic_trials) == 2
+            assert len(nb._dynamic_trials) == 1
+            # unknown token: typed 401 refusal, nothing dispatched
+            bad = NetTrials(srv.url, exp_key="e1", token="wrong",
+                            refresh=False)
+            with pytest.raises(RuntimeError, match="AuthError"):
+                bad.refresh()
+        finally:
+            srv.shutdown()
+
+    def test_max_claims_quota(self, tmp_path):
+        tt = TenantTable([Tenant("acme", "tok-a", max_claims=1)])
+        srv = ServiceServer(str(tmp_path / "wal"), tenants=tt)
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="tok-a")
+            nt._insert_trial_docs(_mk_docs([0, 1], "e1", [0.1, 0.2]))
+            doc = nt.reserve("w0")
+            assert doc is not None
+            # one RUNNING held -> the quota answers queue-empty
+            assert nt.reserve("w1") is None
+            assert _counter(
+                "netstore.tenant.acme.quota.claims_rejected") >= 1
+            assert nt.write_result(_complete(doc, 1.0), owner="w0")
+            assert nt.reserve("w1")["tid"] == 1   # freed by completion
+        finally:
+            srv.shutdown()
+
+    def test_trials_per_s_quota_is_typed_and_not_retried(self, tmp_path):
+        tt = TenantTable([Tenant("acme", "tok-a", trials_per_s=0.001,
+                                 burst=2)])
+        srv = ServiceServer(str(tmp_path / "wal"), tenants=tt)
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="tok-a")
+            nt._insert_trial_docs(_mk_docs([0, 1], "e1", [0.1, 0.2]))
+            before = _counter("netstore.rpc.retry")
+            with pytest.raises(QuotaExceeded):
+                nt._insert_trial_docs(_mk_docs([2], "e1", [0.3]))
+            # a quota refusal is a deliberate answer — never retried
+            assert _counter("netstore.rpc.retry") == before
+            nt.refresh()
+            assert len(nt._dynamic_trials) == 2   # refused insert left
+            # no trace (nothing half-admitted, nothing WAL-logged)
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# idempotency cache bounds + timing-safe single-token auth
+# ---------------------------------------------------------------------------
+
+
+class TestIdemCacheBounds:
+    def _dispatch(self, srv, idem, n=1):
+        return srv._dispatch({"verb": "new_trial_ids", "n": n,
+                              "exp_key": "e", "idem": idem})
+
+    def test_lru_cap_evicts_and_counts(self, tmp_path):
+        srv = StoreServer(str(tmp_path))
+        srv._idem_cap = 3
+        try:
+            before = _counter("netstore.idem.evicted")
+            for k in range(5):
+                self._dispatch(srv, f"k{k}")
+            assert len(srv._idem) == 3
+            assert _counter("netstore.idem.evicted") - before == 2
+            # survivors are the most recent; replay of one returns the
+            # cached reply without re-executing
+            out1 = self._dispatch(srv, "k4")
+            out2 = self._dispatch(srv, "k4")
+            assert out1 == out2
+        finally:
+            srv.shutdown()
+
+    def test_ttl_expiry(self, tmp_path):
+        srv = StoreServer(str(tmp_path))
+        srv._idem_ttl = 0.02
+        try:
+            out1 = self._dispatch(srv, "t1")
+            before = _counter("netstore.idem.evicted")
+            time.sleep(0.05)
+            # expired: the same key re-executes (fresh tids) and the
+            # eviction is counted
+            out2 = self._dispatch(srv, "t1")
+            assert out2["tids"] != out1["tids"]
+            assert _counter("netstore.idem.evicted") - before >= 1
+        finally:
+            srv.shutdown()
+
+    def test_single_token_auth_uses_compare_digest(self, tmp_path):
+        srv = StoreServer(str(tmp_path), token="s3cret")
+        srv.start()
+        try:
+            with mock.patch("hmac.compare_digest",
+                            wraps=hmac.compare_digest) as spy:
+                nt = NetTrials(srv.url, exp_key="e", token="s3cret",
+                               refresh=False)
+                nt.refresh()
+                assert spy.call_count >= 1     # the gate ran, timing-safe
+                bad = NetTrials(srv.url, exp_key="e", token="nope",
+                                refresh=False)
+                with pytest.raises(RuntimeError, match="AuthError"):
+                    bad.refresh()
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# server-side suggest: bit-identical to the client path
+# ---------------------------------------------------------------------------
+
+
+def _mk_domain():
+    space = {"x": hp.uniform("x", -5, 5),
+             "c": hp.choice("c", [0, 1, 2])}
+    return base.Domain(lambda a: a["x"] ** 2, space)
+
+
+class TestServerSuggest:
+    def test_bit_identical_to_client_tpe(self, tmp_path):
+        """The pinned contract: for the same (history, seed), the server's
+        ``suggest`` verb (dispatch + materialize over its own store) emits
+        the EXACT documents client-side ``tpe.suggest`` would — compared
+        through the JSON wire representation, which is lossless for the
+        native-typed vals ``docs_from_samples`` emits."""
+        from hyperopt_tpu import tpe
+
+        tt = TenantTable([Tenant("acme", "tok-a")])
+        srv = ServiceServer(str(tmp_path / "wal"), tenants=tt)
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="tok-a")
+            local = base.Trials(exp_key="e1")
+            domain = _mk_domain()
+            nt.save_domain(domain)
+            rng = np.random.default_rng(7)
+            tid0 = 0
+            for _batch in range(3):
+                seed = int(rng.integers(2 ** 31 - 1))
+                new_ids = list(range(tid0, tid0 + 4))
+                tid0 += 4
+                client_docs = tpe.suggest(new_ids, domain, local, seed,
+                                          n_startup_jobs=4, verbose=False)
+                server_docs = nt.suggest(seed, new_ids=new_ids,
+                                         insert=False, n_startup_jobs=4)
+                assert json.loads(json.dumps(client_docs)) == server_docs
+                # evolve BOTH histories identically so later batches
+                # exercise the fitted-posterior path (startup=4 < 8)
+                done = [_complete(d, d["misc"]["vals"]["x"][0] ** 2)
+                        for d in client_docs]
+                local.insert_trial_docs(done)
+                local.refresh()
+                nt._insert_trial_docs(json.loads(json.dumps(done)))
+        finally:
+            srv.shutdown()
+
+    def test_fmin_algo_adapter_matches(self, tmp_path):
+        """``server_suggest`` slots into the fmin algo slot: same ids,
+        same seed, docs equal to the direct client call."""
+        from hyperopt_tpu import tpe
+
+        srv = ServiceServer(str(tmp_path / "wal"), token="t")
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="t")
+            domain = _mk_domain()
+            nt.save_domain(domain)
+            local = base.Trials(exp_key="e1")
+            docs_srv = server_suggest([0, 1], domain, nt, 1234)
+            docs_cli = tpe.suggest([0, 1], domain, local, 1234,
+                                   verbose=False)
+            assert json.loads(json.dumps(docs_cli)) == docs_srv
+            with pytest.raises(TypeError):
+                server_suggest([0], domain, local, 1)   # needs NetTrials
+        finally:
+            srv.shutdown()
+
+    def test_enqueue_form_allocates_and_inserts(self, tmp_path):
+        srv = ServiceServer(str(tmp_path / "wal"), token="t")
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="t")
+            nt.save_domain(_mk_domain())
+            docs = nt.suggest(seed=3, n=4, algo="rand")
+            assert [d["tid"] for d in docs] == [0, 1, 2, 3]
+            nt.refresh()
+            assert len(nt._dynamic_trials) == 4   # inserted server-side
+            docs2 = nt.suggest(seed=4, n=2, algo="rand")
+            assert [d["tid"] for d in docs2] == [4, 5]
+        finally:
+            srv.shutdown()
+
+    def test_bad_requests_are_refused(self, tmp_path):
+        srv = ServiceServer(str(tmp_path / "wal"), token="t")
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="t")
+            nt.save_domain(_mk_domain())
+            with pytest.raises(RuntimeError, match="unknown algo"):
+                nt.suggest(seed=1, n=1, algo="gradient_descent")
+            with pytest.raises(RuntimeError, match="unknown argument"):
+                nt.suggest(seed=1, n=1, algo="rand", exploit_me=True)
+            with pytest.raises(RuntimeError, match="no domain"):
+                NetTrials(srv.url, exp_key="other", token="t").suggest(
+                    seed=1, n=1, algo="rand")
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# WAL: replay, snapshot/compaction, torn tail, idem repopulation
+# ---------------------------------------------------------------------------
+
+
+class TestWalReplay:
+    def _drive(self, srv, token="tok-a"):
+        nt = NetTrials(srv.url, exp_key="e1", token=token)
+        nt._insert_trial_docs(_mk_docs([0, 1, 2], "e1", [0.1, 0.2, 0.3]))
+        doc = nt.reserve("w0")
+        nt.write_result(_complete(doc, 7.0), owner="w0")
+        nt.reserve("w1")        # left RUNNING: claims must survive replay
+        return nt
+
+    def test_replay_restores_store_byte_identically(self, tmp_path):
+        tt = TenantTable([Tenant("acme", "tok-a"), Tenant("bob", "tok-b")])
+        wal_dir = str(tmp_path / "wal")
+        srv = ServiceServer(wal_dir, tenants=tt)
+        srv.start()
+        self._drive(srv)
+        # a read-only tenant must not perturb durable state
+        NetTrials(srv.url, exp_key="e1", token="tok-b").refresh()
+        state_a = srv.state_bytes()
+        srv.shutdown()
+
+        srv2 = ServiceServer(wal_dir, tenants=tt)
+        try:
+            assert srv2.state_bytes() == state_a
+        finally:
+            srv2.shutdown()
+
+    def test_snapshot_compaction_then_tail_replay(self, tmp_path):
+        wal_dir = str(tmp_path / "wal")
+        srv = ServiceServer(wal_dir, token="t")
+        srv.start()
+        nt = self._drive(srv, token="t")
+        srv.snapshot()
+        # post-snapshot tail
+        doc = nt.reserve("w2")
+        assert doc is not None
+        nt.write_result(_complete(doc, 9.0), owner="w2")
+        state_a = srv.state_bytes()
+        srv.shutdown()
+
+        info = wal_mod.inspect(wal_dir)
+        assert info["snapshot"] is not None
+        assert 0 < info["records"] <= 4   # only the post-snapshot tail
+        srv2 = ServiceServer(wal_dir, token="t")
+        try:
+            assert srv2.state_bytes() == state_a
+        finally:
+            srv2.shutdown()
+
+    def test_auto_snapshot_every_n_appends(self, tmp_path):
+        wal_dir = str(tmp_path / "wal")
+        srv = ServiceServer(wal_dir, token="t", snapshot_every=2)
+        srv.start()
+        try:
+            self._drive(srv, token="t")
+            info = wal_mod.inspect(wal_dir)
+            assert info["snapshot"] is not None
+            assert info["records"] <= 2      # log keeps compacting
+        finally:
+            srv.shutdown()
+
+    def test_torn_tail_is_dropped_not_fatal(self, tmp_path):
+        wal_dir = str(tmp_path / "wal")
+        srv = ServiceServer(wal_dir, token="t")
+        srv.start()
+        self._drive(srv, token="t")
+        state_a = srv.state_bytes()
+        srv.shutdown()
+        with open(os.path.join(wal_dir, "wal.jsonl"), "a") as f:
+            f.write('{"t": 1, "verb": "insert_docs", "re')   # crash mid-append
+        srv2 = ServiceServer(wal_dir, token="t")
+        try:
+            # the torn record was never acked -> state unchanged
+            assert srv2.state_bytes() == state_a
+        finally:
+            srv2.shutdown()
+        assert wal_mod.inspect(wal_dir)["torn_tail"] == 1
+
+    def test_idem_cache_survives_crash(self, tmp_path):
+        """A client retry that straddles a server restart must dedupe:
+        the WAL records carry the idempotency keys and replay repopulates
+        the reply cache."""
+        wal_dir = str(tmp_path / "wal")
+        srv = ServiceServer(wal_dir, token="t")
+        try:
+            docs = _mk_docs([0], "e1", [0.5])
+            out1 = srv._dispatch({"verb": "insert_docs", "docs": docs,
+                                  "exp_key": "e1", "idem": "abc"})
+        finally:
+            srv.shutdown()
+        srv2 = ServiceServer(wal_dir, token="t")
+        try:
+            out2 = srv2._dispatch({"verb": "insert_docs", "docs": docs,
+                                   "exp_key": "e1", "idem": "abc"})
+            assert out2 == out1                    # cached, not re-executed
+            ft = srv2._store("e1", tenant=None)
+            ft.refresh()
+            assert len(ft._dynamic_trials) == 1    # no duplicate insert
+        finally:
+            srv2.shutdown()
+
+    def test_suggest_idem_reply_reconstructed_after_crash(self, tmp_path):
+        """Server-side suggest is logged as physical records; the retry
+        reply is reconstructed from them (docs + tids + inserted)."""
+        wal_dir = str(tmp_path / "wal")
+        srv = ServiceServer(wal_dir, token="t")
+        srv.start()
+        try:
+            NetTrials(srv.url, exp_key="e1", token="t").save_domain(
+                _mk_domain())
+            out1 = srv._dispatch({"verb": "suggest", "seed": 5, "n": 2,
+                                  "algo": "rand", "exp_key": "e1",
+                                  "idem": "xyz"})
+        finally:
+            srv.shutdown()
+        srv2 = ServiceServer(wal_dir, token="t")
+        try:
+            out2 = srv2._dispatch({"verb": "suggest", "seed": 5, "n": 2,
+                                   "algo": "rand", "exp_key": "e1",
+                                   "idem": "xyz"})
+            assert out2 == out1
+            ft = srv2._store("e1", tenant=None)
+            ft.refresh()
+            assert [d["tid"] for d in ft._dynamic_trials] == [0, 1]
+        finally:
+            srv2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: SIGKILL mid-write_result, replay loses nothing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestChaosKillReplay:
+    def test_sigkill_mid_write_result_zero_lost_or_duplicated(
+            self, tmp_path, monkeypatch):
+        """A real server process is SIGKILLed at the WAL append boundary
+        of a ``write_result`` (``wal.write`` fault + WAL_CRASH=kill, no
+        Python teardown).  A fresh server on the same WAL dir must replay
+        to a store with zero lost and zero duplicated tids, and the run
+        completes."""
+        monkeypatch.setenv("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.01")
+        wal_dir = str(tmp_path / "wal")
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   HYPEROPT_TPU_WAL_CRASH="kill",
+                   # appends: 1 new_trial_ids, 2 insert_docs, then
+                   # (reserve, write) pairs -> the 8th append is the
+                   # write_result of the third trial.  @7 = fire there.
+                   HYPEROPT_TPU_FAULTS="wal.write=1.0:1@7")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "hyperopt_tpu.service.server",
+             "--serve", "--wal-dir", wal_dir, "--token", "tok"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            url = None
+            deadline = time.time() + 45
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if "service: serving" in line:
+                    url = line.rsplit(" at ", 1)[1].strip()
+                    break
+                if proc.poll() is not None:
+                    pytest.fail(f"server died on startup: "
+                                f"{proc.stdout.read()}")
+            assert url, "server never printed its URL"
+
+            nt = NetTrials(url, exp_key="e1", token="tok", retries=2,
+                           refresh=False)
+            tids = nt.new_trial_ids(4)
+            assert tids == [0, 1, 2, 3]
+            nt._insert_trial_docs(_mk_docs(tids, "e1",
+                                           [0.1, 0.2, 0.3, 0.4]))
+            crashed = False
+            completed = []
+            try:
+                for _ in range(4):
+                    doc = nt.reserve("w0")
+                    assert nt.write_result(_complete(doc, 1.0),
+                                           owner="w0")
+                    completed.append(doc["tid"])
+            except NetstoreUnavailable:
+                crashed = True
+            assert crashed, "fault schedule never killed the server"
+            assert proc.wait(timeout=20) == -signal.SIGKILL
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.stdout.close()
+
+        # replay on the same WAL dir (this process has no faults armed)
+        srv = ServiceServer(wal_dir, token="tok")
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="tok")
+            nt.refresh()
+            seen = [d["tid"] for d in nt._dynamic_trials]
+            assert sorted(seen) == [0, 1, 2, 3]          # zero lost
+            assert len(seen) == len(set(seen))           # zero duplicated
+            by_tid = {d["tid"]: d for d in nt._dynamic_trials}
+            for t in completed:
+                assert by_tid[t]["state"] == JOB_STATE_DONE
+            # the trial whose ack was cut: reserved (claim replayed) but
+            # its un-logged write never happened — finish the run
+            for d in nt._dynamic_trials:
+                if d["state"] == JOB_STATE_RUNNING:
+                    assert nt.write_result(_complete(dict(d), 1.0),
+                                           owner=d["owner"])
+                elif d["state"] == JOB_STATE_NEW:
+                    got = nt.reserve("w1")
+                    assert nt.write_result(_complete(got, 1.0),
+                                           owner="w1")
+            nt.refresh()
+            assert all(d["state"] == JOB_STATE_DONE
+                       for d in nt._dynamic_trials)
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# show: wal subcommand + tenant dashboard section
+# ---------------------------------------------------------------------------
+
+
+class TestShow:
+    def test_show_wal_subcommand(self, tmp_path, capsys):
+        from hyperopt_tpu import show
+
+        wal_dir = str(tmp_path / "wal")
+        srv = ServiceServer(wal_dir, token="t")
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="t")
+            nt._insert_trial_docs(_mk_docs([0, 1], "e1", [0.1, 0.2]))
+            doc = nt.reserve("w0")
+            nt.write_result(_complete(doc, 1.0), owner="w0")
+        finally:
+            srv.shutdown()
+        assert show.main(["wal", wal_dir]) == 0
+        out = capsys.readouterr().out
+        assert "wal dir:" in out
+        assert "insert_docs" in out
+        assert "write_result" in out
+        assert show.main(["wal", wal_dir, "--json"]) == 0
+        info = json.loads(capsys.readouterr().out)
+        assert info["per_verb"]["write_result"] == 1
+
+    def test_live_dashboard_has_tenant_section(self):
+        from hyperopt_tpu import show
+
+        snap = {"counters": {
+                    "netstore.tenant.acme.verb.reserve.calls": 12,
+                    "netstore.tenant.acme.quota.claims_rejected": 3,
+                    "netstore.tenant.bob.verb.insert_docs.calls": 5,
+                    "netstore.tenant.bob.quota.rate_rejected": 2},
+                "gauges": {"netstore.tenant.acme.claims_held": 4},
+                "histograms": {}, "fleet": {}}
+        buf = io.StringIO()
+        show.render_live(snap, out=buf)
+        out = buf.getvalue()
+        assert "acme" in out and "bob" in out
+        assert "tenant" in out

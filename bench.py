@@ -65,6 +65,7 @@ PHASE_DEADLINES = {
     "pallas_ab": 600.0,
     "trials_sec": 420.0,
     "pipeline": 600.0,
+    "fleet": 600.0,
     "device_fmin": 600.0,
     "cpu_ref": 300.0,
     "result": 60.0,
@@ -453,6 +454,79 @@ def child():
         _say("partial", partial)
     except Exception as e:
         partial["pipeline_error"] = f"{type(e).__name__}: {e}"
+        _say("partial", partial)
+
+    # Fleet cohorts (ISSUE 8): B same-structure experiments served by ONE
+    # vmap-batched dispatch vs a serial loop of B solo suggests.  On a
+    # tunneled TPU the serial loop pays B fetch syncs per round and the
+    # cohort pays 1, so this phase measures the real aggregate win; the
+    # full sweep with the attachment model lives in benchmarks/fleet_ab.py.
+    _say("phase", {"name": "fleet"})
+    try:
+        import hyperopt_tpu as ho_f
+        from hyperopt_tpu import fleet as _fleet
+        from hyperopt_tpu.base import Domain as _FDomain
+        from hyperopt_tpu.obs.metrics import (kernel_cache_stats as _f_kcs,
+                                              registry as _f_reg)
+
+        cohorts = (4,) if fast else (4, 16)
+        rounds_f = 3 if fast else 5
+        space_f = _flagship_space(10)
+        rng_f = np.random.default_rng(0)
+
+        def _f_exp(b_i):
+            dom = _FDomain(lambda cfg: float(cfg["u0"] ** 2), space_f)
+            t = ho_f.Trials()
+            for i in range(30):
+                t.insert_trial_docs(ho_f.rand.suggest(
+                    [i], dom, t, int(rng_f.integers(2 ** 31))))
+                t.refresh()
+                d = t._dynamic_trials[-1]
+                d["state"] = 2          # JOB_STATE_DONE
+                d["result"] = {"status": "ok",
+                               "loss": float(rng_f.normal())}
+            t.refresh()
+            return dom, t
+
+        frows = []
+        for bsz in cohorts:
+            exps_f = [_f_exp(i) for i in range(bsz)]
+            sched_f = _fleet.CohortScheduler()
+
+            def _serial(r0):
+                for e, (dom, t) in enumerate(exps_f):
+                    ho_f.tpe.suggest([30], dom, t, r0 * 1000 + e)
+
+            def _cohort(r0):
+                sched_f.suggest([([30], dom, t, r0 * 1000 + e)
+                                 for e, (dom, t) in enumerate(exps_f)])
+
+            _serial(0), _cohort(0)      # absorb compiles
+            t0f = time.perf_counter()
+            for r in range(1, rounds_f + 1):
+                _serial(r)
+            ser_s = bsz * rounds_f / (time.perf_counter() - t0f)
+            _f_kcs(reset=True)
+            t0f = time.perf_counter()
+            for r in range(1, rounds_f + 1):
+                _cohort(r)
+            coh_s = bsz * rounds_f / (time.perf_counter() - t0f)
+            frows.append({
+                "cohort": bsz,
+                "serial_suggestions_per_sec": round(ser_s, 1),
+                "cohort_suggestions_per_sec": round(coh_s, 1),
+                "speedup": round(coh_s / ser_s, 2),
+                "dispatches_per_sec": round(coh_s / bsz, 2),
+                "padding_waste": _f_reg().snapshot()["gauges"].get(
+                    "fleet.padding_waste", 0.0),
+                "kernel_compiles_steady": _f_kcs()["misses"],
+            })
+            _say("rep", {"i": len(frows), "ms": round(1e3 / coh_s, 2)})
+        partial["fleet"] = {"rounds": rounds_f, "history_rows": 30,
+                            "rows": frows}
+        _say("partial", partial)
+    except Exception as e:
+        partial["fleet_error"] = f"{type(e).__name__}: {e}"
         _say("partial", partial)
 
     # Device-resident fmin (hyperopt_tpu/device.py): the ENTIRE optimize

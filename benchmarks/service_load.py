@@ -26,6 +26,10 @@ bar is zero.
 Run::
 
     env JAX_PLATFORMS=cpu python benchmarks/service_load.py
+    env JAX_PLATFORMS=cpu python benchmarks/service_load.py \
+        --cohort-window-ms 50     # same chaos through the fleet cohort
+                                  # gate: server-side TPE, tenants
+                                  # coalesced into vmap-batched dispatches
 
 Writes ``benchmarks/service_load_cpu_<stamp>.json`` with per-verb
 p50/p95/p99 server latencies, per-tenant totals, chaos + WAL stats and
@@ -122,7 +126,7 @@ def _worker_pool(url, tenant_idx, token, stop, stats, lock):
     return threads
 
 
-def main():
+def main(cohort_window_ms=None):
     os.environ.setdefault("HYPEROPT_TPU_NETSTORE_RETRIES", "30")
     os.environ.setdefault("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.002")
 
@@ -139,9 +143,14 @@ def main():
         Tenant(f"tenant-{i}", f"tok-{i}", max_claims=64,
                trials_per_s=500.0, burst=300.0)
         for i in range(N_TENANTS)])
+    # --cohort-window-ms: run the SAME chaos schedule through the fleet
+    # cohort gate — concurrent tenants' server-side TPE suggests coalesce
+    # into vmap-batched device dispatches instead of solo verb calls.
     srv = ServiceServer(wal_dir, tenants=tenants, fsync="batch",
-                        snapshot_every=2000)
+                        snapshot_every=2000,
+                        cohort_window_ms=cohort_window_ms)
     srv.start()
+    drive_algo = "tpe" if cohort_window_ms else "rand"
 
     stop = threading.Event()
     lock = threading.Lock()
@@ -157,7 +166,7 @@ def main():
         def drive(i):
             nt = NetTrials(srv.url, exp_key="exp", token=f"tok-{i}")
             nt.fmin(partial(_objective, offset=i * OFFSET), _space(),
-                    algo=partial(server_suggest, algo="rand"),
+                    algo=partial(server_suggest, algo=drive_algo),
                     max_evals=WORKERS_PER_TENANT,
                     max_queue_len=MAX_QUEUE_LEN,
                     rstate=np.random.default_rng(SEED + i),
@@ -229,7 +238,8 @@ def main():
             "workers_per_tenant": WORKERS_PER_TENANT,
             "threads_per_tenant": THREADS_PER_TENANT,
             "max_queue_len": MAX_QUEUE_LEN,
-            "algo": "rand (server-side suggest verb)",
+            "algo": f"{drive_algo} (server-side suggest verb)",
+            "cohort_window_ms": cohort_window_ms,
             "fsync": "batch",
             "rpc_loss": {"send_p": SEND_P, "recv_p": RECV_P,
                          "combined": round(1 - (1 - SEND_P) * (1 - RECV_P),
@@ -243,6 +253,8 @@ def main():
             "rpc_unavailable": counters.get("netstore.rpc.unavailable", 0),
             "idem_hits": counters.get("netstore.idem.hits", 0),
             "idem_evicted": counters.get("netstore.idem.evicted", 0),
+            "fleet_dispatches": counters.get("fleet.dispatches", 0),
+            "fleet_suggestions": counters.get("fleet.suggestions", 0),
         },
         "wal": {
             "appends": counters.get("wal.appends", 0),
@@ -279,4 +291,13 @@ def main():
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cohort-window-ms", type=float, default=None,
+                    help="hold tenants' server-side TPE suggests up to this "
+                         "long so concurrent tenants coalesce into one "
+                         "vmap-batched fleet dispatch (default: off — solo "
+                         "rand verb path)")
+    args = ap.parse_args()
+    raise SystemExit(main(cohort_window_ms=args.cohort_window_ms))

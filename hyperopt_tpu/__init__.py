@@ -18,6 +18,7 @@ from . import (  # noqa: F401
     anneal,
     atpe,
     criteria,
+    fleet,
     graphviz,
     hp,
     mix,
@@ -87,7 +88,7 @@ __all__ = [
     "fmin", "fmin_device", "FMinIter", "fmin_pass_expr_memo_ctrl",
     "space_eval",
     "generate_trials_to_calculate",
-    "partial", "hp", "tpe", "rand", "anneal", "mix", "atpe", "qmc",
+    "partial", "hp", "tpe", "rand", "anneal", "mix", "atpe", "qmc", "fleet",
     "criteria", "rdists", "plotting", "graphviz", "scope", "pyll",
     "Trials", "trials_from_docs", "Domain", "Ctrl",
     "PoolTrials", "FileTrials",

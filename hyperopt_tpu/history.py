@@ -37,6 +37,23 @@ host→device byte this module moves), ``history.append_hits`` (calls
 served by the delta path), ``history.rebuilds`` (full re-uploads).  The
 steady-state per-trial upload contract — O(P) bytes, not O(n_cap·P) —
 is asserted from these counters in the tier-1 suite.
+
+Two extensions for fleet mode (PR 8):
+
+* **Bounded store** — ``_Resident`` state is keyed by trials identity
+  and historically only ``forget()`` freed it, so a long-lived
+  ``ServiceServer`` with churning tenants leaked device buffers.
+  ``HYPEROPT_TPU_RESIDENT_HISTORY_CAP`` (0/unset = unbounded) caps the
+  number of resident entries process-wide with LRU eviction
+  (``history.evicted`` counter); an evicted experiment's next suggest
+  pays one full re-upload, never a wrong answer.
+* **Batched rings** — :class:`BatchedResident` /
+  :func:`device_history_batched` stack the per-bucket ``(hv, ha, hl,
+  hok)`` rings of N same-shape experiments along a leading axis so a
+  cohort's whole history feed is one set of ``[B, n_cap, ...]`` device
+  buffers: delta-append, constant-liar overlay and pregrow all gain a
+  batch dim (``fleet.CohortScheduler`` drives them).  Per-lane content
+  is bit-identical to the solo buffers — tests/test_fleet.py pins it.
 """
 
 from __future__ import annotations
@@ -44,6 +61,7 @@ from __future__ import annotations
 import os
 import threading
 import weakref
+from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
@@ -51,13 +69,41 @@ import numpy as np
 
 from .obs.metrics import registry as _registry
 
-__all__ = ["enabled", "device_history", "pregrow", "forget"]
+__all__ = ["enabled", "device_history", "pregrow", "forget", "generation",
+           "BatchedResident", "device_history_batched", "pregrow_batched",
+           "resident_cap", "KEEP"]
+
+
+class _Keep:
+    """Sentinel lane marker for :func:`device_history_batched`: the lane
+    belongs to a live experiment that is NOT part of this dispatch —
+    leave its resident rows and metadata untouched (its output lane is
+    simply unused) instead of clearing it like a padding lane."""
+
+    __slots__ = ()
+
+    def __repr__(self):  # pragma: no cover - debug nicety
+        return "history.KEEP"
+
+
+KEEP = _Keep()
 
 
 def enabled() -> bool:
     """Resident-history gate (``HYPEROPT_TPU_RESIDENT_HISTORY``, default on)."""
     return os.environ.get("HYPEROPT_TPU_RESIDENT_HISTORY", "1").lower() \
         not in ("0", "off", "false")
+
+
+def resident_cap() -> int:
+    """Process-wide resident-entry cap (``HYPEROPT_TPU_RESIDENT_HISTORY_CAP``,
+    0/unset/invalid = unbounded).  Read per call so a long-lived server
+    can be retuned without a restart."""
+    try:
+        cap = int(os.environ.get("HYPEROPT_TPU_RESIDENT_HISTORY_CAP", "0"))
+    except ValueError:
+        return 0
+    return max(cap, 0)
 
 
 def _row_bytes(p: int) -> int:
@@ -104,6 +150,67 @@ def _overlay_impl(hv, ha, hl, hok, pv, pa, lie, idx):
     return hv, ha, hl, hok
 
 
+# -- batched (fleet) programs: same semantics, one leading cohort axis ------
+
+
+def _append_b_impl(hv, ha, hl, hok, rows, acts, loss, ok, lane, idx):
+    """Per-lane delta append into the stacked ``[B, cap, ...]`` buffers."""
+    hv = jax.lax.dynamic_update_slice(hv, rows[None], (lane, idx, 0))
+    ha = jax.lax.dynamic_update_slice(ha, acts[None], (lane, idx, 0))
+    hl = jax.lax.dynamic_update_slice(hl, loss[None], (lane, idx))
+    hok = jax.lax.dynamic_update_slice(hok, ok[None], (lane, idx))
+    return hv, ha, hl, hok
+
+
+def _grow_b_impl(hv, ha, hl, hok, new_cap):
+    pad = new_cap - hv.shape[1]
+    return (jnp.pad(hv, ((0, 0), (0, pad), (0, 0))),
+            jnp.pad(ha, ((0, 0), (0, pad), (0, 0))),
+            jnp.pad(hl, ((0, 0), (0, pad)), constant_values=np.inf),
+            jnp.pad(hok, ((0, 0), (0, pad))))
+
+
+def _slice_b_impl(hv, ha, hl, hok, cap):
+    return hv[:, :cap], ha[:, :cap], hl[:, :cap], hok[:, :cap]
+
+
+def _clear_b_impl(hv, ha, hl, hok, lane):
+    """Reset one lane to the pad values (device-side, zero upload)."""
+    cap, p = hv.shape[1], hv.shape[2]
+    hv = jax.lax.dynamic_update_slice(
+        hv, jnp.zeros((1, cap, p), hv.dtype), (lane, 0, 0))
+    ha = jax.lax.dynamic_update_slice(
+        ha, jnp.zeros((1, cap, p), jnp.bool_), (lane, 0, 0))
+    hl = jax.lax.dynamic_update_slice(
+        hl, jnp.full((1, cap), np.inf, hl.dtype), (lane, 0))
+    hok = jax.lax.dynamic_update_slice(
+        hok, jnp.zeros((1, cap), jnp.bool_), (lane, 0))
+    return hv, ha, hl, hok
+
+
+def _overlay_b_impl(hv, ha, hl, hok, pvz, paz, liez, start, mcnt):
+    """Per-lane fantasy overlay with VARIABLE row counts.
+
+    ``dynamic_update_slice`` cannot place a different number of rows per
+    lane, so the overlay is a gather/where program instead: position
+    ``j`` of lane ``b`` takes fantasy row ``j - start[b]`` when that
+    index is in ``[0, mcnt[b])`` and the canonical row otherwise.
+    ``pvz/paz/liez`` are ``[B, Mmax, ...]`` host-flattened slot rows
+    (multi-slot lies flattened to one per-row lie vector, preserving the
+    solo path's slot layout exactly)."""
+    cap = hv.shape[1]
+    j = jnp.arange(cap)[None, :] - start[:, None]          # [B, cap]
+    inr = (j >= 0) & (j < mcnt[:, None])
+    jc = jnp.clip(j, 0, pvz.shape[1] - 1)
+    hv = jnp.where(inr[:, :, None],
+                   jnp.take_along_axis(pvz, jc[:, :, None], axis=1), hv)
+    ha = jnp.where(inr[:, :, None],
+                   jnp.take_along_axis(paz, jc[:, :, None], axis=1), ha)
+    hl = jnp.where(inr, jnp.take_along_axis(liez, jc, axis=1), hl)
+    hok = jnp.where(inr, True, hok)
+    return hv, ha, hl, hok
+
+
 _FNS: dict = {}
 _FNS_LOCK = threading.Lock()
 
@@ -126,7 +233,7 @@ def _fn(name: str):
         if fn is None:
             donate = (0, 1, 2, 3) if _donate_ok() else ()
             if name == "append":
-                # Exact-shape in-place aliasing; the only donating program.
+                # Exact-shape in-place aliasing; a donating program.
                 fn = jax.jit(_append_impl, donate_argnums=donate)
             elif name == "grow":
                 # Shapes differ old→new so donation could never alias —
@@ -134,8 +241,20 @@ def _fn(name: str):
                 fn = jax.jit(_grow_impl, static_argnums=(4,))
             elif name == "slice":
                 fn = jax.jit(_slice_impl, static_argnums=(4,))
-            else:  # overlay: canonical buffers must SURVIVE — no donation
+            elif name == "overlay":
+                # canonical buffers must SURVIVE — no donation
                 fn = jax.jit(_overlay_impl)
+            # batched (fleet) twins of the four programs above
+            elif name == "append_b":
+                fn = jax.jit(_append_b_impl, donate_argnums=donate)
+            elif name == "grow_b":
+                fn = jax.jit(_grow_b_impl, static_argnums=(4,))
+            elif name == "slice_b":
+                fn = jax.jit(_slice_b_impl, static_argnums=(4,))
+            elif name == "clear_b":
+                fn = jax.jit(_clear_b_impl, donate_argnums=donate)
+            else:  # overlay_b: derived copy, canonical lanes survive
+                fn = jax.jit(_overlay_b_impl)
             _FNS[name] = fn
     return fn
 
@@ -163,6 +282,66 @@ class _Resident:
 # strongly so the id(cs) key cannot be recycled while the entry lives.
 _STORE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 _LOCK = threading.Lock()
+
+# trials → wipe generation.  ``forget`` bumps it; external batched stores
+# (fleet cohorts are NOT keyed by trials identity, so the WeakKeyDictionary
+# pop cannot reach them) compare generations to catch tid reuse after
+# ``delete_all`` — reinserted tids restart at 0 and can prefix-match a
+# stale fingerprint that the tids check alone would wrongly accept.
+_GENS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def generation(trials) -> int:
+    """Monotone wipe counter for ``trials`` (bumped by :func:`forget`).
+    Feed it to :func:`device_history_batched` via ``gens`` so cohort
+    lanes invalidate on tenant/experiment deletion."""
+    try:
+        return _GENS.get(trials, 0)
+    except TypeError:
+        return 0
+
+# LRU order over every live resident entry: (weakref(trials), inner key) →
+# None, hottest last.  Only consulted when a cap is set; dead referents
+# fall out for free as their _STORE entries vanish.
+_LRU: "OrderedDict" = OrderedDict()
+
+
+def _lru_touch(trials, key):
+    """Mark (trials, key) most-recently-used and evict past the cap.
+    Caller holds _LOCK."""
+    try:
+        ref = weakref.ref(trials)
+    except TypeError:
+        return
+    _LRU[(ref, key)] = None
+    _LRU.move_to_end((ref, key))
+    cap = resident_cap()
+    if not cap:
+        return
+    evicted = 0
+    while len(_LRU) > cap:
+        (ref, k), _ = _LRU.popitem(last=False)
+        tr = ref()
+        if tr is None:
+            continue                      # referent died; nothing resident
+        try:
+            states = _STORE.get(tr)
+        except TypeError:                 # pragma: no cover - exotic trials
+            continue
+        if states is not None and states.pop(k, None) is not None:
+            evicted += 1
+    if evicted:
+        _registry().counter("history.evicted").inc(evicted)
+
+
+def _lru_drop(trials):
+    """Forget every LRU slot for ``trials``.  Caller holds _LOCK."""
+    try:
+        ref = weakref.ref(trials)
+    except TypeError:
+        return
+    for k in [k for k in _LRU if k[0] == ref or k[0]() is None]:
+        _LRU.pop(k, None)
 
 
 def _states(trials):
@@ -263,6 +442,8 @@ def device_history(trials, cs, h, n_cap, fantasies=None, sharding=None,
                 st.tids = h["tids"]
                 reg.counter("history.upload_bytes").inc(k * _row_bytes(p))
             reg.counter("history.append_hits").inc()
+        if states is not None:
+            _lru_touch(trials, key)
         out = st.bufs
     if st.cap > n_cap:
         # Canonical outgrew the request (pregrow band / post-batch single
@@ -313,11 +494,203 @@ def forget(trials):
     """Drop all resident buffers for ``trials`` (frees device memory).
 
     Called by stores that know their history is going away wholesale
-    (``Trials.delete_all``, pool shutdown); ordinary mutation needs no
-    call — the tids-prefix check catches it.
+    (``Trials.delete_all``, pool shutdown, the netstore/service
+    ``delete_all`` verb); ordinary mutation needs no call — the
+    tids-prefix check catches it.
     """
     with _LOCK:
+        _lru_drop(trials)
         try:
             _STORE.pop(trials, None)
+            _GENS[trials] = _GENS.get(trials, 0) + 1
         except TypeError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# batched (fleet) resident store
+# ---------------------------------------------------------------------------
+
+
+class BatchedResident:
+    """Stacked canonical device buffers for a cohort of experiments.
+
+    The fleet twin of :class:`_Resident`: one set of ``[B, cap, ...]``
+    buffers, one lane per experiment, owned by its
+    :class:`~hyperopt_tpu.fleet.CohortScheduler` cohort (lifetime is the
+    scheduler's problem, so no weak keying here).  Per-lane cursors and
+    tids fingerprints drive the same delta-append / coherence-fallback
+    contract as the solo store.
+    """
+
+    __slots__ = ("b", "cap", "p", "n", "tids", "gens", "filled", "bufs")
+
+    def __init__(self, b: int, cap: int, p: int):
+        self.b = b
+        self.cap = cap
+        self.p = p
+        self.n = [0] * b            # real rows resident per lane
+        self.tids = [None] * b      # per-lane coherence fingerprint
+        self.gens = [0] * b         # per-lane wipe generation (see _GENS)
+        self.filled = [False] * b   # lane ever held real rows?
+        self.bufs = _put((np.zeros((b, cap, p), np.float32),
+                          np.zeros((b, cap, p), bool),
+                          np.full((b, cap), np.inf, np.float32),
+                          np.zeros((b, cap), bool)), None)
+
+
+def _lane_coherent(st: BatchedResident, i: int, h, gen: int) -> bool:
+    return (st.tids[i] is not None
+            and st.gens[i] == gen
+            and st.n[i] <= h["tids"].shape[0]
+            and np.array_equal(st.tids[i], h["tids"][: st.n[i]]))
+
+
+def device_history_batched(store, lanes, n_cap, fantasies=None, gens=None):
+    """Batched history feed for one cohort: returns ``(store, bufs)``
+    with ``bufs = (hv[B,n_cap,P], ha, hl[B,n_cap], hok)`` where lane
+    ``i`` is bit-identical to ``tpe._padded_history(lanes[i], n_cap)``
+    (+ that lane's constant-liar overlay).
+
+    ``lanes`` is a length-B list of ``Trials.history()`` dicts, ``None``
+    marking a padding lane (empty history) and :data:`KEEP` marking an
+    occupied lane whose experiment sits out this dispatch (buffers left
+    untouched, output unused).  ``store`` is the
+    :class:`BatchedResident` returned by the previous call for this
+    cohort, or ``None`` on first touch; lane count / param-width changes
+    rebuild it wholesale, capacity growth is a device pad-copy, and a
+    coherent lane uploads only its delta rows.  ``fantasies`` is an
+    optional length-B list of per-lane overlay specs in
+    :func:`device_history` form (a ``(pv, pa, lie)`` tuple or a list of
+    slot tuples, or ``None``); the overlay lands in a DERIVED copy via a
+    variable-count gather program, leaving the canonical lanes clean.
+    ``gens`` is an optional length-B list of :func:`generation` values —
+    a lane whose generation moved (its trials was wiped via ``forget`` /
+    ``delete_all``) is re-uploaded wholesale even if reused tids happen
+    to prefix-match the stale fingerprint.
+    """
+    b = len(lanes)
+    if gens is None:
+        gens = [0] * b
+    real = [h for h in lanes if isinstance(h, dict)]
+    if not real:
+        raise ValueError("device_history_batched: all lanes are padding")
+    p = real[0]["vals"].shape[1]
+    reg = _registry()
+    if (store is None or store.b != b or store.p != p
+            or store.cap > n_cap):
+        # Shape migration (new cohort tier / param width / capacity
+        # shrink): start clean.  Capacity only ever shrinks when the
+        # cohort key changed, which re-keys the store anyway.
+        store = BatchedResident(b, n_cap, p)
+    elif store.cap < n_cap:
+        store.bufs = _fn("grow_b")(*store.bufs, n_cap)
+        store.cap = n_cap
+    cap = store.cap
+    for i, h in enumerate(lanes):
+        if h is KEEP:
+            # Live experiment sitting out this dispatch: resident rows
+            # and metadata stay put; its output lane is unused.
+            continue
+        if h is None:
+            if store.filled[i]:
+                store.bufs = _fn("clear_b")(*store.bufs, np.int32(i))
+                store.n[i], store.tids[i] = 0, None
+                store.filled[i] = False
+            store.gens[i] = gens[i]
+            continue
+        n = h["vals"].shape[0]
+        if _lane_coherent(store, i, h, gens[i]):
+            k = n - store.n[i]
+            if k > 0:
+                store.bufs = _fn("append_b")(
+                    *store.bufs,
+                    np.ascontiguousarray(h["vals"][store.n[i]:n]),
+                    np.ascontiguousarray(h["active"][store.n[i]:n]),
+                    np.ascontiguousarray(h["loss"][store.n[i]:n]),
+                    np.ascontiguousarray(h["ok"][store.n[i]:n]),
+                    np.int32(i), np.int32(store.n[i]))
+                reg.counter("history.upload_bytes").inc(k * _row_bytes(p))
+            store.n[i], store.tids[i] = n, h["tids"]
+            reg.counter("history.append_hits").inc()
+        else:
+            # First touch, prefix mismatch, or wipe-generation change:
+            # one full-lane re-upload (padded to cap, so it also clears
+            # any stale slack rows).
+            store.bufs = _fn("append_b")(
+                *store.bufs, *_pad_full(h, cap, p), np.int32(i), np.int32(0))
+            store.n[i], store.tids[i] = n, h["tids"]
+            store.filled[i] = True
+            reg.counter("history.rebuilds").inc()
+            reg.counter("history.upload_bytes").inc(cap * _row_bytes(p))
+        store.gens[i] = gens[i]
+        store.filled[i] = store.filled[i] or n > 0
+    out = store.bufs
+    if cap > n_cap:
+        out = _fn("slice_b")(*out, n_cap)
+    if fantasies is not None and any(f is not None for f in fantasies):
+        out = _overlay_batched(out, lanes, fantasies, n_cap, p, reg)
+    return store, out
+
+
+def _overlay_batched(bufs, lanes, fantasies, n_cap, p, reg):
+    """Flatten per-lane fantasy slots into padded ``[B, Mmax, ...]``
+    arrays and apply the gather overlay — slot layout (contiguous from
+    each lane's ``n``, per-slot lie values, capacity clipping + the
+    ``history.fantasy_clipped`` counter) identical to the solo path."""
+    b = len(lanes)
+    rows_v, rows_a, rows_l, start, mcnt = [], [], [], [], []
+    clipped = upload = 0
+    for i in range(b):
+        f = fantasies[i] if i < len(fantasies) else None
+        n = lanes[i]["vals"].shape[0] if isinstance(lanes[i], dict) else 0
+        slots = [] if f is None else (f if isinstance(f, list) else [f])
+        pv_l, pa_l, lie_l = [], [], []
+        for pv, pa, lie in slots:
+            if len(pv):
+                pv_l.append(np.asarray(pv, np.float32))
+                pa_l.append(np.asarray(pa, bool))
+                lie_l.append(np.full(len(pv), lie, np.float32))
+        total = sum(len(v) for v in pv_l)
+        room = max(n_cap - n, 0)
+        m = min(total, room)
+        if total > m:
+            clipped += total - m
+        rows_v.append(np.concatenate(pv_l)[:m] if total
+                      else np.zeros((0, p), np.float32))
+        rows_a.append(np.concatenate(pa_l)[:m] if total
+                      else np.zeros((0, p), bool))
+        rows_l.append(np.concatenate(lie_l)[:m] if total
+                      else np.zeros((0,), np.float32))
+        start.append(n)
+        mcnt.append(m)
+        upload += m * (p * 4 + p + 4)
+    mmax = max(max(mcnt), 1)
+    pvz = np.zeros((b, mmax, p), np.float32)
+    paz = np.zeros((b, mmax, p), bool)
+    liez = np.zeros((b, mmax), np.float32)
+    for i in range(b):
+        m = mcnt[i]
+        if m:
+            pvz[i, :m] = rows_v[i]
+            paz[i, :m] = rows_a[i]
+            liez[i, :m] = rows_l[i]
+    if clipped:
+        reg.counter("history.fantasy_clipped").inc(clipped)
+    if not any(mcnt):
+        return bufs
+    reg.counter("history.upload_bytes").inc(upload)
+    return _fn("overlay_b")(*bufs, pvz, paz, liez,
+                            np.asarray(start, np.int32),
+                            np.asarray(mcnt, np.int32))
+
+
+def pregrow_batched(store, n_cap):
+    """Roll a cohort's stacked buffers to ``n_cap`` ahead of the bucket
+    flip (the batch-dim twin of :func:`pregrow`): pure device pad-copy,
+    zero host→device bytes.  No-op when cold or already big."""
+    if store is None or store.cap >= n_cap:
+        return store
+    store.bufs = _fn("grow_b")(*store.bufs, n_cap)
+    store.cap = n_cap
+    return store

@@ -66,7 +66,7 @@ from urllib.error import HTTPError, URLError
 from urllib.request import Request, urlopen
 
 from .filestore import FileTrials, FileWorker, _pickler
-from ..base import JOB_STATE_RUNNING, Trials
+from ..base import JOB_STATE_RUNNING, Trials, docs_from_samples
 from ..exceptions import InjectedFault, NetstoreUnavailable, QuotaExceeded
 from ..obs import context as _context
 from ..obs import metrics as _metrics
@@ -624,7 +624,15 @@ class StoreServer:
 
         Thin-client protocol: the driver only needs ``suggest`` (with
         insert), ``docs`` and the result verbs — no JAX client-side.
+
+        ``_fleet_rows`` carries pre-computed proposal rows from the
+        ServiceServer cohort gate's fleet dispatch, so this verb only
+        packages docs instead of running the algorithm again.  A wire
+        client supplying it merely dictates its own proposals — the same
+        privilege ``insert_docs`` already grants — so it needs no trust
+        boundary beyond the normal auth gate.
         """
+        fleet_rows = req.pop("_fleet_rows", None)
         algo_name = req.get("algo", "tpe")
         algo = self._server_algos().get(algo_name)
         if algo is None:
@@ -655,7 +663,15 @@ class StoreServer:
             self._charge_admission(tenant, len(new_ids))
         domain = self._domain_for(ft)
         ft.refresh()
-        docs = algo(new_ids, domain, ft, int(req["seed"]), **kw)
+        if fleet_rows is not None:
+            import numpy as _np
+
+            rows = _np.asarray(fleet_rows, _np.float32)[: len(new_ids)]
+            acts = domain.cs.active_mask_host(rows)
+            docs = docs_from_samples(domain.cs, new_ids, rows, acts,
+                                     exp_key=getattr(ft, "exp_key", None))
+        else:
+            docs = algo(new_ids, domain, ft, int(req["seed"]), **kw)
         # JSON roundtrip now, inside the lock: the reply the client sees
         # is exactly what a WAL replay would re-insert, and the docs the
         # server stores are plain JSON types like every other doc.

@@ -21,6 +21,12 @@ queue.  Stages per batch (one pipeline slot)::
   attribution, so the fetch sync (~66 ms through the axon tunnel)
   overlaps the objective instead of serializing against it.  Algos
   without those attributes degrade to a blocking (sync) materialize.
+  Handles are opaque to the executor: ``fleet.CohortScheduler.algo()``
+  returns the same four halves over cohort handles (a shared device
+  dispatch serving many experiments), so fleet-batched suggestion
+  pipelines identically to solo ``tpe.suggest`` — including the
+  start-transfer/ready protocol, which fleet implements per-cohort
+  (one fetch sync amortized over every lane in the dispatch).
 * **Scheduling** — one completion is recorded per loop step; the
   evaluator is fed whenever ``open trials <= feed floor`` so a worker
   never starves while host glue (materialize/insert/record/dispatch)

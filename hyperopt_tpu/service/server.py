@@ -34,6 +34,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 
 from .. import faults as _faults
@@ -47,6 +48,172 @@ from .wal import Wal, read_wal
 logger = logging.getLogger(__name__)
 
 __all__ = ["ServiceServer", "main"]
+
+# Millisecond-unit histogram bounds (50µs .. ~26s, ×2/bucket) — the same
+# convention as tpe's suggest.*_ms series, duplicated here so the service
+# module keeps its no-JAX-import property until a cohort actually forms.
+_MS_BUCKETS = tuple(0.05 * (2.0 ** i) for i in range(20))
+
+
+class _GateEntry:
+    """One suggest call waiting at the cohort gate."""
+
+    __slots__ = ("tname", "exp_key", "n", "seed", "algo", "rows", "done")
+
+    def __init__(self, tname, exp_key, n, seed, algo):
+        self.tname = tname
+        self.exp_key = exp_key
+        self.n = n
+        self.seed = seed
+        self.algo = algo
+        self.rows = None
+        self.done = False
+
+
+class _CohortGate:
+    """Hold concurrent tenants' ``suggest`` verbs for up to
+    ``window_ms`` and serve the whole window from ONE fleet dispatch.
+
+    Leader/follower protocol: the first suggest to arrive becomes the
+    window leader and sleeps out the window on the gate condvar (lock
+    released while waiting, so followers enqueue freely); at the
+    deadline it snapshots every member's store under the server lock,
+    runs one :class:`~hyperopt_tpu.fleet.CohortScheduler` dispatch, and
+    hands each member its proposal rows.  Members that cannot batch —
+    custom algorithm knobs, a second call against the same (tenant,
+    exp_key) inside one window, a window with fewer than two members —
+    get ``None`` back and run the ordinary solo verb, so the gate can
+    only ever *add* batching, never change semantics: injected rows are
+    bit-identical to the solo computation against the same history
+    snapshot (tests/test_fleet.py pins this through the service).
+
+    Latency-vs-throughput: every gated suggest pays up to ``window_ms``
+    of queueing (observed in the ``fleet.window_wait_ms`` histogram) to
+    buy one device dispatch per window instead of one per tenant —
+    docs/DESIGN.md §6 quantifies the trade.
+    """
+
+    def __init__(self, server, window_ms: float):
+        self.server = server
+        self.window_s = max(float(window_ms), 0.0) / 1e3
+        self._cv = threading.Condition()
+        self._batch: list[_GateEntry] = []
+        self._leader = False
+        self._scheds: dict = {}
+
+    def _scheduler(self, algo: str):
+        """Per-algo CohortScheduler, built lazily (first cohort pays the
+        JAX import, idle services never do).  Scheduler knobs must equal
+        the solo verb's defaults — that is what makes injected rows
+        bit-identical to the fallback path."""
+        sched = self._scheds.get(algo)
+        if sched is None:
+            from .. import fleet
+            split = "quantile" if algo == "tpe_quantile" else "sqrt"
+            sched = self._scheds[algo] = fleet.CohortScheduler(split=split)
+        return sched
+
+    def submit(self, req: dict, tenant):
+        """Queue one suggest verb; block until its window's dispatch
+        resolves.  Returns host proposal rows ``[n, P]`` or ``None``
+        (caller runs the solo path)."""
+        algo = req.get("algo", "tpe")
+        if (algo not in ("tpe", "tpe_quantile") or "seed" not in req
+                or any(k in req for k in StoreServer._SUGGEST_KW)):
+            return None
+        tname = getattr(tenant, "name", tenant)
+        exp_key = req.get("exp_key", "default")
+        nid = req.get("new_ids")
+        n = len(nid) if nid is not None else int(req.get("n", 1))
+        entry = _GateEntry(tname, exp_key, n, int(req["seed"]), algo)
+        t0 = time.perf_counter()
+        with self._cv:
+            if any(e.tname == tname and e.exp_key == exp_key
+                   for e in self._batch):
+                # Same store twice in one window: one lane = one history
+                # snapshot, so the duplicate runs solo.
+                return None
+            self._batch.append(entry)
+            if self._leader:
+                # Follower: the leader will resolve this entry.
+                limit = self.window_s * 4 + 30.0
+                deadline = time.monotonic() + limit
+                while not entry.done:
+                    if not self._cv.wait(deadline - time.monotonic()):
+                        try:    # leader wedged — bail out to solo
+                            self._batch.remove(entry)
+                        except ValueError:
+                            pass
+                        entry.done = True
+                        break
+                self._observe_wait(t0)
+                return entry.rows
+            self._leader = True
+            deadline = time.monotonic() + self.window_s
+            while True:
+                rem = deadline - time.monotonic()
+                if rem <= 0:
+                    break
+                self._cv.wait(rem)
+            batch, self._batch = self._batch, []
+            self._leader = False
+        try:
+            if len(batch) >= 2:
+                self._compute(batch)
+        except Exception:           # pragma: no cover - defensive
+            logger.exception("cohort gate dispatch failed; falling back "
+                             "to solo suggests")
+            for e in batch:
+                e.rows = None
+        finally:
+            with self._cv:
+                for e in batch:
+                    e.done = True
+                self._cv.notify_all()
+        self._observe_wait(t0)
+        return entry.rows
+
+    @staticmethod
+    def _observe_wait(t0):
+        _metrics.registry().histogram(
+            "fleet.window_wait_ms", buckets=_MS_BUCKETS).observe(
+                (time.perf_counter() - t0) * 1e3)
+
+    def _compute(self, batch):
+        """Snapshot member stores under the server lock, then resolve
+        one fleet dispatch per algo group.  Row forcing (the device
+        sync) happens OUTSIDE the server lock so other verbs keep
+        flowing while the device computes."""
+        server = self.server
+        groups: dict = {}
+        with server._lock:
+            for e in batch:
+                try:
+                    ft = server._store(e.exp_key, tenant=e.tname)
+                    domain = server._domain_for(ft)
+                    ft.refresh()
+                except Exception:
+                    continue            # no domain yet etc. → solo
+                # Placeholder ids: proposal rows depend only on the id
+                # COUNT (ids are packaged into docs later, by the verb).
+                groups.setdefault(e.algo, []).append(
+                    (e, (list(range(e.n)), domain, ft, e.seed)))
+            handles = {}
+            for algo, members in groups.items():
+                hs = self._scheduler(algo).suggest_dispatch(
+                    [r for _, r in members])
+                for (e, _), hd in zip(members, hs):
+                    handles[id(e)] = hd
+        from .. import tpe
+        for e in batch:
+            hd = handles.get(id(e))
+            if hd is None:
+                continue
+            if hd[0] == "fleet":
+                result, lane = hd[3]
+                e.rows = result.force()[lane][: e.n]
+            else:
+                e.rows = tpe._force_rows(hd)[0]
 
 
 def _strip_req(req: dict) -> dict:
@@ -76,12 +243,17 @@ class ServiceServer(StoreServer):
                  token: str | None = None, tenants=None,
                  fsync: str = "always", snapshot_every: int | None = None,
                  requeue_stale_every: float | None = None,
-                 stale_timeout: float = 60.0):
+                 stale_timeout: float = 60.0,
+                 cohort_window_ms: float | None = None):
         self.wal_root = os.path.abspath(wal_dir)
         self._replaying = False
         self._wal = Wal(self.wal_root, fsync=fsync)
         self._snapshot_every = snapshot_every
         self._snap_seq = 0
+        # Fleet mode: hold concurrent tenants' suggests up to this many
+        # milliseconds and serve the window from ONE vmapped dispatch.
+        self._cohort_gate = (_CohortGate(self, cohort_window_ms)
+                             if cohort_window_ms else None)
         super().__init__(self.wal_root, host=host, port=port, token=token,
                          requeue_stale_every=requeue_stale_every,
                          stale_timeout=stale_timeout, tenants=tenants)
@@ -104,6 +276,14 @@ class ServiceServer(StoreServer):
         if self._replaying or verb not in self._WAL_VERBS:
             return super()._dispatch_verb(verb, req, tenant=tenant,
                                           idem=idem)
+        if verb == "suggest" and self._cohort_gate is not None:
+            # Coalesce with concurrent tenants BEFORE taking the server
+            # lock (the gate blocks up to the window).  Injected rows
+            # turn the pure-compute step of _suggest_walled into doc
+            # packaging; the WAL decomposition is unchanged.
+            rows = self._cohort_gate.submit(req, tenant)
+            if rows is not None:
+                req = dict(req, _fleet_rows=rows)
         tname = getattr(tenant, "name", tenant)
         exp_key = req.get("exp_key", "default")
         with self._lock:
@@ -335,6 +515,11 @@ def main(argv=None):
     p.add_argument("--requeue-stale-every", type=float, default=None,
                    metavar="S")
     p.add_argument("--stale-timeout", type=float, default=60.0)
+    p.add_argument("--cohort-window-ms", type=float, default=None,
+                   metavar="MS",
+                   help="fleet mode: hold concurrent tenants' suggest "
+                        "verbs up to MS and serve each window from one "
+                        "vmapped cohort dispatch (0/unset: off)")
     args = p.parse_args(argv)
 
     tenants = None
@@ -347,7 +532,8 @@ def main(argv=None):
                            fsync=args.fsync,
                            snapshot_every=args.snapshot_every,
                            requeue_stale_every=args.requeue_stale_every,
-                           stale_timeout=args.stale_timeout)
+                           stale_timeout=args.stale_timeout,
+                           cohort_window_ms=args.cohort_window_ms)
     print(f"service: serving {args.wal_dir} at {server.url}", flush=True)
 
     import signal

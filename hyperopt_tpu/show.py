@@ -261,7 +261,7 @@ def _hist_row(name, h):
     if not h or not h.get("count"):
         return None
     ms = lambda v: f"{1e3 * v:8.2f}" if v is not None else "       -"  # noqa: E731
-    return (f"  {name:<28s} {h['count']:>7d} {ms(h.get('p50'))} "
+    return (f"  {name:<28s} {int(h['count']):>7d} {ms(h.get('p50'))} "
             f"{ms(h.get('p95'))} {ms(h.get('p99'))}")
 
 
@@ -297,6 +297,21 @@ def render_live(snap: dict, out=None, prev=None) -> dict:
     if occ is not None or backlog is not None:
         print(f"pipeline: occupancy {occ if occ is not None else '-'}   "
               f"eval backlog {backlog if backlog is not None else '-'}",
+              file=out)
+    # Cohort occupancy of the fleet dispatch path: how full the last
+    # vmap-batched dispatch ran (real lanes / pow2 tier) and the padding
+    # it paid, plus aggregate dispatch/suggestion volume.
+    disp = counters.get("fleet.dispatches", 0)
+    if disp:
+        size = gauges.get("fleet.cohort_size_last",
+                          m_gauges.get("fleet.cohort_size_last", 0))
+        tier = gauges.get("fleet.cohort_tier_last",
+                          m_gauges.get("fleet.cohort_tier_last", 0))
+        waste = gauges.get("fleet.padding_waste",
+                           m_gauges.get("fleet.padding_waste", 0.0))
+        print(f"cohorts: last {int(size)}/{int(tier)} lanes   "
+              f"padding {waste:.0%}   dispatches {int(disp)}   "
+              f"suggestions {int(counters.get('fleet.suggestions', 0))}",
               file=out)
     faults = counters.get("faults.injected", 0)
     requeued = counters.get("store.requeued", 0)
@@ -348,9 +363,9 @@ def render_live(snap: dict, out=None, prev=None) -> dict:
             held = gauges.get(f"netstore.tenant.{tname}.claims_held",
                               m_gauges.get(
                                   f"netstore.tenant.{tname}.claims_held"))
-            print(f"  {tname:<20s} {rec['calls']:>8d} "
+            print(f"  {tname:<20s} {int(rec['calls']):>8d} "
                   f"{held if held is not None else '-':>7} "
-                  f"{rec['rate_rej']:>9d} {rec['claims_rej']:>10d}",
+                  f"{int(rec['rate_rej']):>9d} {int(rec['claims_rej']):>10d}",
                   file=out)
 
     workers = fleet.get("workers", {})
@@ -363,7 +378,7 @@ def render_live(snap: dict, out=None, prev=None) -> dict:
             wg = w.get("gauges", {})
             stale = "  STALE" if age > 30.0 else ""
             print(f"  {wid:<28s} age {age:6.1f}s  trials "
-                  f"{wc.get('worker.trials', 0):>5d}  fails "
+                  f"{int(wc.get('worker.trials', 0)):>5d}  fails "
                   f"{wg.get('worker.consecutive_failures', 0)}{stale}",
                   file=out)
     return (now, done)

@@ -884,6 +884,56 @@ class _TpeKernel:
             np.uint32(seed), np.int32(n_rows), vals, active, loss, ok,
             np.float32(gamma), np.float32(prior_weight))
 
+    # -- fleet (cohort) entry ------------------------------------------------
+
+    def _fleet_fn(self, m):
+        """Build (and cache) the jitted VMAPPED cohort entry: B lanes ×
+        m proposals in one device program.
+
+        The per-lane body is exactly the solo seeded program — the
+        single-proposal ``_seeded_one`` when ``m == 1``, the key-split +
+        liar-scan chain of :meth:`_batch_seeded_fn` when ``m > 1`` — so
+        every lane of the vmapped run is bit-identical to that
+        experiment's solo suggest (pinned by tests/test_fleet.py).
+        ``jax.jit`` retraces per distinct lane count B, so compiles are
+        one per ``(n_cap, P, m, B-tier)``; fleet.CohortScheduler rounds B
+        up to pow2 tiers to bound that to O(log fleet).
+        """
+        fn = self._batch_fns.get(("fleet", m))
+        if fn is None:
+            if m == 1:
+                def one(seed, n_rows, hv, ha, hl, hok, gamma, pw):
+                    row, act = self._seeded_one(seed, hv, ha, hl, hok,
+                                                gamma, pw)
+                    return row[None], act[None]
+            else:
+                def one(seed, n_rows, hv, ha, hl, hok, gamma, pw):
+                    keys = jax.random.split(prng_key(seed), m)
+                    return self._liar_scan(keys, n_rows, hv, ha, hl, hok,
+                                           gamma, pw)
+
+            fn = self._batch_fns[("fleet", m)] = jax.jit(jax.vmap(one))
+        return fn
+
+    def suggest_fleet_seeded(self, seeds, m, n_rows, hv, ha, hl, hok,
+                             gamma, prior_weight):
+        """Cohort suggest: ``(rows[B, m, P], acts[B, m, P])`` from stacked
+        ``[B, n_cap, ...]`` history lanes, per-lane integer seeds and
+        insertion cursors ``n_rows[B]``.  Per-lane gamma/prior_weight
+        arrays let mixed experiment configs share a dispatch."""
+        b = len(seeds)
+        seen = getattr(self, "_fleet_tiers", None)
+        if seen is None:
+            seen = self._fleet_tiers = set()
+        tier = ("fleet", self.n_cap, self.cs.n_params, m, b)
+        kernel_cache_event(tier, tier in seen)
+        seen.add(tier)
+        return self._fleet_fn(m)(
+            np.asarray(seeds, np.uint32), np.asarray(n_rows, np.int32),
+            hv, ha, hl, hok,
+            np.asarray(gamma, np.float32),
+            np.asarray(prior_weight, np.float32))
+
 
 # ---------------------------------------------------------------------------
 # kernel cache & history padding

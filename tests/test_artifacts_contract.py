@@ -135,6 +135,50 @@ class TestBenchmarkArtifacts:
                 "the 1.5x acceptance bar")
             assert head["meets_1p5x"] is True
 
+    def test_fleet_ab_artifact_schema(self):
+        """ISSUE 8 acceptance artifact: serial vs vmap-cohort aggregate
+        suggestion throughput per cohort size, the per-experiment parity
+        bit, the one-compile-per-tier proof, and the ≥10x-at-cohort-≥16
+        headline under the tunnel attachment model — written by
+        benchmarks/fleet_ab.py."""
+        paths = sorted(glob.glob(os.path.join(_BENCH_DIR, "fleet_ab_*.json")))
+        assert paths, "no benchmarks/fleet_ab_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "fleet_aggregate_suggestions_per_sec", \
+                name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            assert doc["rows"], f"{name}: empty rows"
+            for r in doc["rows"]:
+                assert {"cohort", "fetch_sim_ms",
+                        "serial_suggestions_per_sec",
+                        "cohort_suggestions_per_sec", "speedup",
+                        "dispatches_per_sec", "padding_waste",
+                        "kernel_compiles_steady",
+                        "parity_bit_identical"} <= set(r), f"{name}: {r}"
+                assert r["cohort"] in doc["cohorts"], name
+                assert r["fetch_sim_ms"] in doc["fetch_sim_ms"], name
+                assert 0.0 <= r["padding_waste"] < 1.0, f"{name}: {r}"
+                assert r["parity_bit_identical"] is True, (
+                    f"{name}: cohort proposals diverged from solo "
+                    f"tpe.suggest at B={r['cohort']}")
+                assert r["kernel_compiles_steady"] == 0, (
+                    f"{name}: steady-state dispatch recompiled at "
+                    f"B={r['cohort']} — the one-compile-per-tier "
+                    "contract is broken")
+            # every (cohort, fetch_sim_ms) cell is present
+            assert len(doc["rows"]) == (len(doc["cohorts"])
+                                        * len(doc["fetch_sim_ms"])), name
+            head = doc["headline"]
+            assert head["meets_10x_at_16plus"] is True, (
+                f"{name}: tunnel-arm speedup below the 10x acceptance "
+                f"bar at cohort >= 16 (headline {head['speedup']})")
+            assert head["parity_all_rows"] is True, name
+            assert head["steady_compiles_all_zero"] is True, name
+
     def test_faults_overhead_artifact_schema(self):
         """ISSUE 5 acceptance artifact: the fault-injection hooks' paired
         A/B (disabled vs armed-at-zero-prob) with the maybe_fail

@@ -1,0 +1,510 @@
+"""Fleet mode (hyperopt_tpu/fleet.py): vmap-batched TPE cohorts.
+
+The load-bearing contract from ISSUE 8 is **per-experiment bit-parity**:
+an experiment served through a cohort dispatch must receive byte-equal
+proposals to what solo ``tpe.suggest`` would have produced for it, for
+every cohort size (1 / 2 / pow2-padded), across evolving histories
+(delta-append rounds), and with constant-liar overlay slots (n>1
+liar-scan members).  Pinned here per layer:
+
+* ``history.device_history_batched`` — lane contents bit-identical to
+  ``tpe._padded_history`` (+ overlay); delta appends upload O(k·P) not
+  O(n_cap·P); ``KEEP`` lanes are untouched; padding lanes cleared;
+  wipe-generation mismatch (``delete_all`` + tid reuse) forces a lane
+  rebuild instead of silently accepting a stale prefix.
+* ``CohortScheduler`` — end-to-end parity through bucketing, padding,
+  startup fallback, singleton fallback, and duplicate-trials fallback.
+* Kernel cache — one compile per ``(n_cap, P, m, B-tier)``, proven by
+  ``kernel_cache_stats`` counters across repeat dispatches.
+* Resident-store LRU cap (``HYPEROPT_TPU_RESIDENT_HISTORY_CAP``) and the
+  ``history.evicted`` counter.
+* ``CohortScheduler.algo()`` — drops into ``fmin`` (plain and depth-D
+  pipelined) via the four-halves pipeline contract.
+* Service cohort gate — concurrent tenants coalesce into one device
+  dispatch with unchanged per-tenant WAL decomposition (replay
+  byte-identity).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import base, fleet, hp, rand, tpe
+from hyperopt_tpu import history as rhist
+from hyperopt_tpu.base import Domain, JOB_STATE_DONE
+from hyperopt_tpu.fmin import fmin
+from hyperopt_tpu.obs.metrics import kernel_cache_stats, registry
+
+
+def _domain(labels=("x", "lr", "c", "a")):
+    x, lr, c, a = labels
+    space = {
+        x: hp.uniform(x, -5, 5),
+        lr: hp.loguniform(lr, -6, 0),
+        c: hp.choice(c, [{a: hp.normal(a, 0, 1)}, {"k": 2}]),
+    }
+    return Domain(lambda d: d[x] ** 2, space)
+
+
+def _run_exp(dom, n, seed0, trials=None):
+    t = trials if trials is not None else base.Trials()
+    rng = np.random.default_rng(seed0)
+    start = len(t._dynamic_trials)
+    for i in range(n):
+        t.insert_trial_docs(
+            rand.suggest([start + i], dom, t, int(rng.integers(2**31))))
+        t.refresh()
+        d = t._dynamic_trials[-1]
+        d["state"] = JOB_STATE_DONE
+        d["result"] = {"status": "ok", "loss": float(rng.normal())}
+    t.refresh()
+    return t
+
+
+def _vals(docs):
+    return [(d["tid"], {k: [float(x) for x in v]
+                       for k, v in d["misc"]["vals"].items()})
+            for d in docs]
+
+
+def _counter(name):
+    return registry().snapshot()["counters"].get(name, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# signatures and tiers
+# ---------------------------------------------------------------------------
+
+
+class TestSignatureAndTiers:
+    def test_signature_ignores_labels(self):
+        # Cohorts bucket by search-space STRUCTURE; parameter labels are
+        # presentation and must not split otherwise-identical tenants.
+        a = fleet.space_signature(_domain().cs)
+        b = fleet.space_signature(_domain(("y", "mom", "arch", "w")).cs)
+        assert a == b
+
+    def test_signature_sees_structure(self):
+        a = fleet.space_signature(_domain().cs)
+        dom2 = Domain(lambda d: 0.0, {"x": hp.uniform("x", -1, 1)})
+        assert a != fleet.space_signature(dom2.cs)
+
+    def test_cohort_tier_pow2(self):
+        assert [fleet.cohort_tier(b) for b in (1, 2, 3, 4, 5, 8, 9)] == \
+            [1, 2, 4, 4, 8, 8, 16]
+
+
+# ---------------------------------------------------------------------------
+# batched resident store
+# ---------------------------------------------------------------------------
+
+
+class TestBatchedHistory:
+    @staticmethod
+    def _ref_lane(h, n_cap, p, fant=None):
+        if h is None:
+            return (np.zeros((n_cap, p), np.float32),
+                    np.zeros((n_cap, p), bool),
+                    np.full((n_cap,), np.inf, np.float32),
+                    np.zeros((n_cap,), bool))
+        ref = tuple(np.array(x) for x in tpe._padded_history(h, n_cap))
+        if fant is not None:
+            rv, ra, rl, rk = ref
+            slots = fant if isinstance(fant, list) else [fant]
+            pos = h["vals"].shape[0]
+            for pv, pa, lie in slots:
+                m = min(len(pv), n_cap - pos)
+                rv[pos:pos + m] = pv[:m]
+                ra[pos:pos + m] = pa[:m]
+                rl[pos:pos + m] = lie
+                rk[pos:pos + m] = True
+                pos += m
+            ref = (rv, ra, rl, rk)
+        return ref
+
+    def _assert_lanes(self, bufs, lanes, n_cap, fantasies=None):
+        hv, ha, hl, hok = [np.asarray(a) for a in bufs]
+        for i, h in enumerate(lanes):
+            assert not isinstance(h, rhist._Keep)
+            f = fantasies[i] if fantasies is not None else None
+            ref = self._ref_lane(h, n_cap, hv.shape[2], f)
+            np.testing.assert_array_equal(hv[i], ref[0], err_msg=f"lane {i}")
+            np.testing.assert_array_equal(ha[i], ref[1], err_msg=f"lane {i}")
+            np.testing.assert_array_equal(hl[i], ref[2], err_msg=f"lane {i}")
+            np.testing.assert_array_equal(hok[i], ref[3], err_msg=f"lane {i}")
+
+    def test_lane_parity_delta_grow_overlay_generation(self):
+        dom = _domain()
+        cs = dom.cs
+        exps = [_run_exp(dom, n, s) for n, s in [(10, 1), (17, 2), (3, 3)]]
+        lanes = [t.history(cs) for t in exps] + [None]
+
+        st, bufs = rhist.device_history_batched(None, lanes, 32)
+        self._assert_lanes(bufs, lanes, 32)
+
+        # delta append: lanes 0,1 extended, lane 2 untouched — upload is
+        # O(k rows), nowhere near a full 4-lane re-upload.
+        _run_exp(dom, 4, 11, trials=exps[0])
+        _run_exp(dom, 2, 12, trials=exps[1])
+        lanes = [t.history(cs) for t in exps] + [None]
+        up0 = _counter("history.upload_bytes")
+        st, bufs = rhist.device_history_batched(st, lanes, 32)
+        self._assert_lanes(bufs, lanes, 32)
+        p = lanes[0]["vals"].shape[1]
+        assert _counter("history.upload_bytes") - up0 <= 8 * rhist._row_bytes(p)
+
+        # capacity growth is a device pad-copy, lanes stay bit-identical
+        _run_exp(dom, 20, 13, trials=exps[1])
+        lanes = [t.history(cs) for t in exps] + [None]
+        st, bufs = rhist.device_history_batched(st, lanes, 64)
+        self._assert_lanes(bufs, lanes, 64)
+
+        # multi-slot constant-liar overlay; canonical buffers unharmed
+        rng = np.random.default_rng(0)
+        pv1 = rng.normal(size=(3, p)).astype(np.float32)
+        pv2 = rng.normal(size=(2, p)).astype(np.float32)
+        ones = np.ones((3, p), bool)
+        fant = [[(pv1, ones, 0.5), (pv2, ones[:2], 0.7)], None,
+                (pv2, ones[:2], 1.5), None]
+        st, bufs = rhist.device_history_batched(st, lanes, 64, fantasies=fant)
+        self._assert_lanes(bufs, lanes, 64, fantasies=fant)
+        st, bufs = rhist.device_history_batched(st, lanes, 64)
+        self._assert_lanes(bufs, lanes, 64)
+
+        # delete_all + reinsert reuses tids 0..k: the stale fingerprint
+        # prefix-matches, so only the wipe generation catches it.
+        g0 = rhist.generation(exps[2])
+        exps[2].delete_all()
+        assert rhist.generation(exps[2]) == g0 + 1
+        _run_exp(dom, 5, 14, trials=exps[2])
+        lanes = [t.history(cs) for t in exps] + [None]
+        gens = [rhist.generation(t) for t in exps] + [0]
+        r0 = _counter("history.rebuilds")
+        st, bufs = rhist.device_history_batched(st, lanes, 64, gens=gens)
+        self._assert_lanes(bufs, lanes, 64)
+        assert _counter("history.rebuilds") >= r0 + 1
+
+        # occupied lane departs → padding lane is CLEARED
+        lanes2 = [lanes[0], None, lanes[2], None]
+        st, bufs = rhist.device_history_batched(st, lanes2, 64, gens=gens)
+        self._assert_lanes(bufs, lanes2, 64)
+
+        # pregrow: pure device pad-copy, later calls delta-append into it
+        st = rhist.pregrow_batched(st, 128)
+        assert st.cap == 128
+        lanes = [t.history(cs) for t in exps] + [None]
+        st, bufs = rhist.device_history_batched(st, lanes, 128, gens=gens)
+        self._assert_lanes(bufs, lanes, 128)
+
+    def test_keep_lane_preserved(self):
+        # KEEP marks an occupied lane sitting out a dispatch: its buffers
+        # and delta cursor survive, so the NEXT dispatch it joins is still
+        # a cheap delta append, not a rebuild.
+        dom = _domain()
+        cs = dom.cs
+        a, b = _run_exp(dom, 8, 21), _run_exp(dom, 6, 22)
+        lanes = [a.history(cs), b.history(cs)]
+        st, _ = rhist.device_history_batched(None, lanes, 32)
+
+        keep_lanes = [rhist.KEEP, b.history(cs)]
+        st, bufs = rhist.device_history_batched(st, keep_lanes, 32)
+        hv = np.asarray(bufs[0])
+        ref = tpe._padded_history(lanes[0], 32)
+        np.testing.assert_array_equal(hv[0], np.array(ref[0]))
+
+        _run_exp(dom, 2, 23, trials=a)
+        lanes = [a.history(cs), b.history(cs)]
+        r0 = _counter("history.rebuilds")
+        st, bufs = rhist.device_history_batched(st, lanes, 32)
+        self._assert_lanes(bufs, lanes, 32)
+        assert _counter("history.rebuilds") == r0
+
+
+# ---------------------------------------------------------------------------
+# cohort scheduler parity
+# ---------------------------------------------------------------------------
+
+
+class TestCohortParity:
+    B = 5  # pads to tier 8
+
+    def _setup(self):
+        doms = [_domain() for _ in range(self.B)]
+        exps = [_run_exp(doms[i], 22 + i, 10 + i) for i in range(self.B)]
+        seeds = [1000 + 7 * i for i in range(self.B)]
+        return doms, exps, seeds
+
+    def test_padded_cohort_and_evolution_and_liar_scan(self):
+        doms, exps, seeds = self._setup()
+
+        def solo(n, bump):
+            out = []
+            for i in range(self.B):
+                nid = len(exps[i]._dynamic_trials)
+                out.append(_vals(tpe.suggest(
+                    list(range(nid, nid + n)), doms[i], exps[i],
+                    seeds[i] + bump)))
+            return out
+
+        def cohort(sched, n, bump):
+            reqs = [(list(range(len(exps[i]._dynamic_trials),
+                               len(exps[i]._dynamic_trials) + n)),
+                     doms[i], exps[i], seeds[i] + bump)
+                    for i in range(self.B)]
+            return [_vals(d) for d in sched.suggest(reqs)]
+
+        sched = fleet.CohortScheduler()
+        ref = solo(1, 0)
+        assert cohort(sched, 1, 0) == ref
+        assert registry().snapshot()["gauges"]["fleet.padding_waste"] == \
+            pytest.approx((8 - self.B) / 8)
+
+        # evolve every history and go again: the delta-append round
+        for i in range(self.B):
+            d = exps[i]._dynamic_trials[-1]
+            d["state"] = JOB_STATE_DONE
+            d["result"] = {"status": "ok", "loss": 0.1 * i}
+            exps[i].refresh()
+        assert cohort(sched, 1, 1) == solo(1, 1)
+
+        # n=3 members → m=4 constant-liar scan inside each lane
+        assert cohort(sched, 3, 2) == solo(3, 2)
+
+    def test_cohort_of_two(self):
+        doms, exps, seeds = self._setup()
+        solo = [_vals(tpe.suggest([len(exps[i]._dynamic_trials)], doms[i],
+                                  exps[i], seeds[i])) for i in range(2)]
+        sched = fleet.CohortScheduler()
+        reqs = [([len(exps[i]._dynamic_trials)], doms[i], exps[i], seeds[i])
+                for i in range(2)]
+        assert [_vals(d) for d in sched.suggest(reqs)] == solo
+
+    def test_singleton_falls_back_solo(self):
+        dom = _domain()
+        t = _run_exp(dom, 25, 5)
+        nid = len(t._dynamic_trials)
+        ref = _vals(tpe.suggest([nid], dom, t, 99))
+        sched = fleet.CohortScheduler()
+        hd = sched.suggest_dispatch([([nid], dom, t, 99)])
+        assert hd[0][0] != "fleet"
+        assert _vals(fleet.suggest_materialize(hd[0])) == ref
+
+    def test_startup_member_falls_back_to_rand(self):
+        dom = _domain()
+        t = _run_exp(dom, 3, 99)  # < n_startup_jobs
+        doms, exps, seeds = self._setup()
+        reqs = [([len(exps[i]._dynamic_trials)], doms[i], exps[i], seeds[i])
+                for i in range(2)] + [([3], dom, t, 7)]
+        sched = fleet.CohortScheduler()
+        hd = sched.suggest_dispatch(reqs)
+        assert hd[2][0] != "fleet"
+        ref = rand.suggest([3], dom, t, 7)
+        assert _vals(fleet.suggest_materialize(hd[2])) == _vals(ref)
+
+    def test_duplicate_trials_in_batch_fall_back(self):
+        # Two requests against the SAME trials object cannot share a
+        # cohort lane; the second must take the solo path, both stay
+        # bit-correct.
+        dom = _domain()
+        t = _run_exp(dom, 25, 6)
+        nid = len(t._dynamic_trials)
+        r1 = _vals(tpe.suggest([nid], dom, t, 31))
+        r2 = _vals(tpe.suggest([nid + 1], dom, t, 32))
+        sched = fleet.CohortScheduler()
+        out = sched.suggest([([nid], dom, t, 31), ([nid + 1], dom, t, 32)])
+        assert [_vals(d) for d in out] == [r1, r2]
+
+    def test_one_compile_per_tier(self):
+        doms, exps, seeds = self._setup()
+        kernel_cache_stats(reset=True)
+        sched = fleet.CohortScheduler()
+        reqs = [([len(exps[i]._dynamic_trials)], doms[i], exps[i], seeds[i])
+                for i in range(self.B)]
+        for hd in sched.suggest_dispatch(reqs):
+            fleet.suggest_materialize(hd)
+        mid = kernel_cache_stats()
+        for hd in sched.suggest_dispatch(
+                [(ids, d, t, s + 1) for ids, d, t, s in reqs]):
+            fleet.suggest_materialize(hd)
+        stats = kernel_cache_stats()
+        tiers = {k: v for k, v in stats["by_key"].items()
+                 if k.startswith("('fleet'")}
+        # both dispatches share one (n_cap, P, m, B-tier) key, and the
+        # repeat dispatch adds a request but NO compile
+        assert len(tiers) == 1
+        (per,) = tiers.values()
+        assert per["requests"] == 2
+        assert stats["misses"] == mid["misses"]
+
+
+# ---------------------------------------------------------------------------
+# resident-store LRU cap
+# ---------------------------------------------------------------------------
+
+
+class TestResidentLRU:
+    def test_cap_evicts_coldest(self, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_RESIDENT_HISTORY_CAP", "2")
+        dom = _domain()
+        cs = dom.cs
+        ts = [_run_exp(dom, 6, 40 + i) for i in range(3)]
+        e0 = _counter("history.evicted")
+        for t in ts:
+            rhist.device_history(t, cs, t.history(cs), 32)
+        assert _counter("history.evicted") == e0 + 1
+        # the evicted (oldest) entry takes a full rebuild on return; the
+        # still-resident hottest entry delta-appends
+        r0 = _counter("history.rebuilds")
+        rhist.device_history(ts[0], cs, ts[0].history(cs), 32)
+        assert _counter("history.rebuilds") == r0 + 1
+
+    def test_unset_cap_is_unbounded(self, monkeypatch):
+        monkeypatch.delenv("HYPEROPT_TPU_RESIDENT_HISTORY_CAP", raising=False)
+        assert rhist.resident_cap() == 0
+        monkeypatch.setenv("HYPEROPT_TPU_RESIDENT_HISTORY_CAP", "nope")
+        assert rhist.resident_cap() == 0
+
+
+# ---------------------------------------------------------------------------
+# pipeline contract: scheduler-backed algo through fmin
+# ---------------------------------------------------------------------------
+
+
+class TestAlgoAdapter:
+    SPACE = {"x": hp.uniform("x", -5, 5), "lr": hp.loguniform("lr", -6, 0)}
+
+    @staticmethod
+    def _obj(d):
+        return d["x"] ** 2 + d["lr"]
+
+    def _losses(self, t):
+        return [d["result"]["loss"] for d in t.trials]
+
+    def test_fmin_parity_and_pipelined(self):
+        t1 = base.Trials()
+        fmin(self._obj, self.SPACE, algo=tpe.suggest, max_evals=30,
+             trials=t1, rstate=np.random.default_rng(42),
+             show_progressbar=False)
+        sched = fleet.CohortScheduler()
+        t2 = base.Trials()
+        fmin(self._obj, self.SPACE, algo=sched.algo(), max_evals=30,
+             trials=t2, rstate=np.random.default_rng(42),
+             show_progressbar=False)
+        assert self._losses(t1) == self._losses(t2)
+
+        t3 = base.Trials()
+        fmin(self._obj, self.SPACE, algo=sched.algo(), max_evals=30,
+             trials=t3, rstate=np.random.default_rng(42),
+             show_progressbar=False, overlap_depth=2, evaluators=1)
+        assert len(t3.trials) == 30
+
+
+# ---------------------------------------------------------------------------
+# service cohort gate
+# ---------------------------------------------------------------------------
+
+
+class TestServiceGate:
+    N = 3
+
+    def _serve(self, tmp_path, **kw):
+        from hyperopt_tpu.service.server import ServiceServer
+        srv = ServiceServer(str(tmp_path / "wal"), token="t", fsync="never",
+                            **kw)
+        srv.start()
+        return srv
+
+    def test_concurrent_tenants_coalesce_with_parity(self, tmp_path):
+        from hyperopt_tpu.parallel.netstore import NetTrials
+        srv = self._serve(tmp_path, cohort_window_ms=150)
+        try:
+            doms, locals_, nts, seeds = [], [], [], []
+            for e in range(self.N):
+                dom = _domain()
+                local = base.Trials(exp_key=f"e{e}")
+                nt = NetTrials(srv.url, exp_key=f"e{e}", token="t")
+                nt.save_domain(dom)
+                _run_exp(dom, 22 + e, 50 + e, trials=local)
+                wire = json.loads(json.dumps(list(local._dynamic_trials)))
+                nt._insert_trial_docs(wire)
+                doms.append(dom)
+                locals_.append(local)
+                nts.append(nt)
+                seeds.append(4000 + 13 * e)
+
+            solo = [json.loads(json.dumps(
+                tpe.suggest([22 + e], doms[e], locals_[e], seeds[e])))
+                for e in range(self.N)]
+
+            d0 = _counter("fleet.dispatches")
+            out = [None] * self.N
+
+            def call(e):
+                out[e] = nts[e].suggest(seeds[e], new_ids=[22 + e],
+                                        insert=False)
+
+            ts = [threading.Thread(target=call, args=(e,))
+                  for e in range(self.N)]
+            for th in ts:
+                th.start()
+            for th in ts:
+                th.join()
+            assert out == solo
+            assert _counter("fleet.dispatches") == d0 + 1
+            state1 = srv.state_bytes()
+        finally:
+            srv.shutdown()
+
+        # per-tenant WAL decomposition unchanged by the gate: replay is
+        # byte-identical
+        from hyperopt_tpu.service.server import ServiceServer
+        srv2 = ServiceServer(str(tmp_path / "wal"), token="t")
+        try:
+            assert srv2.state_bytes() == state1
+        finally:
+            srv2.shutdown()
+
+    def test_live_view_shows_cohort_occupancy(self):
+        import io
+
+        from hyperopt_tpu.show import render_live
+
+        buf = io.StringIO()
+        render_live({
+            "counters": {"fleet.dispatches": 7, "fleet.suggestions": 35},
+            "gauges": {"fleet.cohort_size_last": 5,
+                       "fleet.cohort_tier_last": 8,
+                       "fleet.padding_waste": 0.375},
+        }, out=buf)
+        text = buf.getvalue()
+        assert "cohorts: last 5/8 lanes" in text
+        assert "padding 38%" in text
+        assert "dispatches 7" in text and "suggestions 35" in text
+        # no cohort line when the fleet path never ran
+        buf2 = io.StringIO()
+        render_live({"counters": {}, "gauges": {}}, out=buf2)
+        assert "cohorts:" not in buf2.getvalue()
+
+    def test_custom_kwargs_bypass_gate(self, tmp_path):
+        # Per-request knobs (gamma etc.) take the solo verb path — the
+        # gate only coalesces default-knob tpe suggests.
+        from hyperopt_tpu.parallel.netstore import NetTrials
+        srv = self._serve(tmp_path, cohort_window_ms=50)
+        try:
+            dom = _domain()
+            local = base.Trials(exp_key="e0")
+            nt = NetTrials(srv.url, exp_key="e0", token="t")
+            nt.save_domain(dom)
+            _run_exp(dom, 24, 50, trials=local)
+            nt._insert_trial_docs(
+                json.loads(json.dumps(list(local._dynamic_trials))))
+            ref = json.loads(json.dumps(
+                tpe.suggest([24], dom, local, 7, gamma=0.5)))
+            d0 = _counter("fleet.dispatches")
+            out = nt.suggest(7, new_ids=[24], insert=False, gamma=0.5)
+            assert out == ref
+            assert _counter("fleet.dispatches") == d0
+        finally:
+            srv.shutdown()

@@ -1,0 +1,56 @@
+"""Invariant analyzer suite — static checks gating tier-1.
+
+Four AST-based checkers over the package (see each module's docstring
+for the rule catalog):
+
+* :mod:`.jit_purity`      JP001–JP005 — trace-time purity of jit/vmap paths
+* :mod:`.lock_order`      LK001–LK003 — lock discipline in threaded layers
+* :mod:`.registry_drift`  RD001–RD008 — env/fault/verb/metric catalogs
+* :mod:`.artifacts`       AH001       — benchmark artifact schema guards
+
+Run as ``python -m hyperopt_tpu.analysis [--json] [--baseline FILE]``;
+the tier-1 gate (``tests/test_analysis_gate.py``) runs the same
+:func:`run_repo` against the checked-in ``baseline.json``.
+
+This package imports **stdlib only** and never imports the modules it
+analyzes (pure ``ast`` over source text) — it runs on a machine without
+JAX and is immune to import-time side effects.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import artifacts, jit_purity, lock_order, registry_drift
+from .core import Baseline, Finding, Project
+
+__all__ = ["CHECKERS", "Baseline", "Finding", "Project",
+           "run_project", "run_repo", "default_baseline_path"]
+
+#: name -> (checker module, rule-id tuple), in report order.
+CHECKERS = {
+    "jit-purity": (jit_purity, jit_purity.RULES),
+    "lock-order": (lock_order, lock_order.RULES),
+    "registry-drift": (registry_drift, registry_drift.RULES),
+    "artifact-honesty": (artifacts, artifacts.RULES),
+}
+
+
+def default_baseline_path(root: str) -> str:
+    return os.path.join(root, "hyperopt_tpu", "analysis", "baseline.json")
+
+
+def run_project(project, checkers=None) -> list:
+    """Run the named checkers (default: all) over a built project."""
+    findings = []
+    for name, (mod, _rules) in CHECKERS.items():
+        if checkers and name not in checkers:
+            continue
+        findings.extend(mod.check(project))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))
+    return findings
+
+
+def run_repo(root: str, checkers=None) -> list:
+    """Parse the repo at ``root`` and run the checkers over it."""
+    return run_project(Project.from_dir(root), checkers=checkers)

@@ -1,0 +1,112 @@
+"""CLI: ``python -m hyperopt_tpu.analysis [options]``.
+
+Exit codes: 0 — no findings outside the baseline and no stale entries;
+1 — new findings or stale baseline entries; 2 — malformed baseline.
+
+``--json`` prints the full machine-readable report (the input of
+``hyperopt-tpu-show lint``); ``--write-baseline`` snapshots the current
+findings into the baseline file with TODO notes to be annotated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import CHECKERS, default_baseline_path, run_repo
+from .core import Baseline
+
+
+def build_report(root, baseline_path, checkers=None) -> dict:
+    findings = run_repo(root, checkers=checkers)
+    baseline = Baseline.load(baseline_path)
+    if checkers:
+        # Partial run: entries owned by checkers that didn't run can't be
+        # judged stale — keep only the selected checkers' rules in scope.
+        active = set()
+        for name in checkers:
+            active |= set(CHECKERS[name][1])
+        baseline = Baseline(entries=[e for e in baseline.entries
+                                     if e.get("rule") in active],
+                            path=baseline.path)
+    errors = baseline.validate()
+    new, baselined, stale = baseline.match(findings)
+    counts: dict = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "root": os.path.abspath(root),
+        "baseline": baseline_path,
+        "baseline_errors": errors,
+        "counts": dict(sorted(counts.items())),
+        "new": [f.to_dict() for f in new],
+        "baselined": [f.to_dict() for f in baselined],
+        "stale": [{"rule": e.get("rule"), "file": e.get("file"),
+                   "symbol": e.get("symbol"), "note": e.get("note")}
+                  for e in stale],
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hyperopt_tpu.analysis",
+        description="Run the invariant analyzer suite over the repo.")
+    ap.add_argument("--root", default=".",
+                    help="repo root to analyze (default: cwd)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: "
+                         "hyperopt_tpu/analysis/baseline.json under root)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable report")
+    ap.add_argument("--checker", action="append", choices=sorted(CHECKERS),
+                    help="run only this checker (repeatable)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="snapshot current findings into the baseline")
+    args = ap.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    baseline_path = args.baseline or default_baseline_path(root)
+
+    if args.write_baseline:
+        findings = run_repo(root, checkers=args.checker)
+        old = Baseline.load(baseline_path)
+        notes = {(e["rule"], e["file"], e["symbol"]): e["note"]
+                 for e in old.entries if e.get("note")}
+        doc = Baseline.render(findings, notes=notes)
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=2, sort_keys=False)
+            f.write("\n")
+        print(f"wrote {len(doc['entries'])} entries to {baseline_path}")
+        return 0
+
+    report = build_report(root, baseline_path, checkers=args.checker)
+    if args.as_json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        for key in ("new", "baselined"):
+            for f in report[key]:
+                tag = " (baselined)" if key == "baselined" else ""
+                print(f"{f['file']}:{f['line']}: {f['rule']} "
+                      f"[{f['symbol']}] {f['message']}{tag}")
+        for e in report["stale"]:
+            print(f"stale baseline entry: {e['rule']} {e['file']} "
+                  f"[{e['symbol']}] — finding no longer fires; delete it")
+        for err in report["baseline_errors"]:
+            print(f"baseline error: {err}")
+        total = sum(report["counts"].values())
+        print(f"{total} finding(s): {len(report['new'])} new, "
+              f"{len(report['baselined'])} baselined, "
+              f"{len(report['stale'])} stale baseline entr(ies); "
+              f"counts {report['counts']}")
+    if report["baseline_errors"]:
+        return 2
+    if report["new"] or report["stale"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,49 @@
+"""AH — artifact honesty: every JSON-writing benchmark has a schema guard.
+
+AH001  A ``benchmarks/*.py`` module that serializes JSON (``json.dump``
+       / ``json.dumps``) has no named schema guard in
+       ``tests/test_artifacts_contract.py`` — its artifact shape can
+       drift silently and downstream consumers (the A/B drivers, the
+       show CLI) find out at read time.  A guard counts when the
+       contract test mentions the benchmark's stem anywhere (test name,
+       artifact filename, or grandfather list with a justification).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, dotted_name
+
+RULES = ("AH001",)
+
+_CONTRACT = "tests/test_artifacts_contract.py"
+
+
+def _writes_json(tree: ast.Module) -> int:
+    """Line of the first json.dump/json.dumps call, else 0."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func) or ""
+            if name.split(".")[-1] in ("dump", "dumps") and \
+                    name.split(".")[0] == "json":
+                return node.lineno
+    return 0
+
+
+def check(project) -> list:
+    findings: list = []
+    contract = project.file_text(_CONTRACT)
+    for rel, module in sorted(project.modules.items()):
+        if not rel.startswith("benchmarks/") or not rel.endswith(".py"):
+            continue
+        line = _writes_json(module.tree)
+        if not line:
+            continue
+        stem = rel.rsplit("/", 1)[-1][:-3]
+        if stem not in contract:
+            findings.append(Finding(
+                "AH001", rel, line, stem,
+                f"benchmark writes a JSON artifact but {_CONTRACT} has "
+                f"no schema guard mentioning '{stem}'"))
+    return findings

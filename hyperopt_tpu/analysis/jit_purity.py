@@ -1,0 +1,360 @@
+"""JP — trace-time purity of jit/vmap/pallas kernel paths.
+
+Walks every function reachable from a ``jax.jit`` / ``jax.vmap`` /
+``pl.pallas_call`` entry point (wrapping calls, decorators, including
+``partial(jit, ...)`` forms and lambdas) through the *same-module* call
+graph, and flags the host-sync / recompile hazard classes that ROADMAP
+items 4–5 exist to kill:
+
+JP001  ``.item()`` on a value inside a traced function — a device→host
+       sync per call.
+JP002  ``float()`` / ``int()`` / ``bool()`` on a non-constant inside a
+       traced function — concretizes a tracer (ConcretizationTypeError
+       at best, a silent host round-trip when the value is already
+       concrete by accident).
+JP003  ``np.*`` / ``numpy.*`` call on non-constant arguments inside a
+       traced function — numpy computes on host, forcing materialization.
+JP004  Python ``if`` / ``while`` on a traced parameter — either a
+       tracer-boolean error or, with scalar leaks, a recompile per
+       distinct value.  Structure tests (``x is None``,
+       ``isinstance(x, ...)``) and parameters marked static
+       (``static_argnums`` / ``static_argnames``) are exempt: those
+       branch on trace-time structure, which is the supported idiom.
+JP005  Use-after-donation: an argument passed in a donated position of a
+       ``jax.jit(..., donate_argnums=...)`` callable is read again after
+       the call — donated buffers are invalidated by XLA aliasing (the
+       ``history.py`` delta-append rings are the in-repo donors).
+
+Purely lexical + same-module reachability: cross-module calls are out of
+scope (each module's own traced entry points cover its kernels).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, dotted_name, qualified_functions
+
+RULES = ("JP001", "JP002", "JP003", "JP004", "JP005")
+
+_TRACERS = {"jit", "vmap", "pmap", "pallas_call", "shard_map"}
+_CASTS = {"float", "int", "bool"}
+
+
+def _is_trace_wrapper(name: str | None) -> bool:
+    """True for ``jit``, ``jax.jit``, ``jax.experimental.x.pallas_call``…"""
+    if not name:
+        return False
+    return name.split(".")[-1] in _TRACERS
+
+
+def _partial_trace_call(call: ast.Call):
+    """``partial(jax.jit, ...)`` / ``functools.partial(jit, ...)`` →
+    the inner jit Call-alike (kwargs carry static args), else None."""
+    name = dotted_name(call.func)
+    if not name or name.split(".")[-1] != "partial":
+        return False
+    return bool(call.args) and _is_trace_wrapper(dotted_name(call.args[0]))
+
+
+def _const_tuple(node):
+    """Literal int-tuple/int value, else None (unresolvable)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.append(el.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _static_names(call: ast.Call, fn: ast.FunctionDef | None):
+    """Parameter names marked static in a jit() call wrapping ``fn``."""
+    static = set()
+    params = [a.arg for a in fn.args.args] if fn is not None else []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            if isinstance(kw.value, ast.Constant):
+                static.add(str(kw.value.value))
+            elif isinstance(kw.value, (ast.Tuple, ast.List)):
+                for el in kw.value.elts:
+                    if isinstance(el, ast.Constant):
+                        static.add(str(el.value))
+        elif kw.arg == "static_argnums":
+            nums = _const_tuple(kw.value)
+            for i in nums or ():
+                if 0 <= i < len(params):
+                    static.add(params[i])
+    return static
+
+
+class _ModuleIndex:
+    """Per-module symbol tables the walker resolves against."""
+
+    def __init__(self, module):
+        self.module = module
+        self.funcs: dict = {}      # name -> FunctionDef (top level)
+        self.methods: dict = {}    # (class, name) -> FunctionDef
+        self.np_aliases: set = set()
+        for qual, node, cls in qualified_functions(module.tree):
+            if cls is None:
+                self.funcs[node.name] = node
+            else:
+                self.methods[(cls, node.name)] = node
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "numpy":
+                        self.np_aliases.add(a.asname or "numpy")
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "numpy":
+                    continue    # from numpy import x — rare; skip
+
+
+def _entry_points(index: _ModuleIndex):
+    """(func_node, class_name, static_param_names) for every function the
+    module hands to a trace wrapper, plus decorated ones."""
+    entries = []
+
+    def resolve(node, cls):
+        if isinstance(node, ast.Name):
+            fn = index.funcs.get(node.id)
+            return (fn, None) if fn is not None else None
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name) and node.value.id == "self" \
+                and cls is not None:
+            fn = index.methods.get((cls, node.attr))
+            return (fn, cls) if fn is not None else None
+        return None
+
+    # decorators: @jit / @jax.jit / @partial(jit, static_argnames=...)
+    for qual, node, cls in qualified_functions(index.module.tree):
+        for dec in node.decorator_list:
+            if _is_trace_wrapper(dotted_name(dec)):
+                entries.append((node, cls, set()))
+            elif isinstance(dec, ast.Call) and (
+                    _is_trace_wrapper(dotted_name(dec.func))
+                    or _partial_trace_call(dec)):
+                entries.append((node, cls, _static_names(dec, node)))
+
+    # wrapping calls: jit(f), jax.jit(jax.vmap(f), static_argnums=...),
+    # pl.pallas_call(kernel, ...) — resolve Name / self.attr / lambda.
+    class _Wraps(ast.NodeVisitor):
+        def __init__(self):
+            self.cls = None
+
+        def visit_ClassDef(self, node):
+            prev, self.cls = self.cls, node.name
+            self.generic_visit(node)
+            self.cls = prev
+
+        def visit_Call(self, node):
+            if _is_trace_wrapper(dotted_name(node.func)) and node.args:
+                target, outer_static = node.args[0], _static_names(node, None)
+                # unwrap nesting: jit(vmap(f))
+                while isinstance(target, ast.Call) and \
+                        _is_trace_wrapper(dotted_name(target.func)) \
+                        and target.args:
+                    target = target.args[0]
+                if isinstance(target, ast.Lambda):
+                    entries.append((target, self.cls, set()))
+                else:
+                    got = resolve(target, self.cls)
+                    if got is not None:
+                        fn, cls = got
+                        entries.append(
+                            (fn, cls, _static_names(node, fn)))
+            self.generic_visit(node)
+
+    _Wraps().visit(index.module.tree)
+    return entries
+
+
+def _reachable(index: _ModuleIndex, entries):
+    """BFS over same-module calls: Name() → top-level func, self.m() →
+    method of the entry's class.  Returns {id(node): (node, cls, static)}."""
+    seen: dict = {}
+    work = list(entries)
+    while work:
+        fn, cls, static = work.pop()
+        if id(fn) in seen:
+            continue
+        seen[id(fn)] = (fn, cls, static)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = None
+            if isinstance(node.func, ast.Name):
+                callee = (index.funcs.get(node.func.id), None)
+            elif isinstance(node.func, ast.Attribute) and \
+                    isinstance(node.func.value, ast.Name) and \
+                    node.func.value.id == "self" and cls is not None:
+                callee = (index.methods.get((cls, node.func.attr)), cls)
+            if callee and callee[0] is not None and id(callee[0]) not in seen:
+                work.append((callee[0], callee[1], set()))
+    return seen
+
+
+def _fn_name(fn, cls):
+    name = getattr(fn, "name", "<lambda>")
+    return f"{cls}.{name}" if cls else name
+
+
+def _traced_params(fn, static):
+    args = fn.args
+    names = [a.arg for a in args.args + args.kwonlyargs
+             + getattr(args, "posonlyargs", [])]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    return {n for n in names if n not in static and n != "self"}
+
+
+def _is_env_read(node) -> bool:
+    """``os.environ.get(...)`` / ``os.getenv(...)`` — a host string at
+    trace time, never a tracer; casting it is config parsing, not a sync."""
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func) or ""
+    return "environ" in name or name.endswith("getenv")
+
+
+def _structure_test_names(test):
+    """Names that only appear in `x is None` / `isinstance(x, ...)` /
+    `hasattr/getattr/len(...)`-free structure positions — exempt."""
+    exempt = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare):
+            ops_none = all(isinstance(op, (ast.Is, ast.IsNot))
+                           for op in node.ops) and all(
+                isinstance(c, ast.Constant) and c.value is None
+                for c in node.comparators)
+            if ops_none and isinstance(node.left, ast.Name):
+                exempt.add(node.left.id)
+        elif isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            if fname in ("isinstance", "hasattr", "callable", "len"):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name):
+                        exempt.add(sub.id)
+    return exempt
+
+
+def _check_body(findings, rel, fn, cls, static, index):
+    sym = _fn_name(fn, cls)
+    traced = _traced_params(fn, static)
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "item" and not node.args:
+                    findings.append(Finding(
+                        "JP001", rel, node.lineno, sym,
+                        ".item() in a traced function forces a "
+                        "device->host sync"))
+                elif name in _CASTS and node.args and not isinstance(
+                        node.args[0], ast.Constant) and \
+                        not _is_env_read(node.args[0]):
+                    findings.append(Finding(
+                        "JP002", rel, node.lineno, sym,
+                        f"{name}() on a non-constant in a traced function "
+                        "concretizes a tracer"))
+                elif name and name.split(".")[0] in index.np_aliases \
+                        and node.args and any(
+                            not isinstance(a, ast.Constant)
+                            for a in node.args):
+                    findings.append(Finding(
+                        "JP003", rel, node.lineno, sym,
+                        f"host numpy call {name}() on non-constant args "
+                        "inside a traced function"))
+            elif isinstance(node, (ast.If, ast.While)):
+                exempt = _structure_test_names(node.test)
+                hits = sorted(
+                    {n.id for n in ast.walk(node.test)
+                     if isinstance(n, ast.Name)} & traced - exempt)
+                if hits:
+                    findings.append(Finding(
+                        "JP004", rel, node.lineno, sym,
+                        f"Python branch on traced parameter(s) "
+                        f"{', '.join(hits)} (tracer boolean / recompile "
+                        "per value; mark static or use lax.cond/jnp.where)"))
+
+
+def _donated_calls(index: _ModuleIndex):
+    """name -> donated positions, for ``g = jax.jit(f, donate_argnums=...)``
+    bindings at module or function scope (literal argnums only)."""
+    table: dict = {}
+    for node in ast.walk(index.module.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if not _is_trace_wrapper(dotted_name(call.func)):
+                continue
+            donate = None
+            for kw in call.keywords:
+                if kw.arg == "donate_argnums":
+                    donate = _const_tuple(kw.value)
+            if donate:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        table[tgt.id] = donate
+    return table
+
+
+def _check_donation(findings, rel, index: _ModuleIndex):
+    donated = _donated_calls(index)
+    if not donated:
+        return
+    for qual, fn, cls in qualified_functions(index.module.tree):
+        stmts = list(fn.body)
+        # statement-ordered scan: record donated arg names at call sites,
+        # flag any later Load of those names (before reassignment).
+        dead: dict = {}    # var name -> donation call line
+        for stmt in stmts:
+            # reads first (a = f(a) reads then rebinds)
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    name = dotted_name(node.func)
+                    if name in donated:
+                        for pos in donated[name]:
+                            if pos < len(node.args) and isinstance(
+                                    node.args[pos], ast.Name):
+                                dead.setdefault(node.args[pos].id,
+                                                node.lineno)
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name) and \
+                        isinstance(node.ctx, ast.Load) and node.id in dead \
+                        and node.lineno > dead[node.id]:
+                    findings.append(Finding(
+                        "JP005", rel, node.lineno, qual,
+                        f"'{node.id}' read after being donated to a "
+                        f"donate_argnums jit at line {dead[node.id]} "
+                        "(donated buffers are invalidated)"))
+                    del dead[node.id]
+                    break
+            # rebinding clears the hazard
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                targets = [stmt.target]
+            for tgt in targets:
+                for node in ast.walk(tgt):
+                    if isinstance(node, ast.Name):
+                        dead.pop(node.id, None)
+
+
+def check(project) -> list:
+    findings: list = []
+    for module in project.package_modules():
+        index = _ModuleIndex(module)
+        entries = _entry_points(index)
+        if entries:
+            for fn, cls, static in _reachable(index, entries).values():
+                _check_body(findings, module.rel, fn, cls, static, index)
+        _check_donation(findings, module.rel, index)
+    return findings

@@ -1,0 +1,235 @@
+"""RD — name-registry coherence between source and catalogs.
+
+Four registries, each checked in both directions:
+
+* env vars     ``HYPEROPT_TPU_*`` string literals read in source vs the
+               docs/API.md catalog.
+               RD001 read-but-undocumented · RD002 documented-but-unread
+* fault points first args of ``maybe_fail()`` calls and the
+               ``FAULT_POINTS`` frozenset in faults.py vs docs/API.md.
+               RD003 injected-point-not-in-FAULT_POINTS ·
+               RD004 FAULT_POINTS-entry-not-in-docs
+* service verbs ``self._rpc("X")`` client literals and ``*_VERBS``
+               frozensets vs the ``verb == "X"`` dispatcher arms.
+               RD005 referenced-but-no-dispatch-arm ·
+               RD008 dispatch-arm-never-referenced
+* obs metrics  ``.counter/.gauge/.histogram("name")`` emission literals
+               (f-strings become ``prefix*`` wildcards) vs the dotted
+               names back-ticked in API.md's Observability sections
+               (``<placeholder>`` segments become ``*``).
+               RD006 emitted-but-uncataloged · RD007 cataloged-but-unemitted
+
+All extraction is AST / text based — nothing is imported, so a metric
+emitted behind an env guard or a lazily-registered fault point is still
+seen.  Docstring prose is excluded from the env-var scan (a mention is
+not a read).  Doc tokens only count as *metric* catalog entries when
+their first dotted segment matches some emitted metric's first segment;
+this keeps module paths and config keys out of RD007 at the cost of
+missing a catalog section whose whole subsystem was deleted (which
+RD002/RD004 would catch via its env vars / fault points anyway).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, dotted_name, joined_str_prefix, str_const
+
+RULES = ("RD001", "RD002", "RD003", "RD004",
+         "RD005", "RD006", "RD007", "RD008")
+
+_ENV_RE = re.compile(r"HYPEROPT_TPU_[A-Z0-9_]+")
+_DOC_TOKEN_RE = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_.<>*-]+)+)`")
+_EMITTERS = {"counter", "gauge", "histogram"}
+_NONMETRIC_SUFFIXES = (".py", ".md", ".json", ".jsonl", ".txt", ".log")
+
+
+def _doc_line(text: str, token: str) -> int:
+    for i, line in enumerate(text.splitlines(), 1):
+        if token in line:
+            return i
+    return 1
+
+
+def _docstring_ids(tree: ast.Module):
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+def _wild_match(a: str, b: str) -> bool:
+    """Match two names where either may carry ``*`` wildcards."""
+    if "*" not in a and "*" not in b:
+        return a == b
+    pa, pb = a.split("*", 1)[0], b.split("*", 1)[0]
+    return pa.startswith(pb) or pb.startswith(pa)
+
+
+def _literal_set(node) -> set:
+    """String elements of a set/frozenset/tuple/list literal expression."""
+    out = set()
+    if isinstance(node, ast.Call) and node.args:
+        name = dotted_name(node.func)
+        if name and name.split(".")[-1] in ("frozenset", "set", "tuple"):
+            node = node.args[0]
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        for el in node.elts:
+            s = str_const(el)
+            if s:
+                out.add(s)
+    return out
+
+
+class _Extract:
+    """One pass over every module: all four registries' source side."""
+
+    def __init__(self, project):
+        self.env: dict = {}            # name -> (file, line)
+        self.fault_sites: dict = {}    # point -> (file, line)
+        self.fault_points: set = set()
+        self.fault_file = "hyperopt_tpu/faults.py"
+        self.client_verbs: dict = {}   # verb -> (file, line)
+        self.dispatch_verbs: dict = {} # verb -> (file, line)
+        self.metrics: dict = {}        # name/pattern -> (file, line)
+        for module in project.package_modules():
+            self._scan(module)
+
+    def _scan(self, module):
+        rel = module.rel
+        doc_ids = _docstring_ids(module.tree)
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and id(node) not in doc_ids:
+                for name in _ENV_RE.findall(node.value):
+                    self.env.setdefault(name, (rel, node.lineno))
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func) or ""
+            tail = fname.split(".")[-1]
+            if tail == "maybe_fail" and node.args:
+                point = str_const(node.args[0])
+                if point:
+                    self.fault_sites.setdefault(point, (rel, node.lineno))
+            elif tail == "_rpc" and node.args:
+                verb = str_const(node.args[0])
+                if verb:
+                    self.client_verbs.setdefault(verb, (rel, node.lineno))
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _EMITTERS and node.args:
+                # matches both reg.counter("x") and registry().counter("x")
+                # (dotted_name cannot resolve a Call base)
+                name = str_const(node.args[0]) or \
+                    joined_str_prefix(node.args[0])
+                if name:
+                    self.metrics.setdefault(name, (rel, node.lineno))
+        # FAULT_POINTS / *_VERBS literal sets (module or class scope)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                tname = tgt.id if isinstance(tgt, ast.Name) else None
+                if not tname:
+                    continue
+                if tname == "FAULT_POINTS":
+                    self.fault_points |= _literal_set(node.value)
+                    self.fault_file = rel
+                elif tname.endswith("_VERBS"):
+                    for v in _literal_set(node.value):
+                        self.client_verbs.setdefault(v, (rel, node.lineno))
+        # dispatcher arms: verb == "X" comparisons anywhere in the module
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Compare) and \
+                    isinstance(node.left, ast.Name) and \
+                    node.left.id == "verb" and \
+                    all(isinstance(op, ast.Eq) for op in node.ops):
+                for comp in node.comparators:
+                    v = str_const(comp)
+                    if v:
+                        self.dispatch_verbs.setdefault(
+                            v, (rel, node.lineno))
+
+
+def check(project) -> list:
+    findings: list = []
+    ext = _Extract(project)
+    api = project.file_text("docs/API.md")
+    api_env = set(_ENV_RE.findall(api))
+
+    # RD001 / RD002 — env vars
+    for name, (rel, line) in sorted(ext.env.items()):
+        if name not in api_env:
+            findings.append(Finding(
+                "RD001", rel, line, name,
+                f"env var {name} is read in source but missing from the "
+                "docs/API.md catalog"))
+    for name in sorted(api_env - set(ext.env)):
+        findings.append(Finding(
+            "RD002", "docs/API.md", _doc_line(api, name), name,
+            f"env var {name} is documented in docs/API.md but never read "
+            "in source"))
+
+    # RD003 / RD004 — fault points
+    for point, (rel, line) in sorted(ext.fault_sites.items()):
+        if ext.fault_points and point not in ext.fault_points:
+            findings.append(Finding(
+                "RD003", rel, line, point,
+                f"maybe_fail point '{point}' is not in faults.FAULT_POINTS"))
+    for point in sorted(ext.fault_points):
+        if f"`{point}`" not in api:
+            findings.append(Finding(
+                "RD004", "docs/API.md", 1, point,
+                f"fault point '{point}' is in FAULT_POINTS but not "
+                "documented in docs/API.md"))
+
+    # RD005 / RD008 — service verbs
+    for verb, (rel, line) in sorted(ext.client_verbs.items()):
+        if ext.dispatch_verbs and verb not in ext.dispatch_verbs:
+            findings.append(Finding(
+                "RD005", rel, line, verb,
+                f"verb '{verb}' is sent/cataloged by clients but has no "
+                "dispatcher arm"))
+    for verb, (rel, line) in sorted(ext.dispatch_verbs.items()):
+        if ext.client_verbs and verb not in ext.client_verbs:
+            findings.append(Finding(
+                "RD008", rel, line, verb,
+                f"dispatcher handles verb '{verb}' that no client or "
+                "*_VERBS catalog references"))
+
+    # RD006 / RD007 — obs metrics vs the Observability doc sections
+    obs_text, keep = [], False
+    for line in api.splitlines():
+        if line.startswith("#"):
+            keep = "observability" in line.lower()
+        if keep:
+            obs_text.append(line)
+    obs_text = "\n".join(obs_text)
+    first_segs = {m.split(".")[0].rstrip("*") for m in ext.metrics}
+    catalog = set()
+    for tok in _DOC_TOKEN_RE.findall(obs_text):
+        if tok.endswith(_NONMETRIC_SUFFIXES) or tok in ext.fault_points:
+            continue
+        pat = re.sub(r"<[^>]*>", "*", tok)
+        if pat.split(".")[0].split("*")[0] in first_segs:
+            catalog.add(pat)
+    for name, (rel, line) in sorted(ext.metrics.items()):
+        if catalog and not any(_wild_match(name, p) for p in catalog):
+            findings.append(Finding(
+                "RD006", rel, line, name,
+                f"metric '{name}' is emitted but not cataloged in "
+                "docs/API.md's Observability section"))
+    for pat in sorted(catalog):
+        if not any(_wild_match(name, pat) for name in ext.metrics):
+            findings.append(Finding(
+                "RD007", "docs/API.md", _doc_line(api, pat.split("*")[0]),
+                pat,
+                f"metric '{pat}' is cataloged in docs/API.md but never "
+                "emitted"))
+    return findings

@@ -218,7 +218,8 @@ class CohortScheduler:
     def _rep(self, sig, cs):
         """Representative space for a signature: all structurally equal
         spaces compile against ONE CompiledSpace so the kernel cache
-        (keyed on ``id(cs)``) cannot fragment across tenants."""
+        (keyed on ``id(cs)``) cannot fragment across tenants.
+        Caller holds ``self._lock`` (``suggest_dispatch`` only)."""
         rep = self._rep_cs.get(sig)
         if rep is None:
             rep = self._rep_cs[sig] = cs
@@ -258,6 +259,8 @@ class CohortScheduler:
         return handles
 
     def _dispatch_cohort(self, key, members, handles):
+        """Caller holds ``self._lock`` (``suggest_dispatch`` only) —
+        ``_states``/``_rep``/lane bookkeeping all mutate under it."""
         sig, n_cap, m = key
         state = self._states.get(key)
         if state is None:

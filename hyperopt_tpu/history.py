@@ -345,12 +345,16 @@ def _lru_drop(trials):
 
 
 def _states(trials):
+    """Per-``trials`` resident-state dict, created under ``_LOCK``:
+    two suggest threads racing the first touch must agree on ONE dict,
+    or the loser's uploads land in a store nobody reads again."""
     try:
-        d = _STORE.get(trials)
-        if d is None:
-            d = {}
-            _STORE[trials] = d
-        return d
+        with _LOCK:
+            d = _STORE.get(trials)
+            if d is None:
+                d = {}
+                _STORE[trials] = d
+            return d
     except TypeError:       # exotic trials without weakref support
         return None
 

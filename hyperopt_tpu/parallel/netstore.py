@@ -135,6 +135,10 @@ class StoreServer:
         # LRU + TTL bounded (netstore.idem.evicted counts expulsions).
         self._idem: OrderedDict = OrderedDict()
         self._idem_lock = threading.Lock()
+        # Keys whose first execution is still running: concurrent
+        # duplicates park on the Event instead of running the verb again
+        # (the check-then-act hole between cache probe and publish).
+        self._idem_inflight: dict = {}
         self._idem_cap = int(os.environ.get(
             "HYPEROPT_TPU_NETSTORE_IDEM_CAP", "") or self._IDEM_CAP)
         self._idem_ttl = float(os.environ.get(
@@ -307,11 +311,15 @@ class StoreServer:
     # -- verbs ---------------------------------------------------------------
 
     def _store(self, exp_key: str, tenant=None) -> FileTrials:
-        # Tenant namespacing happens HERE and only here: the store key
-        # pairs the authenticated tenant name with the client's exp_key,
-        # and each tenant's files live under their own subtree.  The
-        # exp_key inside the documents stays the client's own (the doc
-        # filter `_exp_key in (None, d["exp_key"])` must keep matching).
+        """Caller holds ``self._lock`` (every site: the verb dispatcher
+        and the cohort gate's snapshot section take the RLock first).
+
+        Tenant namespacing happens HERE and only here: the store key
+        pairs the authenticated tenant name with the client's exp_key,
+        and each tenant's files live under their own subtree.  The
+        exp_key inside the documents stays the client's own (the doc
+        filter ``_exp_key in (None, d["exp_key"])`` must keep matching).
+        """
         tname = getattr(tenant, "name", tenant)
         key = (tname, exp_key)
         ft = self._trials.get(key)
@@ -319,19 +327,6 @@ class StoreServer:
             root = os.path.join(self.root, tname) if tname else self.root
             ft = self._trials[key] = FileTrials(root, exp_key=exp_key)
         return ft
-
-    def _idem_get(self, key):
-        with self._idem_lock:
-            hit = self._idem.get(key)
-            if hit is None:
-                return None
-            t, payload = hit
-            if time.monotonic() - t > self._idem_ttl:
-                del self._idem[key]
-                _metrics.registry().counter("netstore.idem.evicted").inc()
-                return None
-            self._idem.move_to_end(key)      # LRU touch
-            return payload
 
     def _idem_put(self, key, payload: str):
         evicted = 0
@@ -349,6 +344,43 @@ class StoreServer:
                     break
         if evicted:
             _metrics.registry().counter("netstore.idem.evicted").inc(evicted)
+
+    def _idem_execute(self, key, run):
+        """At-most-once execution of ``run()`` for idempotency ``key``.
+
+        Returns ``(reply_dict, replayed)``.  The cache probe and the
+        in-flight claim are one atomic step under ``_idem_lock``, so two
+        concurrent retries of the same key cannot both miss and run the
+        verb twice: the loser parks on the winner's Event and re-reads
+        the cache once the winner publishes.  If the winner's verb
+        raises, nothing is published and the waiter claims the key
+        itself — ordinary retry semantics.
+        """
+        while True:
+            with self._idem_lock:
+                hit = self._idem.get(key)
+                if hit is not None:
+                    t, payload = hit
+                    if time.monotonic() - t <= self._idem_ttl:
+                        self._idem.move_to_end(key)      # LRU touch
+                        return json.loads(payload), True
+                    del self._idem[key]
+                    _metrics.registry().counter("netstore.idem.evicted").inc()
+                ev = self._idem_inflight.get(key)
+                if ev is None:
+                    ev = self._idem_inflight[key] = threading.Event()
+                    break
+            # A duplicate of an in-flight call: wait for its publish,
+            # then loop — cache hit replays it, a failure re-claims.
+            ev.wait()
+        try:
+            out = run()
+            self._idem_put(key, json.dumps(out))
+            return out, False
+        finally:
+            with self._idem_lock:
+                self._idem_inflight.pop(key, None)
+            ev.set()
 
     def _dispatch(self, req: dict, tenant=None) -> dict:
         verb = req["verb"]
@@ -373,13 +405,12 @@ class StoreServer:
                 # — it cannot know whether the loss was on the way in or
                 # out).
                 key = (tname, req.get("exp_key", "default"), idem)
-                cached = self._idem_get(key)
-                if cached is not None:
+                out, replayed = self._idem_execute(
+                    key, lambda: self._dispatch_verb(verb, req,
+                                                     tenant=tenant,
+                                                     idem=idem))
+                if replayed:
                     reg.counter("netstore.idem.hits").inc()
-                    return json.loads(cached)
-                out = self._dispatch_verb(verb, req, tenant=tenant,
-                                          idem=idem)
-                self._idem_put(key, json.dumps(out))
                 return out
         finally:
             # Per-verb call count + latency histogram: the contention

@@ -105,7 +105,8 @@ class _CohortGate:
         """Per-algo CohortScheduler, built lazily (first cohort pays the
         JAX import, idle services never do).  Scheduler knobs must equal
         the solo verb's defaults — that is what makes injected rows
-        bit-identical to the fallback path."""
+        bit-identical to the fallback path.  Caller holds the server
+        lock (``_compute``'s snapshot section is the only call site)."""
         sched = self._scheds.get(algo)
         if sched is None:
             from .. import fleet

@@ -455,6 +455,41 @@ def show_wal(wal_dir: str, as_json: bool = False, out=None) -> int:
     return 0
 
 
+def show_lint(report, out=None):
+    """Render an analyzer report (``python -m hyperopt_tpu.analysis --json``)
+    grouped by rule, new findings first, stale/invalid baseline rows last."""
+    out = out or sys.stdout
+    by_rule = {}
+    for key in ("new", "baselined"):
+        for f in report.get(key, ()):
+            by_rule.setdefault(f["rule"], []).append((key, f))
+    for rule in sorted(by_rule):
+        rows = by_rule[rule]
+        n_new = sum(1 for k, _ in rows if k == "new")
+        print(f"{rule}: {len(rows)} finding(s), {n_new} new", file=out)
+        for key, f in sorted(rows, key=lambda kf: (kf[0] != "new",
+                                                   kf[1]["file"],
+                                                   kf[1]["line"])):
+            tag = "NEW " if key == "new" else "base"
+            print(f"  [{tag}] {f['file']}:{f['line']} "
+                  f"[{f['symbol']}] {f['message']}", file=out)
+    for e in report.get("stale", ()):
+        print(f"stale baseline entry: {e['rule']} {e['file']} "
+              f"[{e['symbol']}] — finding no longer fires; delete it",
+              file=out)
+    for err in report.get("baseline_errors", ()):
+        print(f"baseline error: {err}", file=out)
+    counts = report.get("counts", {})
+    print(f"{sum(counts.values())} finding(s): "
+          f"{len(report.get('new', ()))} new, "
+          f"{len(report.get('baselined', ()))} baselined, "
+          f"{len(report.get('stale', ()))} stale; counts {counts}",
+          file=out)
+    if report.get("baseline_errors"):
+        return 2
+    return 1 if (report.get("new") or report.get("stale")) else 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "trace":
@@ -510,6 +545,32 @@ def main(argv=None):
             "HYPEROPT_TPU_NETSTORE_TOKEN") or None
         return live(largs.url, token=token, interval=largs.interval,
                     once=largs.once)
+
+    if argv and argv[0] == "lint":
+        ap = argparse.ArgumentParser(prog="hyperopt-tpu-show lint",
+                                     description="render an invariant-"
+                                                 "analyzer report (or run "
+                                                 "the analyzers now)")
+        ap.add_argument("report", nargs="?", default=None,
+                        help="saved `python -m hyperopt_tpu.analysis "
+                             "--json` output; omit to analyze --root")
+        ap.add_argument("--root", default=".",
+                        help="repo root to analyze when no report file "
+                             "is given (default: cwd)")
+        ap.add_argument("--baseline", default=None,
+                        help="baseline file (default: the repo's "
+                             "hyperopt_tpu/analysis/baseline.json)")
+        largs = ap.parse_args(argv[1:])
+        if largs.report:
+            with open(largs.report, "r", encoding="utf-8") as f:
+                report = json.load(f)
+        else:
+            from .analysis import default_baseline_path
+            from .analysis.__main__ import build_report
+            root = os.path.abspath(largs.root)
+            report = build_report(
+                root, largs.baseline or default_baseline_path(root))
+        return show_lint(report)
 
     p = argparse.ArgumentParser(description="inspect a hyperopt_tpu "
                                             "experiment")

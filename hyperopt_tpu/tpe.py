@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 from functools import partial
 from time import perf_counter
 
@@ -437,6 +438,12 @@ class _TpeKernel:
         self._fn = jax.jit(self._suggest_one)
         self._fn_seeded = jax.jit(self._seeded_one)
         self._batch_fns = {}  # n -> jitted vmapped suggest (K proposals)
+        # Guards _batch_fns and _fleet_tiers: _prewarm_async builds entry
+        # programs from a daemon thread while the suggest path builds its
+        # own — a racy double-build means a duplicate compile, the exact
+        # stall prewarming exists to hide.  Builders run under the lock
+        # (jit() wrapping is cheap, no trace); calls run outside it.
+        self._fns_lock = threading.Lock()
 
     # -- sharding hook -------------------------------------------------------
 
@@ -856,25 +863,27 @@ class _TpeKernel:
         """K constant-liar proposals in ONE device program (see
         :meth:`_liar_scan`).  Returns (rows[K, P], act[K, P]); the history
         bucket must have at least ``n`` rows of padding slack."""
-        fn = self._batch_fns.get(n)
-        if fn is None:
-            fn = self._batch_fns[n] = jax.jit(
-                lambda key, *a: self._liar_scan(
-                    jax.random.split(key, n), *a))
+        with self._fns_lock:
+            fn = self._batch_fns.get(n)
+            if fn is None:
+                fn = self._batch_fns[n] = jax.jit(
+                    lambda key, *a: self._liar_scan(
+                        jax.random.split(key, n), *a))
         return fn(key, n_rows, vals, active, loss, ok,
                   np.float32(gamma), np.float32(prior_weight))
 
     def _batch_seeded_fn(self, n):
         """Build (and cache) the jitted n-proposal liar-scan entry."""
-        fn = self._batch_fns.get(("seeded", n))
-        if fn is None:
-            def run(seed, n_rows, vals, active, loss, ok, gamma,
-                    prior_weight):
-                keys = jax.random.split(prng_key(seed), n)
-                return self._liar_scan(keys, n_rows, vals, active, loss,
-                                       ok, gamma, prior_weight)
+        with self._fns_lock:
+            fn = self._batch_fns.get(("seeded", n))
+            if fn is None:
+                def run(seed, n_rows, vals, active, loss, ok, gamma,
+                        prior_weight):
+                    keys = jax.random.split(prng_key(seed), n)
+                    return self._liar_scan(keys, n_rows, vals, active, loss,
+                                           ok, gamma, prior_weight)
 
-            fn = self._batch_fns[("seeded", n)] = jax.jit(run)
+                fn = self._batch_fns[("seeded", n)] = jax.jit(run)
         return fn
 
     def suggest_many_seeded(self, seed, n, n_rows, vals, active, loss, ok,
@@ -899,20 +908,21 @@ class _TpeKernel:
         one per ``(n_cap, P, m, B-tier)``; fleet.CohortScheduler rounds B
         up to pow2 tiers to bound that to O(log fleet).
         """
-        fn = self._batch_fns.get(("fleet", m))
-        if fn is None:
-            if m == 1:
-                def one(seed, n_rows, hv, ha, hl, hok, gamma, pw):
-                    row, act = self._seeded_one(seed, hv, ha, hl, hok,
-                                                gamma, pw)
-                    return row[None], act[None]
-            else:
-                def one(seed, n_rows, hv, ha, hl, hok, gamma, pw):
-                    keys = jax.random.split(prng_key(seed), m)
-                    return self._liar_scan(keys, n_rows, hv, ha, hl, hok,
-                                           gamma, pw)
+        with self._fns_lock:
+            fn = self._batch_fns.get(("fleet", m))
+            if fn is None:
+                if m == 1:
+                    def one(seed, n_rows, hv, ha, hl, hok, gamma, pw):
+                        row, act = self._seeded_one(seed, hv, ha, hl, hok,
+                                                    gamma, pw)
+                        return row[None], act[None]
+                else:
+                    def one(seed, n_rows, hv, ha, hl, hok, gamma, pw):
+                        keys = jax.random.split(prng_key(seed), m)
+                        return self._liar_scan(keys, n_rows, hv, ha, hl,
+                                               hok, gamma, pw)
 
-            fn = self._batch_fns[("fleet", m)] = jax.jit(jax.vmap(one))
+                fn = self._batch_fns[("fleet", m)] = jax.jit(jax.vmap(one))
         return fn
 
     def suggest_fleet_seeded(self, seeds, m, n_rows, hv, ha, hl, hok,
@@ -922,12 +932,14 @@ class _TpeKernel:
         insertion cursors ``n_rows[B]``.  Per-lane gamma/prior_weight
         arrays let mixed experiment configs share a dispatch."""
         b = len(seeds)
-        seen = getattr(self, "_fleet_tiers", None)
-        if seen is None:
-            seen = self._fleet_tiers = set()
         tier = ("fleet", self.n_cap, self.cs.n_params, m, b)
-        kernel_cache_event(tier, tier in seen)
-        seen.add(tier)
+        with self._fns_lock:
+            seen = getattr(self, "_fleet_tiers", None)
+            if seen is None:
+                seen = self._fleet_tiers = set()
+            hit = tier in seen
+            seen.add(tier)
+        kernel_cache_event(tier, hit)
         return self._fleet_fn(m)(
             np.asarray(seeds, np.uint32), np.asarray(n_rows, np.int32),
             hv, ha, hl, hok,
@@ -995,14 +1007,21 @@ def _prewarm_async(kern: _TpeKernel, n: int = 1) -> None:
                      name=f"tpe-prewarm-{kern.n_cap}-n{n}").start()
 
 
+#: Guards the per-CompiledSpace kernel dicts in :func:`get_kernel`:
+#: fleet dispatch threads and the solo suggest path share one ``cs``,
+#: and a racy first touch either loses a dict or double-builds a kernel.
+_KERNELS_LOCK = threading.Lock()
+
+
 def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
                split: str = "sqrt", multivariate: bool = False,
                cat_prior: str | None = None) -> _TpeKernel:
     from .ops.gmm import _comp_sampler
 
-    cache = getattr(cs, "_tpe_kernels", None)
-    if cache is None:
-        cache = cs._tpe_kernels = {}
+    with _KERNELS_LOCK:
+        cache = getattr(cs, "_tpe_kernels", None)
+        if cache is None:
+            cache = cs._tpe_kernels = {}
     cat_prior = cat_prior or _cat_prior_default()
     # Env toggles baked into the traced program all key the cache —
     # a mid-process toggle must produce a fresh kernel, never a stale one.
@@ -1013,11 +1032,15 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
          _pallas_mode(), _comp_sampler(), _pallas_tile(), _split_impl(),
          prng_impl(), _pallas_ei_impl(), _ei_precision(), _ei_topm(),
          _rhist.enabled())
-    hit = k in cache
+    with _KERNELS_LOCK:
+        hit = k in cache
+        if not hit:
+            # Construction under the lock is cheap (jit wrapping, no
+            # trace/compile) and guarantees one kernel per key — a
+            # double-build would double the eventual compiles.
+            cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split,
+                                  multivariate, cat_prior)
     kernel_cache_event(k, hit)
-    if not hit:
-        cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split, multivariate,
-                              cat_prior)
     return cache[k]
 
 

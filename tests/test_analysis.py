@@ -1,0 +1,449 @@
+"""Invariant analyzer suite: per-rule fixtures + import-independence.
+
+Every rule gets a pair: a minimal fixture module carrying ONE known
+violation (the rule must fire) and a clean twin (the rule must stay
+silent) — the analyzer equivalent of the fault harness's seeded
+schedules: each checker's trigger condition is pinned by construction,
+not by whatever the live codebase happens to contain today.
+
+The analysis package is loaded here *standalone* — by file path, under
+its own module name, never via ``import hyperopt_tpu`` — because its
+contract is to run without JAX.  ``test_runs_with_jax_blocked`` proves
+that end-to-end in a subprocess whose meta_path rejects any jax import.
+"""
+
+import ast
+import importlib.util
+import json
+import pathlib
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+PKG_DIR = ROOT / "hyperopt_tpu" / "analysis"
+_STANDALONE = "_hyperopt_tpu_analysis_standalone"
+
+
+def load_analysis():
+    """Load ``hyperopt_tpu.analysis`` by path, without executing
+    ``hyperopt_tpu/__init__`` (which imports JAX)."""
+    mod = sys.modules.get(_STANDALONE)
+    if mod is not None:
+        return mod
+    spec = importlib.util.spec_from_file_location(
+        _STANDALONE, PKG_DIR / "__init__.py",
+        submodule_search_locations=[str(PKG_DIR)])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[_STANDALONE] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_checker(checker, sources, files=None):
+    analysis = load_analysis()
+    project = analysis.Project.from_sources(sources, files=files)
+    mod, _rules = analysis.CHECKERS[checker]
+    return mod.check(project)
+
+
+def rules_fired(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+# JP — jit purity
+# ---------------------------------------------------------------------------
+
+
+def _jp(body):
+    return {"hyperopt_tpu/fx.py": body}
+
+
+def test_jp001_item_fires_and_clean_twin_silent():
+    bad = _jp("import jax\n"
+              "def f(x):\n"
+              "    return x.item()\n"
+              "g = jax.jit(f)\n")
+    ok = _jp("import jax\n"
+             "def f(x):\n"
+             "    return x * 2\n"
+             "g = jax.jit(f)\n")
+    assert rules_fired(run_checker("jit-purity", bad), "JP001")
+    assert not rules_fired(run_checker("jit-purity", ok), "JP001")
+
+
+def test_jp002_cast_fires_and_env_read_exempt():
+    bad = _jp("import jax\n"
+              "def f(x):\n"
+              "    return float(x)\n"
+              "g = jax.jit(f)\n")
+    # Casting an os.environ read is host config parsing, never a tracer.
+    ok = _jp("import jax, os\n"
+             "def f(x):\n"
+             "    t = float(os.environ.get('HYPEROPT_TPU_FX', '1.0'))\n"
+             "    return x * t\n"
+             "g = jax.jit(f)\n")
+    assert rules_fired(run_checker("jit-purity", bad), "JP002")
+    assert not rules_fired(run_checker("jit-purity", ok), "JP002")
+
+
+def test_jp003_host_numpy_fires_and_jnp_silent():
+    bad = _jp("import jax\n"
+              "import numpy as np\n"
+              "def f(x):\n"
+              "    return np.sum(x)\n"
+              "g = jax.jit(f)\n")
+    ok = _jp("import jax\n"
+             "import jax.numpy as jnp\n"
+             "def f(x):\n"
+             "    return jnp.sum(x)\n"
+             "g = jax.jit(f)\n")
+    assert rules_fired(run_checker("jit-purity", bad), "JP003")
+    assert not rules_fired(run_checker("jit-purity", ok), "JP003")
+
+
+def test_jp004_branch_fires_and_static_param_exempt():
+    bad = _jp("import jax\n"
+              "def f(x):\n"
+              "    if x > 0:\n"
+              "        return x\n"
+              "    return -x\n"
+              "g = jax.jit(f)\n")
+    ok = _jp("import jax\n"
+             "def f(x):\n"
+             "    if x > 0:\n"
+             "        return x\n"
+             "    return -x\n"
+             "g = jax.jit(f, static_argnames='x')\n")
+    none_test = _jp("import jax\n"
+                    "def f(x):\n"
+                    "    if x is None:\n"
+                    "        return 0\n"
+                    "    return x\n"
+                    "g = jax.jit(f)\n")
+    assert rules_fired(run_checker("jit-purity", bad), "JP004")
+    assert not rules_fired(run_checker("jit-purity", ok), "JP004")
+    assert not rules_fired(run_checker("jit-purity", none_test), "JP004")
+
+
+def test_jp005_use_after_donation_fires_and_rebind_silent():
+    bad = _jp("import jax\n"
+              "def step(a):\n"
+              "    return a + 1\n"
+              "g = jax.jit(step, donate_argnums=(0,))\n"
+              "def run(buf):\n"
+              "    out = g(buf)\n"
+              "    return buf + out\n")
+    ok = _jp("import jax\n"
+             "def step(a):\n"
+             "    return a + 1\n"
+             "g = jax.jit(step, donate_argnums=(0,))\n"
+             "def run(buf):\n"
+             "    buf = g(buf)\n"
+             "    return buf\n")
+    assert rules_fired(run_checker("jit-purity", bad), "JP005")
+    assert not rules_fired(run_checker("jit-purity", ok), "JP005")
+
+
+# ---------------------------------------------------------------------------
+# LK — lock discipline
+# ---------------------------------------------------------------------------
+
+
+def test_lk001_lock_order_cycle_fires_and_consistent_order_silent():
+    bad = {"hyperopt_tpu/fx.py": (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def f():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with B:\n"
+        "        with A:\n"
+        "            pass\n")}
+    ok = {"hyperopt_tpu/fx.py": (
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "B = threading.Lock()\n"
+        "def f():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n"
+        "def g():\n"
+        "    with A:\n"
+        "        with B:\n"
+        "            pass\n")}
+    assert rules_fired(run_checker("lock-order", bad), "LK001")
+    assert not rules_fired(run_checker("lock-order", ok), "LK001")
+
+
+def test_lk002_unlocked_shared_write_fires_and_locked_silent():
+    bad = {"hyperopt_tpu/fx.py": (
+        "import threading\n"
+        "_LOCK = threading.Lock()\n"
+        "CACHE = {}\n"
+        "def put(k, v):\n"
+        "    CACHE[k] = v\n")}
+    ok = {"hyperopt_tpu/fx.py": (
+        "import threading\n"
+        "_LOCK = threading.Lock()\n"
+        "CACHE = {}\n"
+        "def put(k, v):\n"
+        "    with _LOCK:\n"
+        "        CACHE[k] = v\n")}
+    assert rules_fired(run_checker("lock-order", bad), "LK002")
+    assert not rules_fired(run_checker("lock-order", ok), "LK002")
+
+
+def test_lk003_check_then_act_fires_locked_and_caller_holds_silent():
+    bad = {"hyperopt_tpu/fx.py": (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.cache = {}\n"
+        "    def get_or_make(self, k):\n"
+        "        if k in self.cache:\n"
+        "            return self.cache[k]\n"
+        "        self.cache[k] = object()\n"
+        "        return self.cache[k]\n")}
+    ok = {"hyperopt_tpu/fx.py": (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.cache = {}\n"
+        "    def get_or_make(self, k):\n"
+        "        with self._lock:\n"
+        "            if k in self.cache:\n"
+        "                return self.cache[k]\n"
+        "            self.cache[k] = object()\n"
+        "            return self.cache[k]\n")}
+    documented = {"hyperopt_tpu/fx.py": (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.cache = {}\n"
+        "    def get_or_make(self, k):\n"
+        "        \"\"\"Caller holds ``self._lock``.\"\"\"\n"
+        "        if k in self.cache:\n"
+        "            return self.cache[k]\n"
+        "        self.cache[k] = object()\n"
+        "        return self.cache[k]\n")}
+    assert rules_fired(run_checker("lock-order", bad), "LK003")
+    assert not rules_fired(run_checker("lock-order", ok), "LK003")
+    assert not rules_fired(run_checker("lock-order", documented), "LK003")
+
+
+# ---------------------------------------------------------------------------
+# RD — registry drift
+# ---------------------------------------------------------------------------
+
+
+def test_rd001_rd002_env_vars_both_directions():
+    src = {"hyperopt_tpu/fx.py": (
+        "import os\n"
+        "KNOB = os.environ.get('HYPEROPT_TPU_FIXTURE_KNOB', '')\n")}
+    undocumented = run_checker("registry-drift", src,
+                               files={"docs/API.md": "nothing here\n"})
+    assert rules_fired(undocumented, "RD001")
+    documented = run_checker(
+        "registry-drift", src,
+        files={"docs/API.md": "`HYPEROPT_TPU_FIXTURE_KNOB` — fixture\n"})
+    assert not rules_fired(documented, "RD001")
+    assert not rules_fired(documented, "RD002")
+    # doc mentions a var nothing reads -> RD002
+    phantom = run_checker(
+        "registry-drift", src,
+        files={"docs/API.md": "`HYPEROPT_TPU_FIXTURE_KNOB` and "
+                              "`HYPEROPT_TPU_NO_SUCH_KNOB`\n"})
+    assert rules_fired(phantom, "RD002")
+
+
+def test_rd003_rd004_fault_points_both_directions():
+    api = {"docs/API.md": "fault points: `store.write`\n"}
+    bad = {
+        "hyperopt_tpu/faultsx.py":
+            "FAULT_POINTS = frozenset({'store.write'})\n",
+        "hyperopt_tpu/user.py":
+            "def f(mf):\n    mf.maybe_fail('store.read')\n",
+    }
+    findings = run_checker("registry-drift", bad, files=api)
+    assert rules_fired(findings, "RD003")
+    ok = {
+        "hyperopt_tpu/faultsx.py":
+            "FAULT_POINTS = frozenset({'store.write'})\n",
+        "hyperopt_tpu/user.py":
+            "def f(mf):\n    mf.maybe_fail('store.write')\n",
+    }
+    clean = run_checker("registry-drift", ok, files=api)
+    assert not rules_fired(clean, "RD003")
+    assert not rules_fired(clean, "RD004")
+    undoc = run_checker("registry-drift", ok,
+                        files={"docs/API.md": "nothing\n"})
+    assert rules_fired(undoc, "RD004")
+
+
+def test_rd005_rd008_verbs_both_directions():
+    bad = {
+        "hyperopt_tpu/client.py":
+            "class C:\n    def put(self):\n"
+            "        return self._rpc('put')\n",
+        "hyperopt_tpu/server.py":
+            "def handle(verb, req):\n"
+            "    if verb == 'get':\n        return {}\n",
+    }
+    findings = run_checker("registry-drift", bad)
+    assert rules_fired(findings, "RD005")   # client 'put' has no arm
+    assert rules_fired(findings, "RD008")   # arm 'get' has no client
+    ok = {
+        "hyperopt_tpu/client.py":
+            "class C:\n    def get(self):\n"
+            "        return self._rpc('get')\n",
+        "hyperopt_tpu/server.py":
+            "def handle(verb, req):\n"
+            "    if verb == 'get':\n        return {}\n",
+    }
+    clean = run_checker("registry-drift", ok)
+    assert not rules_fired(clean, "RD005")
+    assert not rules_fired(clean, "RD008")
+
+
+def test_rd006_rd007_metrics_both_directions():
+    src = {"hyperopt_tpu/fx.py": (
+        "def emit(reg):\n"
+        "    reg.counter('fx.hits').inc()\n")}
+    drifted = run_checker(
+        "registry-drift", src,
+        files={"docs/API.md": "## Observability\n\n`fx.miss` counts\n"})
+    assert rules_fired(drifted, "RD006")    # fx.hits emitted, uncataloged
+    assert rules_fired(drifted, "RD007")    # fx.miss cataloged, unemitted
+    clean = run_checker(
+        "registry-drift", src,
+        files={"docs/API.md": "## Observability\n\n`fx.hits` counts\n"})
+    assert not rules_fired(clean, "RD006")
+    assert not rules_fired(clean, "RD007")
+
+
+def test_rd006_fstring_metric_matches_placeholder_catalog():
+    src = {"hyperopt_tpu/fx.py": (
+        "def emit(reg, v):\n"
+        "    reg.counter(f'fx.verb.{v}.calls').inc()\n")}
+    clean = run_checker(
+        "registry-drift", src,
+        files={"docs/API.md": "## Observability\n\n`fx.verb.<verb>.calls`\n"})
+    assert not rules_fired(clean, "RD006")
+    assert not rules_fired(clean, "RD007")
+
+
+# ---------------------------------------------------------------------------
+# AH — artifact honesty
+# ---------------------------------------------------------------------------
+
+
+def test_ah001_unguarded_benchmark_fires_and_guarded_silent():
+    src = {"benchmarks/bm_fixture.py": (
+        "import json\n"
+        "def main(out):\n"
+        "    json.dump({'x': 1}, out)\n")}
+    bare = run_checker("artifact-honesty", src,
+                       files={"tests/test_artifacts_contract.py":
+                              "def test_other():\n    pass\n"})
+    assert rules_fired(bare, "AH001")
+    guarded = run_checker(
+        "artifact-honesty", src,
+        files={"tests/test_artifacts_contract.py":
+               "def test_bm_fixture_schema():\n    pass\n"})
+    assert not rules_fired(guarded, "AH001")
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_match_splits_new_baselined_stale():
+    analysis = load_analysis()
+    F = analysis.Finding
+    findings = [F("JP001", "hyperopt_tpu/a.py", 3, "f", "m"),
+                F("LK002", "hyperopt_tpu/b.py", 9, "g", "m")]
+    baseline = analysis.Baseline(entries=[
+        {"rule": "JP001", "file": "hyperopt_tpu/a.py", "symbol": "f",
+         "note": "known"},
+        {"rule": "AH001", "file": "benchmarks/gone.py", "symbol": "gone",
+         "note": "fixed long ago"},
+    ])
+    new, old, stale = baseline.match(findings)
+    assert [f.rule for f in new] == ["LK002"]
+    assert [f.rule for f in old] == ["JP001"]
+    assert [e["rule"] for e in stale] == ["AH001"]
+
+
+def test_baseline_validate_rejects_unannotated_entries():
+    analysis = load_analysis()
+    baseline = analysis.Baseline(entries=[
+        {"rule": "JP001", "file": "a.py", "symbol": "f", "note": "  "},
+        {"rule": "JP001", "symbol": "f", "note": "missing file"},
+    ])
+    errs = baseline.validate()
+    assert len(errs) == 2
+    assert any("empty 'note'" in e for e in errs)
+
+
+def test_checked_in_baseline_is_valid_and_annotated():
+    analysis = load_analysis()
+    baseline = analysis.Baseline.load(
+        analysis.default_baseline_path(str(ROOT)))
+    assert baseline.entries, "repo baseline should exist and be non-empty"
+    assert baseline.validate() == []
+
+
+# ---------------------------------------------------------------------------
+# import independence (satellite: the core must run without JAX)
+# ---------------------------------------------------------------------------
+
+
+def test_analysis_package_imports_stdlib_only():
+    allowed = {"__future__", "ast", "json", "os", "re", "argparse", "sys",
+               "dataclasses"}
+    for path in sorted(PKG_DIR.glob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                tops = {a.name.split(".")[0] for a in node.names}
+            elif isinstance(node, ast.ImportFrom) and node.level == 0:
+                tops = {(node.module or "").split(".")[0]}
+            else:
+                continue
+            assert tops <= allowed, \
+                f"{path.name} imports outside the stdlib allowlist: {tops}"
+
+
+def test_runs_with_jax_blocked():
+    """The full repo analysis completes in a subprocess where importing
+    jax (or anything under it) raises — the no-JAX contract, end to end."""
+    code = f"""
+import sys, importlib.util
+class Block:
+    def find_spec(self, name, path=None, target=None):
+        if name == "jax" or name.startswith("jax."):
+            raise ImportError("jax is blocked in this test")
+        return None
+sys.meta_path.insert(0, Block())
+spec = importlib.util.spec_from_file_location(
+    "{_STANDALONE}", {str(PKG_DIR / '__init__.py')!r},
+    submodule_search_locations=[{str(PKG_DIR)!r}])
+mod = importlib.util.module_from_spec(spec)
+sys.modules["{_STANDALONE}"] = mod
+spec.loader.exec_module(mod)
+print(len(mod.run_repo({str(ROOT)!r})))
+"""
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    n_subproc = int(proc.stdout.strip())
+    analysis = load_analysis()
+    assert n_subproc == len(analysis.run_repo(str(ROOT)))

@@ -1,0 +1,89 @@
+"""Tier-1 gate: the invariant analyzers must come back clean.
+
+Clean means clean *against the annotated baseline*: zero findings
+outside it, zero stale entries (a fixed finding must delete its
+suppression — burn-down, not amnesty), zero malformed entries.  The
+gate runs the same ``run_repo`` as ``python -m hyperopt_tpu.analysis``,
+so a local CLI run and CI can never disagree, and it carries a
+wall-clock budget so the static pass stays a cheap tier-1 citizen.
+"""
+
+import pathlib
+import time
+
+from hyperopt_tpu import analysis, show
+
+ROOT = str(pathlib.Path(__file__).resolve().parents[1])
+
+
+def test_analyzers_clean_against_baseline_within_budget():
+    t0 = time.monotonic()
+    findings = analysis.run_repo(ROOT)
+    baseline = analysis.Baseline.load(analysis.default_baseline_path(ROOT))
+    elapsed = time.monotonic() - t0
+
+    assert baseline.validate() == []
+    new, _baselined, stale = baseline.match(findings)
+    assert not new, "new analyzer findings (fix or annotate+baseline):\n" \
+        + "\n".join(f.render() for f in new)
+    assert not stale, "stale baseline entries (finding fixed — delete " \
+        "the suppression):\n" + "\n".join(
+            f"{e['rule']} {e['file']} [{e['symbol']}]" for e in stale)
+    assert elapsed <= 20.0, f"analyzer pass took {elapsed:.1f}s (>20s budget)"
+
+
+def _report(**over):
+    base = {"root": ROOT, "baseline": "baseline.json",
+            "baseline_errors": [], "counts": {}, "new": [],
+            "baselined": [], "stale": []}
+    base.update(over)
+    return base
+
+
+def test_show_lint_renders_new_and_baselined(capsys):
+    finding = {"rule": "LK002", "file": "hyperopt_tpu/x.py", "line": 7,
+               "symbol": "put", "message": "unlocked write"}
+    old = {"rule": "AH001", "file": "benchmarks/b.py", "line": 1,
+           "symbol": "b", "message": "no guard"}
+    rc = show.show_lint(_report(counts={"LK002": 1, "AH001": 1},
+                                new=[finding], baselined=[old]))
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "[NEW ] hyperopt_tpu/x.py:7 [put] unlocked write" in out
+    assert "[base] benchmarks/b.py:1 [b] no guard" in out
+    assert "1 new" in out
+
+
+def test_show_lint_clean_report_exits_zero(capsys):
+    rc = show.show_lint(_report())
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 new" in out
+
+
+def test_show_lint_flags_stale_and_baseline_errors(capsys):
+    rc = show.show_lint(_report(
+        stale=[{"rule": "JP001", "file": "hyperopt_tpu/y.py",
+                "symbol": "f", "note": "fixed"}]))
+    assert rc == 1
+    assert "stale baseline entry" in capsys.readouterr().out
+    rc = show.show_lint(_report(baseline_errors=["entry 0: empty note"]))
+    assert rc == 2
+    assert "baseline error" in capsys.readouterr().out
+
+
+def test_partial_checker_run_scopes_baseline_staleness():
+    # A --checker subset must not judge the other checkers' baseline
+    # entries stale (the AH001 entries belong to artifact-honesty).
+    from hyperopt_tpu.analysis.__main__ import build_report
+    report = build_report(ROOT, analysis.default_baseline_path(ROOT),
+                          checkers=["lock-order"])
+    assert report["stale"] == []
+    assert report["new"] == []
+
+
+def test_show_lint_cli_runs_from_repo_root(capsys):
+    rc = show.main(["lint", "--root", ROOT])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 new" in out

@@ -1,0 +1,114 @@
+"""Regression tests for races the lock-order analyzer surfaced (LK002/LK003).
+
+Each test pins one fixed true positive:
+
+* ``history._states`` — the per-``trials`` state dict's first touch now
+  happens under ``history._LOCK``; two suggest threads racing it must
+  agree on ONE dict or the loser's uploads land in a store nobody reads.
+* ``StoreServer._idem_execute`` — concurrent duplicate retries of one
+  idempotency key execute the verb once; the loser parks on the
+  winner's in-flight Event and replays the same serialized reply.
+* ``_TpeKernel._batch_seeded_fn`` — the jitted-entry cache is built
+  under ``_fns_lock``, so the prewarm daemon and the suggest path can
+  no longer double-build (and double-compile) the same program.
+"""
+
+import threading
+import time
+import weakref
+
+from hyperopt_tpu import history, tpe
+from hyperopt_tpu.parallel import netstore
+
+
+def test_states_first_touch_happens_under_lock(monkeypatch):
+    asserted = []
+
+    class AssertingStore(weakref.WeakKeyDictionary):
+        def __setitem__(self, key, value):
+            # The insert is the race window: it must be inside _LOCK.
+            asserted.append(history._LOCK.locked())
+            super().__setitem__(key, value)
+
+    monkeypatch.setattr(history, "_STORE", AssertingStore())
+
+    class Trials:      # weakref-able stand-in
+        pass
+
+    tr = Trials()
+    d = history._states(tr)
+    assert d == {}
+    assert asserted == [True]
+    assert history._states(tr) is d          # same dict on re-entry
+    assert history._states(5) is None        # non-weakrefable: disabled
+
+
+def test_netstore_concurrent_idem_duplicates_execute_once(tmp_path):
+    server = netstore.StoreServer(str(tmp_path))
+    try:
+        calls = []
+        entered = threading.Event()
+        release = threading.Event()
+
+        def fake_verb(verb, req, tenant=None, idem=None):
+            calls.append(verb)
+            entered.set()
+            release.wait(5.0)
+            return {"ok": True, "serial": len(calls)}
+
+        server._dispatch_verb = fake_verb
+
+        results = []
+
+        def call():
+            results.append(server._dispatch(
+                {"verb": "insert", "exp_key": "e", "idem": "k1"}))
+
+        t1 = threading.Thread(target=call)
+        t1.start()
+        assert entered.wait(5.0)
+        # Second retry arrives while the first execution is in flight.
+        t2 = threading.Thread(target=call)
+        t2.start()
+        time.sleep(0.05)
+        release.set()
+        t1.join(5.0)
+        t2.join(5.0)
+
+        assert calls == ["insert"]           # the verb ran exactly once
+        assert results[0] == results[1] == {"ok": True, "serial": 1}
+        assert server._idem_inflight == {}   # claim released
+    finally:
+        server.shutdown()
+
+
+def test_tpe_batch_fn_cache_builds_once_under_race(monkeypatch):
+    builds = []
+
+    def counting_jit(fn, **kwargs):
+        builds.append(fn)
+        time.sleep(0.05)     # widen the build window the lock must cover
+        return fn
+
+    monkeypatch.setattr(tpe.jax, "jit", counting_jit)
+
+    kernel = object.__new__(tpe._TpeKernel)
+    kernel._batch_fns = {}
+    kernel._fns_lock = threading.Lock()
+
+    barrier = threading.Barrier(2)
+    got = []
+
+    def go():
+        barrier.wait()
+        got.append(kernel._batch_seeded_fn(4))
+
+    threads = [threading.Thread(target=go) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5.0)
+
+    assert len(builds) == 1                  # no double-build
+    assert got[0] is got[1]
+    assert set(kernel._batch_fns) == {("seeded", 4)}

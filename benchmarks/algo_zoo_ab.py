@@ -1,0 +1,148 @@
+"""Suggest-head A/B over the domain zoo: rand vs tpe vs gp vs es.
+
+The backend-registry acceptance sweep for the pluggable-head subsystem:
+every head is resolved by *name* through ``hyperopt_tpu.backends`` (the
+exact path ``fmin(algo="...")`` and the service suggest verb take), run
+over the same 5 zoo domains x 20 seeds as ``device_ab.py``, and scored
+on median best loss.  Each head is wrapped with a wall-clock shim so the
+artifact also carries per-suggest latency columns (mean + p50 ms).
+
+The headline claim this artifact backs (DESIGN.md §6): GP-EI beats
+random search on >=4/5 domains at equal budgets, and both new heads run
+through the standard ``fmin`` loop with no special-casing.
+
+Run::
+
+    env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python benchmarks/algo_zoo_ab.py
+
+Writes ``benchmarks/algo_zoo_ab_<backend>_<yyyymmdd>.json``
+(schema pinned in ``tests/test_artifacts_contract.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+SEEDS = list(range(20))
+HEADS = ["rand", "tpe", "gp", "es"]
+
+
+def _timed(fn, sink_ms):
+    """Wrap a resolved head; record per-call wall ms into ``sink_ms``.
+
+    The wrapper is opaque (no dispatch/materialize halves), which is fine
+    here: the sweep runs the synchronous loop, the same path a latency
+    measurement should time end to end.
+    """
+    def wrapper(new_ids, domain, trials, seed):
+        t0 = time.perf_counter()
+        out = fn(new_ids, domain, trials, seed)
+        sink_ms.append((time.perf_counter() - t0) * 1e3)
+        return out
+    return wrapper
+
+
+def main():
+    import hyperopt_tpu as ho
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.backends import resolve
+
+    def branin(p):
+        x, y = p["x"], p["y"]
+        return ((y - 5.1 / (4 * math.pi ** 2) * x ** 2 + 5 / math.pi * x
+                 - 6) ** 2 + 10 * (1 - 1 / (8 * math.pi)) * math.cos(x)
+                + 10)
+
+    def gauss_wave(p):
+        x = p["x"]
+        return -math.exp(-(x ** 2)) * (1 + 0.5 * math.cos(5 * x))
+
+    def distractor(p):
+        x = p["x"]
+        return -(math.exp(-((x - 3) ** 2))
+                 + 2.0 * math.exp(-((x + 3) ** 2) / 0.02 ** 2))
+
+    gw2_space = {
+        "x": hp.uniform("x", -5, 5),
+        "curve": hp.choice("curve", [
+            {"kind": "plain"},
+            {"kind": "cos", "amp": hp.uniform("amp", 0.5, 2.0)},
+        ]),
+    }
+
+    def gw2(p):
+        x = p["x"]
+        c = p["curve"]
+        if c["kind"] == "plain":
+            return -math.exp(-(x ** 2))
+        return -c["amp"] * math.exp(-(x ** 2)) * math.cos(3 * x) ** 2
+
+    domains = [
+        ("quadratic1", {"x": hp.uniform("x", -5, 5)},
+         lambda p: (p["x"] - 3.0) ** 2, 80),
+        ("branin", {"x": hp.uniform("x", -5, 10),
+                    "y": hp.uniform("y", 0, 15)}, branin, 150),
+        ("gauss_wave", {"x": hp.uniform("x", -10, 10)}, gauss_wave, 120),
+        ("distractor", {"x": hp.uniform("x", -15, 15)}, distractor, 150),
+        ("gauss_wave2", gw2_space, gw2, 150),
+    ]
+
+    rows = []
+    for name, space, fn, budget in domains:
+        cs = ho.compile_space(space)   # one sampler/kernel cache per domain
+        heads = {}
+        for head in HEADS:
+            best, lat_ms = [], []
+            algo = _timed(resolve(head), lat_ms)
+            t0 = time.perf_counter()
+            for s in SEEDS:
+                t = ho.Trials()
+                ho.fmin(fn, cs, algo=algo, max_evals=budget, trials=t,
+                        rstate=np.random.default_rng(s),
+                        show_progressbar=False, verbose=False)
+                best.append(float(t.best_trial["result"]["loss"]))
+            heads[head] = {
+                "best_median": round(float(np.median(best)), 6),
+                "best": [round(v, 6) for v in best],
+                "suggest_ms_mean": round(float(np.mean(lat_ms)), 3),
+                "suggest_ms_p50": round(float(np.median(lat_ms)), 3),
+                "wall_s": round(time.perf_counter() - t0, 1),
+            }
+            print(json.dumps({"domain": name, "head": head,
+                              **{k: v for k, v in heads[head].items()
+                                 if k != "best"}}), flush=True)
+        rec = {"domain": name, "budget": budget, "heads": heads,
+               "gp_beats_rand": heads["gp"]["best_median"]
+               <= heads["rand"]["best_median"]}
+        rows.append(rec)
+
+    import jax
+
+    n_win = sum(r["gp_beats_rand"] for r in rows)
+    out = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        f"algo_zoo_ab_{jax.default_backend()}_"
+        f"{time.strftime('%Y%m%d', time.gmtime())}.json")
+    with open(out, "w") as f:
+        json.dump({"metric": "algo_zoo_ab",
+                   "backend": jax.default_backend(),
+                   "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime()),
+                   "seeds": SEEDS, "heads": HEADS,
+                   "gp_beats_rand_domains": int(n_win),
+                   "rows": rows}, f, indent=1)
+    print(f"# gp beats rand on {n_win}/{len(rows)} domains")
+    print(f"# wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -164,3 +164,7 @@ def suggest(new_ids, domain, trials, seed,
     return base.docs_from_samples(cs, new_ids, rows,
                                   cs.active_mask_host(rows),
                                   exp_key=getattr(trials, "exp_key", None))
+
+
+#: registry hook (hyperopt_tpu.backends.contract resolves through this)
+BACKENDS = {"anneal": suggest}

@@ -581,10 +581,21 @@ def _state(trials, cs, n_arms) -> _BanditState:
 
 def suggest(new_ids, domain, trials, seed,
             n_startup_jobs=tpe._default_n_startup_jobs,
-            linear_forgetting=tpe._default_linear_forgetting):
-    """Adaptive-TPE suggest (drop-in for ``hyperopt/atpe.py::suggest``)."""
+            linear_forgetting=tpe._default_linear_forgetting,
+            extra_algos=()):
+    """Adaptive-TPE suggest (drop-in for ``hyperopt/atpe.py::suggest``).
+
+    ``extra_algos`` widens the bandit's portfolio beyond TPE
+    configurations: each entry is a backend-registry name (``"gp"``,
+    ``"es"``, anything :func:`hyperopt_tpu.backends.resolve` accepts)
+    added as one more arm.  The Thompson bandit then learns per problem
+    whether a whole different *head* beats the TPE arms — the adaptive
+    analog of ``mix.suggest``'s fixed weights.  Delegated arms skip the
+    TPE-specific lockout/prewarm machinery but share the same
+    improvement-reward accounting and transfer memory."""
     cs = domain.cs
     arms = _portfolio(cs)
+    arms += [dict(algo=str(name)) for name in extra_algos]
     st = _state(trials, cs, len(arms))
     st.settle(trials)
     rng = np.random.default_rng(int(seed) % (2 ** 32))
@@ -593,12 +604,21 @@ def suggest(new_ids, domain, trials, seed,
     _reg.counter("atpe.suggest.calls").inc()
     _reg.counter(f"atpe.arm.{arm}.picked").inc()
     cfg = dict(arms[arm])
-    lockout = cfg.pop("lockout", None)
-    cfg.setdefault("linear_forgetting", linear_forgetting)
     try:
         best = trials.best_trial["result"]["loss"]
     except Exception:
         best = None
+    algo_name = cfg.pop("algo", None)
+    if algo_name is not None:
+        from .backends import contract as _backends
+
+        docs = _backends.resolve(algo_name)(new_ids, domain, trials,
+                                            int(seed))
+        for d in docs:
+            st.pending[d["tid"]] = (arm, best)
+        return docs
+    lockout = cfg.pop("lockout", None)
+    cfg.setdefault("linear_forgetting", linear_forgetting)
     rows, acts = tpe.suggest_batch(new_ids, domain, trials, seed,
                                    n_startup_jobs=n_startup_jobs, **cfg)
     if best is not None and len(trials) >= n_startup_jobs:
@@ -614,3 +634,7 @@ def suggest(new_ids, domain, trials, seed,
     for d in docs:
         st.pending[d["tid"]] = (arm, best)
     return docs
+
+
+#: registry hook (hyperopt_tpu.backends.contract resolves through this)
+BACKENDS = {"atpe": suggest}

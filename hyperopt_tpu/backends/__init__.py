@@ -1,0 +1,17 @@
+"""Pluggable suggest backends: the contract, the registry, and the
+model-based heads that live outside the Parzen family.
+
+Import surface is deliberately tiny and JAX-free: ``contract`` (and the
+re-exports below) never import jax or any algo module — heads load
+lazily on first :func:`resolve`, so plain-store netstore servers and
+analysis tooling keep their no-JAX property.  See
+:mod:`hyperopt_tpu.backends.contract` for the SuggestBackend protocol.
+"""
+
+from .contract import (  # noqa: F401
+    UnknownBackend,
+    names,
+    register_backend,
+    resolve,
+    run_conformance,
+)

@@ -1,0 +1,136 @@
+"""Shared unit-cube codec for the model-based suggest backends.
+
+GP and ES both model the search space as ``[0, 1]^P``: history rows are
+*encoded* into the cube before fitting, and proposals are *decoded* back
+to raw parameter values that round-trip through the same
+quantize/clip/exp rules as :meth:`CompiledSpace.sample_traced` (so a
+decoded row is always a row the prior sampler could have produced, and
+``base.docs_from_samples`` / ``active_mask_host`` treat it identically).
+
+The per-pid metadata is plain host numpy built ONCE per CompiledSpace
+(outside any traced function — the jit-purity JP003 discipline); the
+encode/decode helpers are pure jnp and safe to close over inside jitted
+programs.
+
+Column conventions by parameter family:
+
+* uniform family — affine in *fit space* (log space for loguniform):
+  ``z = (t - a) / (b - a)`` with ``t = log(x)`` where ``is_log``.
+* normal family — affine over the ±3σ core, clipped to [0, 1].
+* categorical / probabilistic randint — ``encode(..., cat="index")``
+  keeps the raw option index (the GP's Hamming-style kernel distance);
+  ``cat="unit"`` maps index k of K to ``(k + 0.5) / K`` (the ES
+  continuous relaxation).  Decode inverts the latter via
+  ``floor(z·K)``.
+* wide randint — affine over [low, high); decode floors back to the
+  integer lattice.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+#: kind codes in the per-pid ``kind`` array
+K_UF, K_NF, K_CAT, K_WIDE = 0, 1, 2, 3
+
+
+def unit_meta(cs):
+    """Per-pid codec constants for ``cs`` as a dict of host numpy arrays.
+
+    Keys: ``kind`` (int32 family code), ``a``/``b`` (fit-space affine
+    bounds; for cat columns ``b - a`` is unused), ``is_log``, ``q``
+    (quantization step, 0 = none), ``clip_lo``/``clip_hi`` (raw-space
+    clip after decode), ``cat_k`` (option count, 1 for non-cat),
+    ``cat_off`` (randint low offset for probabilistic-randint columns).
+    """
+    P = cs.n_params
+    kind = np.zeros(P, np.int32)
+    a = np.zeros(P, np.float32)
+    b = np.ones(P, np.float32)
+    is_log = np.zeros(P, bool)
+    q = np.zeros(P, np.float32)
+    clip_lo = np.full(P, -np.inf, np.float32)
+    clip_hi = np.full(P, np.inf, np.float32)
+    cat_k = np.ones(P, np.float32)
+    cat_off = np.zeros(P, np.float32)
+    for i, p in enumerate(cs._uf):
+        pid = p.pid
+        kind[pid] = K_UF
+        a[pid], b[pid] = cs._uf_a[i], cs._uf_b[i]
+        is_log[pid] = cs._uf_log[i]
+        q[pid] = cs._uf_q[i]
+        clip_lo[pid], clip_hi[pid] = cs._uf_clip_lo[i], cs._uf_clip_hi[i]
+    for i, p in enumerate(cs._nf):
+        pid = p.pid
+        kind[pid] = K_NF
+        mu, sg = float(cs._nf_mu[i]), float(cs._nf_sigma[i])
+        a[pid], b[pid] = mu - 3.0 * sg, mu + 3.0 * sg
+        is_log[pid] = cs._nf_log[i]
+        q[pid] = cs._nf_q[i]
+        clip_lo[pid], clip_hi[pid] = -cs._nf_clip[i], cs._nf_clip[i]
+    for i, p in enumerate(cs._cat):
+        pid = p.pid
+        kind[pid] = K_CAT
+        cat_k[pid] = float(p.n_options)
+        cat_off[pid] = cs._cat_offset[i]
+    for i, p in enumerate(cs._wide):
+        pid = p.pid
+        kind[pid] = K_WIDE
+        a[pid], b[pid] = float(cs._wide_low[i]), float(cs._wide_high[i])
+    # Degenerate spans (single-point uniforms, K=1 randints) would divide
+    # by zero in encode; widen to a unit span — z is constant either way.
+    span = b - a
+    b = np.where(span > 0, b, a + 1.0).astype(np.float32)
+    return dict(kind=kind, a=a, b=b, is_log=is_log, q=q,
+                clip_lo=clip_lo, clip_hi=clip_hi,
+                cat_k=cat_k, cat_off=cat_off)
+
+
+def encode(meta, vals, active, cat="index"):
+    """Raw rows ``vals f32[N, P]`` → unit-cube rows (traceable).
+
+    Inactive numeric entries impute to 0.5 (the cube center — distance-
+    neutral for the GP, update-neutral for ES); inactive categorical
+    entries impute to -1 under ``cat="index"`` (a pseudo-level no real
+    row matches) and to 0.5 under ``cat="unit"``.
+    """
+    kind = jnp.asarray(meta["kind"])
+    t = jnp.where(jnp.asarray(meta["is_log"]),
+                  jnp.log(jnp.maximum(vals, 1e-12)), vals)
+    z_num = (t - jnp.asarray(meta["a"])) \
+        / (jnp.asarray(meta["b"]) - jnp.asarray(meta["a"]))
+    z_num = jnp.clip(z_num, 0.0, 1.0)
+    idx = vals - jnp.asarray(meta["cat_off"])
+    if cat == "index":
+        z_cat = idx
+        fill = jnp.where(kind == K_CAT, -1.0, 0.5)
+    else:
+        z_cat = (idx + 0.5) / jnp.asarray(meta["cat_k"])
+        fill = jnp.full((vals.shape[1],), 0.5, vals.dtype)
+    z = jnp.where(kind == K_CAT, z_cat, z_num)
+    return jnp.where(active, z, fill)
+
+
+def decode(meta, z):
+    """Unit-cube rows ``z f32[n, P]`` → raw parameter rows (traceable).
+
+    Applies the family-exact inverse transforms — exp for log-scaled
+    columns, q-lattice rounding, clip — so decoded rows land on the same
+    value lattice as prior samples.
+    """
+    kind = jnp.asarray(meta["kind"])
+    a, b = jnp.asarray(meta["a"]), jnp.asarray(meta["b"])
+    t = a + z * (b - a)
+    x = jnp.where(jnp.asarray(meta["is_log"]), jnp.exp(t), t)
+    q = jnp.asarray(meta["q"])
+    x = jnp.where(q > 0, jnp.round(x / jnp.where(q > 0, q, 1.0)) * q, x)
+    x = jnp.clip(x, jnp.asarray(meta["clip_lo"]), jnp.asarray(meta["clip_hi"]))
+    cat_k = jnp.asarray(meta["cat_k"])
+    x_cat = jnp.asarray(meta["cat_off"]) \
+        + jnp.clip(jnp.floor(z * cat_k), 0.0, cat_k - 1.0)
+    span = jnp.maximum(b - a, 1.0)
+    x_wide = a + jnp.clip(jnp.floor(z * span), 0.0, span - 1.0)
+    return jnp.where(kind == K_CAT, x_cat,
+                     jnp.where(kind == K_WIDE, x_wide, x))

@@ -1,0 +1,363 @@
+"""The SuggestBackend contract: protocol, registry, conformance suite.
+
+ROADMAP item 3: the dispatch/materialize split that ``fmin``,
+``pipeline.py`` and ``fleet.py`` consume was implicit folklore inside
+``tpe.py``.  This module makes it a real plugin boundary.
+
+The protocol (four halves + two substrate conventions)
+------------------------------------------------------
+
+A *suggest backend* is a callable with the reference plugin signature::
+
+    suggest(new_ids, domain, trials, seed, **kw) -> [trial docs]
+
+Dispatch-capable backends additionally attach four attributes on the
+callable — the halves the depth-D pipeline drives
+(:class:`hyperopt_tpu.pipeline.PipelinedExecutor`):
+
+``suggest.dispatch(new_ids, domain, trials, seed, **kw) -> handle``
+    Enqueue the proposal computation on device and return an *opaque*
+    handle WITHOUT forcing it.  History must be snapshotted at dispatch
+    time (the one-step-stale posterior every async optimizer accepts).
+    The canonical handle layout — shared by TPE, GP and ES so their
+    materialize/transfer/ready halves are one implementation — is
+    ``(tag, cs, new_ids, (rows, acts), exp_key)`` with ``tag`` either
+    ``"ready"`` (host arrays, e.g. startup draws) or ``"pending"``
+    (unforced device arrays; ``acts`` may be None — the activity mask
+    is rebuilt host-side from the forced rows, which keeps the
+    materialize at ONE device sync).
+``suggest.materialize(handle) -> [trial docs]``
+    Block on the handle and package trial documents
+    (``base.docs_from_samples``).  ``suggest(...)`` itself must equal
+    dispatch + immediate materialize for the same arguments — the sync
+    and overlapped paths may not drift apart (pinned per head by the
+    conformance suite below).
+``suggest.start_transfer(handle) -> handle``
+    Begin the device→host copy without blocking
+    (``jax.Array.copy_to_host_async``); a no-op on ready handles.
+``suggest.handle_ready(handle) -> bool``
+    True when materialize will not block (``jax.Array.is_ready``).
+    Must never itself block: the executor polls it for stall
+    attribution.
+
+Backends without the attributes are *sync-only*: ``fmin`` degrades to
+the synchronous loop (``rand``, ``qmc``, ``anneal``, ``atpe``).  All
+four halves must be present together or absent together.
+
+Substrate conventions every model-based head follows:
+
+* **History feed** — read the dense SoA history ``trials.history(cs)``
+  and, when ``history.enabled()``, feed the jitted program through the
+  device-resident ring ``history.device_history(trials, cs, h, n_cap,
+  fantasies=...)`` so each trial uploads O(P) bytes, not O(N·P).
+  Bucket ``n_cap`` with ``tpe._bucket`` so programs are shared across
+  runs.
+* **Constant-liar overlay** — trials currently NEW/RUNNING enter the
+  snapshot as fantasy rows at the mean observed loss
+  (``tpe._inflight_fantasy_rows`` → the ring's overlay slots), so a
+  depth-D pipeline's concurrent dispatches repel each other's pending
+  points.  Within one batched dispatch the same lie value drives the
+  liar-scan (propose → fantasize → refit, ``lax.scan``).
+
+The registry
+------------
+
+:func:`resolve` maps ``fmin``'s ``algo="..."`` strings (and the service
+``suggest`` verb's ``algo`` field) to registered callables.  Builtin
+heads live in lazy per-module ``BACKENDS`` dicts — nothing is imported
+until its name is first resolved, so plain-store netstore servers keep
+their no-JAX-until-suggest property.  :func:`register_backend` adds
+third-party heads at runtime; unknown names raise the typed
+:class:`UnknownBackend` (a ``ValueError``, which is what the service
+verb serializes over the wire).
+
+The conformance suite
+---------------------
+
+``check_sync_parity`` / ``check_handle_protocol`` /
+``check_pipeline_depth2`` / ``check_transient_retry`` are reusable
+checks any head must pass; ``tests/test_backends.py`` parametrizes them
+over every registered head.  They are ordinary functions raising
+``AssertionError`` so external backend authors can run them against
+their own heads without pytest.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+
+from ..obs.metrics import registry as _metrics_registry
+
+#: name -> module path holding a ``BACKENDS`` dict with that name.
+#: Lazy by construction: resolving one name imports one module.
+_BUILTIN_SPECS = {
+    "tpe": "hyperopt_tpu.tpe",
+    "tpe_quantile": "hyperopt_tpu.tpe",
+    "tpe_sobol": "hyperopt_tpu.tpe",
+    "tpe_mv": "hyperopt_tpu.tpe",
+    "rand": "hyperopt_tpu.rand",
+    "random": "hyperopt_tpu.rand",
+    "qmc": "hyperopt_tpu.qmc",
+    "sobol": "hyperopt_tpu.qmc",
+    "halton": "hyperopt_tpu.qmc",
+    "anneal": "hyperopt_tpu.anneal",
+    "atpe": "hyperopt_tpu.atpe",
+    "gp": "hyperopt_tpu.backends.gp",
+    "es": "hyperopt_tpu.backends.es",
+}
+
+_REGISTRY: dict = {}            # name -> suggest callable (resolved)
+_REGISTRY_LOCK = threading.Lock()
+
+
+class UnknownBackend(ValueError):
+    """``algo`` name with no registered backend.  Subclasses ValueError
+    so the service ``suggest`` verb's wire behavior (a server-reported
+    ValueError) is unchanged by the registry refactor."""
+
+
+def register_backend(name: str, fn, replace: bool = False) -> None:
+    """Register ``fn`` as the suggest backend for ``algo=name``.
+
+    ``fn`` must follow the plugin signature above; attach the four
+    dispatch halves for pipeline capability.  Re-registering an existing
+    name requires ``replace=True`` (guards against alias collisions with
+    the builtins).
+    """
+    if not callable(fn):
+        raise TypeError(f"backend {name!r} must be callable, got "
+                        f"{type(fn).__name__}")
+    with _REGISTRY_LOCK:
+        if not replace and name in _REGISTRY or \
+                not replace and name in _BUILTIN_SPECS:
+            raise ValueError(f"backend {name!r} already registered "
+                             "(pass replace=True to override)")
+        _REGISTRY[name] = fn
+
+
+def _load_builtin(name: str):
+    """Import the builtin module owning ``name`` and cache every head its
+    ``BACKENDS`` dict declares (one import populates all its aliases)."""
+    module = importlib.import_module(_BUILTIN_SPECS[name])
+    table = module.BACKENDS
+    with _REGISTRY_LOCK:
+        for alias, fn in table.items():
+            _REGISTRY.setdefault(alias, fn)
+    return table[name]
+
+
+def resolve(name: str):
+    """Resolve an ``algo=`` string to its suggest callable.
+
+    Raises :class:`UnknownBackend` (a ValueError) for unregistered
+    names, listing what is available.
+    """
+    fn = _REGISTRY.get(name)
+    if fn is None:
+        if name not in _BUILTIN_SPECS:
+            raise UnknownBackend(
+                f"unknown algo {name!r} (have {names()}) — register new "
+                "heads with hyperopt_tpu.backends.register_backend or "
+                "pass a suggest callable")
+        fn = _load_builtin(name)
+    _metrics_registry().counter(f"backend.{name}.resolved").inc()
+    return fn
+
+
+def names() -> list:
+    """Every resolvable backend name (builtins + runtime-registered),
+    sorted.  Imports nothing: builtin names are known statically."""
+    with _REGISTRY_LOCK:
+        dynamic = set(_REGISTRY)
+    return sorted(dynamic | set(_BUILTIN_SPECS))
+
+
+def server_table() -> dict:
+    """``{name: callable}`` for the netstore ``suggest`` verb: every
+    registered head, with console verbosity suppressed where the head
+    supports it (a server must not chat on a driver's behalf)."""
+    import functools
+    import inspect
+
+    table = {}
+    for name in names():
+        fn = resolve(name)
+        try:
+            params = inspect.signature(fn).parameters
+        except (TypeError, ValueError):  # pragma: no cover - exotic callables
+            params = {}
+        if "verbose" in params:
+            fn = functools.partial(fn, verbose=False)
+        table[name] = fn
+    return table
+
+
+# ---------------------------------------------------------------------------
+# conformance suite
+# ---------------------------------------------------------------------------
+
+#: The checks every head must pass (tests/test_backends.py parametrizes
+#: them over all registered names).
+CONFORMANCE_CHECKS = ("sync_parity", "handle_protocol",
+                      "pipeline_depth2", "transient_retry")
+
+_HALVES = ("dispatch", "materialize", "start_transfer", "handle_ready")
+
+
+def halves_of(fn):
+    """``(dispatch, materialize, start_transfer, handle_ready)`` of a
+    head, or ``(None,)*4`` for sync-only heads.  Unwraps keyword-only
+    ``functools.partial`` the same way ``FMinIter`` does, re-binding the
+    partial's keywords onto the dispatch half, so configured variants
+    (``tpe_sobol``, ``tpe_mv``) keep their pipeline capability."""
+    import functools
+
+    kw = {}
+    if isinstance(fn, functools.partial) and not fn.args:
+        kw = dict(fn.keywords or {})
+        fn = fn.func
+    halves = [getattr(fn, a, None) for a in _HALVES]
+    if halves[0] is not None and kw:
+        halves[0] = functools.partial(halves[0], **kw)
+    return tuple(halves)
+
+
+def conformance_domain():
+    """Small mixed space (continuous + categorical) every check runs on."""
+    from .. import base, hp
+
+    space = {"x": hp.uniform("x", -2.0, 2.0),
+             "c": hp.choice("c", [0, 1, 2])}
+    return base.Domain(_conformance_objective, space)
+
+
+def _conformance_objective(p):
+    return (p["x"] - 0.5) ** 2 + 0.1 * p["c"]
+
+
+def seeded_trials(domain, n=24, seed=0, exp_key=None):
+    """A Trials pre-loaded with ``n`` completed random trials — enough to
+    put every model-based head past its startup phase.  Deterministic in
+    ``seed`` so two calls produce identical histories (the sync-parity
+    check's precondition)."""
+    from .. import base, rand
+
+    t = base.Trials(exp_key=exp_key)
+    docs = rand.suggest(list(range(n)), domain, t, seed)
+    for d in docs:
+        vals = d["misc"]["vals"]
+        x = vals["x"][0]
+        c = vals["c"][0] if vals["c"] else 0
+        d["state"] = base.JOB_STATE_DONE
+        d["result"] = {"status": base.STATUS_OK,
+                       "loss": float(_conformance_objective(
+                           {"x": x, "c": c}))}
+    t.insert_trial_docs(docs)
+    t.refresh()
+    return t
+
+
+def check_sync_parity(fn, n=4, seed=1234):
+    """``suggest(...)`` equals its own dispatch + materialize (when the
+    halves exist) and is deterministic in ``(history, seed)`` — compared
+    through the JSON wire form like the service contract test."""
+    import json
+
+    domain = conformance_domain()
+    ids = list(range(24, 24 + n))
+    docs_sync = fn(ids, domain, seeded_trials(domain), seed)
+    dispatch, materialize = halves_of(fn)[:2]
+    if dispatch is not None:
+        handle = dispatch(ids, domain, seeded_trials(domain), seed)
+        docs_async = materialize(handle)
+    else:
+        docs_async = fn(ids, domain, seeded_trials(domain), seed)
+    assert json.loads(json.dumps(docs_sync)) == \
+        json.loads(json.dumps(docs_async)), \
+        "sync suggest and dispatch+materialize (or a re-run on an " \
+        "identical history) disagree"
+    assert [d["tid"] for d in docs_sync] == ids
+
+
+def check_handle_protocol(fn, n=3, seed=77):
+    """Dispatch handles obey the four-halves protocol: all four
+    attributes present together (or none), ``handle_ready`` returns a
+    bool without blocking, ``start_transfer`` never raises, materialize
+    yields exactly ``len(new_ids)`` docs."""
+    dispatch, materialize, start_transfer, handle_ready = halves_of(fn)
+    halves = (dispatch, materialize, start_transfer, handle_ready)
+    if all(h is None for h in halves):
+        return "sync-only"
+    assert all(h is not None for h in halves), \
+        f"partial protocol: need all of {_HALVES} or none"
+    domain = conformance_domain()
+    ids = list(range(24, 24 + n))
+    handle = dispatch(ids, domain, seeded_trials(domain), seed)
+    ready = handle_ready(handle)
+    assert isinstance(ready, bool)
+    start_transfer(handle)
+    docs = materialize(handle)
+    assert len(docs) == n
+    assert bool(handle_ready(handle)) is True  # forced => ready
+    # The startup path must produce an immediately-ready handle.
+    from .. import base
+    cold = dispatch([0, 1], domain, base.Trials(), seed)
+    assert handle_ready(cold) is True
+    return "dispatch-capable"
+
+
+def check_pipeline_depth2(fn, max_evals=26, seed=5):
+    """A depth-2 pipelined fmin completes with every trial recorded —
+    the head runs unmodified under overlapped dispatch (sync-only heads
+    exercise the graceful degradation path)."""
+    from .. import base
+    from ..fmin import fmin
+    import numpy as np
+
+    domain = conformance_domain()
+    t = base.Trials()
+    fmin(_conformance_objective, domain.expr, algo=fn,
+         max_evals=max_evals, trials=t,
+         rstate=np.random.default_rng(seed), overlap_depth=2,
+         show_progressbar=False, verbose=False)
+    t.refresh()
+    assert len(t.trials) == max_evals
+    states = [d["state"] for d in t.trials]
+    assert all(s == base.JOB_STATE_DONE for s in states), states
+    assert t.best_trial["result"]["loss"] is not None
+
+
+def check_transient_retry(fn, max_evals=6, seed=9):
+    """Transient objective faults are retried in place: with an armed
+    ``objective.call`` schedule and a retry budget, the run still
+    completes every trial."""
+    from .. import base, faults
+    from ..fmin import fmin
+    import numpy as np
+
+    domain = conformance_domain()
+    t = base.Trials()
+    with faults.injected("objective.call", prob=1.0, times=2, seed=3):
+        fmin(_conformance_objective, domain.expr, algo=fn,
+             max_evals=max_evals, trials=t,
+             rstate=np.random.default_rng(seed), max_trial_retries=3,
+             show_progressbar=False, verbose=False)
+    t.refresh()
+    assert len(t.trials) == max_evals
+    assert all(d["state"] == base.JOB_STATE_DONE for d in t.trials)
+    retried = [d for d in t.trials if d["misc"].get("fail_count")]
+    assert retried, "no trial recorded a retried transient fault"
+
+
+def run_conformance(fn) -> dict:
+    """Run the full suite against one head; returns per-check outcomes.
+    External backend authors: ``run_conformance(my_suggest)`` raising
+    nothing means the head composes with fmin, the pipeline and the
+    faults harness."""
+    return {
+        "sync_parity": check_sync_parity(fn) or "ok",
+        "handle_protocol": check_handle_protocol(fn),
+        "pipeline_depth2": check_pipeline_depth2(fn) or "ok",
+        "transient_retry": check_transient_retry(fn) or "ok",
+    }

@@ -1,0 +1,177 @@
+"""Evolution-strategies suggest backend (OpenES-style, population-as-array).
+
+A model-free head in the evosax idiom: the search distribution is an
+isotropic Gaussian over the unit cube whose mean evolves by the OpenES
+natural-gradient estimate, and a *generation* is one population of
+``popsize`` trials.  Proposals are antithetic pairs ``mean ± σ·ε`` —
+the variance-reduction trick OpenES ships with — decoded back to raw
+parameter rows in-program.
+
+State lives NOWHERE on the host: the head is *stateless by replay*.
+Each dispatch reconstructs the strategy state inside one jitted program
+from the device-resident history feed — completed trials, taken in
+insertion order, ARE the generations, and a ``lax.scan`` over them
+replays every completed generation's mean update (centered-rank shaped
+by default).  Replay is O(generations) fused device work per dispatch;
+in exchange the head inherits every substrate property for free —
+fault-injected retries, service-side suggest, WAL recovery, and
+process restarts all resume the strategy exactly, because the history
+IS the state.  Partial generations (the tail ``n_ok % popsize`` trials)
+don't move the mean; in-flight fantasy rows are ignored entirely (a
+model-free update has no posterior to fantasize into — proposals within
+one generation are independent draws by design, which is ES's native
+batch parallelism).
+
+Handle layout and the materialize/transfer/ready halves are shared with
+``tpe``; only dispatch differs.  Population state (the generation
+matrix ``[G, popsize, P]``) is a batched device array throughout —
+never a per-individual host loop.
+"""
+
+from __future__ import annotations
+
+import os
+from time import perf_counter
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import tpe as _tpe
+from .. import history as _rhist
+from . import _codec
+from ..obs.metrics import registry as _metrics_registry
+
+_default_sigma0 = 0.25
+_default_lr = 0.5
+_SIGMA_DECAY = 0.97
+
+
+def _default_popsize() -> int:
+    raw = os.environ.get("HYPEROPT_TPU_ES_POPSIZE", "")
+    try:
+        return max(2, int(raw)) if raw else 8
+    except ValueError:
+        return 8
+
+
+def _build_suggest_fn(cs, n_cap, m, popsize, sigma0, lr, rank_shaping):
+    """Compile replay + proposal for one (bucket, batch, strategy-config)
+    shape.  Codec meta and static sizes close over here, outside the
+    traced function."""
+    meta = _codec.unit_meta(cs)
+    n_gens = max(1, n_cap // popsize)
+    n_take = n_gens * popsize
+    half = (m + 1) // 2
+
+    def run(seed, hv, ha, hl, hok):
+        key = jax.random.PRNGKey(seed)
+        z = _codec.encode(meta, hv, ha, cat="unit")
+        # Completed trials in insertion order are the generations: a
+        # stable argsort moves ok rows to the front without changing
+        # their relative order (indices are unique, so the sort key
+        # ``ok ? i : n_cap`` is a strict total order).
+        order = jnp.argsort(jnp.where(hok, jnp.arange(n_cap), n_cap))
+        take = order[:n_take]
+        zg = z[take].reshape(n_gens, popsize, -1)
+        ag = ha[take].astype(jnp.float32).reshape(n_gens, popsize, -1)
+        lg = jnp.where(hok, hl, 0.0)[take].reshape(n_gens, popsize)
+        full = jnp.sum(hok.astype(jnp.int32)) // popsize
+
+        def step(mean, inp):
+            g, zgen, agen, lgen = inp
+            live = (g < full).astype(jnp.float32)
+            if rank_shaping:
+                # Centered ranks of fitness (-loss): best → +0.5,
+                # worst → -0.5; invariant to loss scale and outliers.
+                ranks = jnp.argsort(jnp.argsort(-lgen))
+                w = ranks.astype(jnp.float32) / (popsize - 1) - 0.5
+            else:
+                f = -lgen
+                w = (f - f.mean()) / (f.std() + 1e-8) / 2.0
+            upd = (2.0 / popsize) * jnp.sum(
+                w[:, None] * agen * (zgen - mean), axis=0)
+            mean = jnp.clip(mean + live * lr * upd, 0.0, 1.0)
+            return mean, None
+
+        mean0 = jnp.full((z.shape[1],), 0.5, z.dtype)
+        mean, _ = jax.lax.scan(step, mean0,
+                               (jnp.arange(n_gens), zg, ag, lg))
+        sigma = sigma0 * jnp.power(_SIGMA_DECAY, full.astype(jnp.float32))
+        eps = jax.random.normal(key, (half, z.shape[1]), z.dtype)
+        eps = jnp.concatenate([eps, -eps], axis=0)[:m]
+        zprop = jnp.clip(mean[None, :] + sigma * eps, 0.0, 1.0)
+        return _codec.decode(meta, zprop)
+
+    return jax.jit(run)
+
+
+def _get_suggest_fn(cs, n_cap, m, popsize, sigma0, lr, rank_shaping):
+    cache = getattr(cs, "_es_kernels", None)
+    if cache is None:
+        cache = {}
+        cs._es_kernels = cache
+    key = (n_cap, m, popsize, float(sigma0), float(lr), bool(rank_shaping))
+    fn = cache.get(key)
+    if fn is None:
+        fn = _build_suggest_fn(cs, n_cap, m, popsize, sigma0, lr,
+                               rank_shaping)
+        cache[key] = fn
+    return fn
+
+
+def suggest_dispatch(new_ids, domain, trials, seed, n_startup_jobs=None,
+                     popsize=None, sigma0=_default_sigma0, lr=_default_lr,
+                     rank_shaping=True, startup=None):
+    """Enqueue the ES replay + proposal program; tpe-layout handle."""
+    cs = domain.cs
+    n = len(new_ids)
+    exp_key = getattr(trials, "exp_key", None)
+    reg = _metrics_registry()
+    reg.counter("backend.es.suggest.calls").inc()
+    popsize = _default_popsize() if popsize is None else max(2, int(popsize))
+    if n_startup_jobs is None:
+        n_startup_jobs = popsize
+    if n == 0 or cs.n_params == 0:
+        return ("ready", cs, list(new_ids),
+                (np.zeros((n, cs.n_params), np.float32),
+                 np.ones((n, cs.n_params), bool)), exp_key)
+    h = trials.history(cs)
+    if int(h["ok"].sum()) < n_startup_jobs:
+        v, a = _tpe._startup_batch(startup, new_ids, domain, trials, seed)
+        if not isinstance(a, np.ndarray):
+            v = np.asarray(v)
+            a = cs.active_mask_host(v)
+        return ("ready", cs, list(new_ids),
+                (np.asarray(v), np.asarray(a)), exp_key)
+    n_rows = h["vals"].shape[0]
+    n_cap = _tpe._bucket(n_rows)
+    m = _tpe._batch_size_for(n)
+    fn = _get_suggest_fn(cs, n_cap, m, popsize, sigma0, lr, rank_shaping)
+    t_feed = perf_counter()
+    if _rhist.enabled():
+        hv, ha, hl, hok = _rhist.device_history(trials, cs, h, n_cap)
+    else:
+        hv, ha, hl, hok = _tpe._padded_history(h, n_cap)
+    _tpe._obs_ms(reg, "suggest.upload_ms", (perf_counter() - t_feed) * 1e3)
+    t_disp = perf_counter()
+    rows = fn(np.uint32(int(seed) % (2 ** 32)), hv, ha, hl, hok)
+    _tpe._obs_ms(reg, "backend.es.dispatch_ms",
+                 (perf_counter() - t_disp) * 1e3)
+    return ("pending", cs, list(new_ids), (rows, None), exp_key)
+
+
+def suggest(new_ids, domain, trials, seed, **kwargs):
+    """OpenES proposals for ``new_ids`` — dispatch + immediate force."""
+    return _tpe.suggest_materialize(
+        suggest_dispatch(new_ids, domain, trials, seed, **kwargs))
+
+
+suggest.dispatch = suggest_dispatch
+suggest.materialize = _tpe.suggest_materialize
+suggest.start_transfer = _tpe.suggest_start_transfer
+suggest.handle_ready = _tpe.suggest_handle_ready
+
+#: registry hook (hyperopt_tpu.backends.contract resolves through this)
+BACKENDS = {"es": suggest}

@@ -1,0 +1,250 @@
+"""GP-EI suggest backend: jitted Gaussian-process surrogate on the
+shared batched-kernel substrate.
+
+The canonical Bayesian-optimization head (Snoek et al., "Practical
+Bayesian Optimization of Machine Learning Algorithms"): a Matérn-5/2 GP
+over the unit-cube encoding of the search space, fit by Cholesky solve,
+proposing the argmax of analytic expected improvement over a candidate
+sweep drawn from the prior sampler.  Everything from history feed to
+proposal row runs in ONE jitted XLA program per (bucket, candidate
+count, batch size) triple, cached on ``cs._gp_kernels`` exactly like
+the TPE kernel cache.
+
+Substrate reuse (the point of the backends/ contract):
+
+* History arrives through the SAME feed as TPE — the device-resident
+  ring (``history.device_history``, delta-upload) when enabled, the
+  host-padded form otherwise, bucketed by ``tpe._bucket`` so programs
+  are shared across runs.
+* In-flight trials (depth-D pipeline, pool workers) enter as
+  constant-liar fantasy rows through the ring's overlay slots
+  (``tpe._inflight_fantasy_rows``) — the GP fits them at the mean
+  observed loss like every other head, so it pipelines at depth D
+  unchanged.
+* Within one batched dispatch the liar idea repeats in-program: a
+  ``lax.scan`` proposes, fantasizes the proposal at the lie (exactly 0
+  in standardized-loss space, since the lie IS the mean), refits, and
+  proposes again — m proposals, m Cholesky factorizations, zero host
+  round-trips.
+* The handle layout and materialize/transfer/ready halves are
+  literally ``tpe``'s — GP only supplies a different dispatch.
+
+Model details: columns encoded to [0, 1] per family (log-space for
+log-scaled params, ±3σ core for normals); categorical columns use an
+index encoding with a Hamming-style kernel distance (0.25 per mismatch)
+so one categorical flip costs half a length-scale, not a continuum
+move; inactive params impute distance-neutrally.  Hyperparameters are
+selected per dispatch by log-marginal-likelihood over a small
+(length-scale × noise) grid, vmapped so the whole grid is one batched
+Cholesky.  Fit cost is bounded by ``HYPEROPT_TPU_GP_MAX_N`` (default
+256): past that many observations the fit gathers the lowest-loss rows
+— O(max_n³) per dispatch forever, the standard subset-of-data
+sparsification.
+"""
+
+from __future__ import annotations
+
+import os
+from time import perf_counter
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import tpe as _tpe
+from .. import history as _rhist
+from . import _codec
+from ..obs.metrics import registry as _metrics_registry
+
+_default_n_startup_jobs = 10
+_default_n_EI_candidates = 64
+
+#: (length-scale, noise) grid scored by log marginal likelihood each
+#: dispatch.  Length-scales are in unit-cube units.
+_LS_GRID = np.asarray([0.1, 0.2, 0.4, 0.8], np.float32)
+_NOISE_GRID = np.asarray([1e-4, 1e-2], np.float32)
+
+
+def _max_fit_rows() -> int:
+    raw = os.environ.get("HYPEROPT_TPU_GP_MAX_N", "")
+    try:
+        return max(16, int(raw)) if raw else 256
+    except ValueError:
+        return 256
+
+
+def _build_suggest_fn(cs, n_cap, n_cand, m, max_n):
+    """Compile the full GP-EI dispatch for one (bucket, sweep, batch)
+    shape.  All host-side meta (codec constants, hyper grid, static
+    sizes) is closed over here, OUTSIDE the traced function — the
+    jit-purity discipline every kernel in the repo follows."""
+    meta = _codec.unit_meta(cs)
+    is_cat = np.asarray(meta["kind"] == _codec.K_CAT)
+    n_eff = min(n_cap, max_n)
+    ls_grid, noise_grid = np.meshgrid(_LS_GRID, _NOISE_GRID)
+    ls_grid = np.ascontiguousarray(ls_grid.ravel())
+    noise_grid = np.ascontiguousarray(noise_grid.ravel())
+
+    def matern52(zi, zj, ls):
+        d = zi[:, None, :] - zj[None, :, :]
+        d2 = jnp.where(jnp.asarray(is_cat), 0.25 * (d != 0.0), d * d)
+        r2 = jnp.sum(d2, axis=-1) / (ls * ls)
+        s = jnp.sqrt(5.0 * r2 + 1e-12)
+        return (1.0 + s + (5.0 / 3.0) * r2) * jnp.exp(-s)
+
+    def run(seed, hv, ha, hl, hok):
+        key = jax.random.PRNGKey(seed)
+        z_all = _codec.encode(meta, hv, ha, cat="index")
+        mk = hok
+        if n_cap > n_eff:
+            # Subset-of-data cap: keep the n_eff lowest-loss rows (the
+            # region EI cares about).  Static shapes — the gather is the
+            # only data-dependent step and it stays in-program.
+            sel = jnp.argsort(jnp.where(mk, hl, jnp.inf))[:n_eff]
+            z_all = z_all[sel]
+            hl_eff = hl[sel]
+            mk = mk[sel]
+        else:
+            hl_eff = hl
+        mf = mk.astype(jnp.float32)
+        cnt = jnp.maximum(mf.sum(), 1.0)
+        y0 = jnp.where(mk, hl_eff, 0.0)
+        mu_y = y0.sum() / cnt
+        sd_y = jnp.sqrt((mf * (y0 - mu_y) ** 2).sum() / cnt) + 1e-6
+        y = mf * (y0 - mu_y) / sd_y
+
+        # Hyperparameter selection: one vmapped Cholesky over the grid.
+        def logml(ls, noise):
+            kf = matern52(z_all, z_all, ls)
+            kmat = kf * jnp.outer(mf, mf) \
+                + jnp.diag((1.0 - mf) + 1e-6 + noise * mf)
+            chol = jnp.linalg.cholesky(kmat)
+            alpha = jax.scipy.linalg.cho_solve((chol, True), y)
+            return -0.5 * jnp.dot(y, alpha) \
+                - jnp.sum(jnp.log(jnp.diagonal(chol)))
+
+        scores = jax.vmap(logml)(jnp.asarray(ls_grid),
+                                 jnp.asarray(noise_grid))
+        bi = jnp.argmax(scores)
+        ls = jnp.asarray(ls_grid)[bi]
+        noise = jnp.asarray(noise_grid)[bi]
+
+        # Liar-scan: m proposals, each fantasized into slot n_eff + i at
+        # the lie (standardized 0 — the lie is the mean) before the next
+        # refit.  Candidates are fresh prior draws per step.
+        z2_0 = jnp.concatenate(
+            [z_all, jnp.zeros((m, z_all.shape[1]), z_all.dtype)])
+        mf2_0 = jnp.concatenate([mf, jnp.zeros((m,), mf.dtype)])
+        y2 = jnp.concatenate([y, jnp.zeros((m,), y.dtype)])
+
+        def step(carry, i):
+            z2, mf2 = carry
+            kc = jax.random.fold_in(key, i)
+            cv, ca = cs.sample_traced(kc, n_cand)
+            zc = _codec.encode(meta, cv, ca, cat="index")
+            kf = matern52(z2, z2, ls)
+            kmat = kf * jnp.outer(mf2, mf2) \
+                + jnp.diag((1.0 - mf2) + 1e-6 + noise * mf2)
+            chol = jnp.linalg.cholesky(kmat)
+            alpha = jax.scipy.linalg.cho_solve((chol, True), y2 * mf2)
+            kstar = matern52(zc, z2, ls) * mf2[None, :]
+            mu = kstar @ alpha
+            v = jax.scipy.linalg.solve_triangular(chol, kstar.T, lower=True)
+            var = jnp.clip(1.0 + noise - jnp.sum(v * v, axis=0), 1e-9)
+            sigma = jnp.sqrt(var)
+            best = jnp.min(jnp.where(mf2 > 0, y2, jnp.inf))
+            zs = (best - mu) / sigma
+            cdf = 0.5 * (1.0 + jax.scipy.special.erf(zs / np.sqrt(2.0)))
+            pdf = jnp.exp(-0.5 * zs * zs) / np.sqrt(2.0 * np.pi)
+            ei = (best - mu) * cdf + sigma * pdf
+            pick = jnp.argmax(ei)
+            z2 = z2.at[n_eff + i].set(zc[pick])
+            mf2 = mf2.at[n_eff + i].set(1.0)
+            return (z2, mf2), cv[pick]
+
+        (_, _), rows = jax.lax.scan(step, (z2_0, mf2_0), jnp.arange(m))
+        return rows
+
+    return jax.jit(run)
+
+
+def _get_suggest_fn(cs, n_cap, n_cand, m, max_n):
+    cache = getattr(cs, "_gp_kernels", None)
+    if cache is None:
+        cache = {}
+        cs._gp_kernels = cache
+    key = (n_cap, n_cand, m, max_n)
+    fn = cache.get(key)
+    if fn is None:
+        fn = _build_suggest_fn(cs, n_cap, n_cand, m, max_n)
+        cache[key] = fn
+    return fn
+
+
+def suggest_dispatch(new_ids, domain, trials, seed,
+                     n_startup_jobs=_default_n_startup_jobs,
+                     n_EI_candidates=_default_n_EI_candidates,
+                     startup=None):
+    """Enqueue the GP-EI proposal program; returns a tpe-layout handle
+    (``("pending", cs, new_ids, (rows, None), exp_key)``) consumed by
+    ``tpe.suggest_materialize`` and friends — the four halves are shared
+    with TPE by construction."""
+    cs = domain.cs
+    n = len(new_ids)
+    exp_key = getattr(trials, "exp_key", None)
+    reg = _metrics_registry()
+    reg.counter("backend.gp.suggest.calls").inc()
+    if n == 0 or cs.n_params == 0:
+        return ("ready", cs, list(new_ids),
+                (np.zeros((n, cs.n_params), np.float32),
+                 np.ones((n, cs.n_params), bool)), exp_key)
+    h = trials.history(cs)
+    if int(h["ok"].sum()) < n_startup_jobs:
+        v, a = _tpe._startup_batch(startup, new_ids, domain, trials, seed)
+        if not isinstance(a, np.ndarray):
+            v = np.asarray(v)
+            a = cs.active_mask_host(v)
+        return ("ready", cs, list(new_ids),
+                (np.asarray(v), np.asarray(a)), exp_key)
+    resident = _rhist.enabled()
+    if resident:
+        fant = _tpe._inflight_fantasy_rows(h, trials, cs)
+        n_rows = h["vals"].shape[0] + (fant[0].shape[0] if fant else 0)
+    else:
+        h = _tpe._with_inflight_fantasies(h, trials, cs)
+        fant = None
+        n_rows = h["vals"].shape[0]
+    n_cap = _tpe._bucket(n_rows)
+    m = _tpe._batch_size_for(n)
+    fn = _get_suggest_fn(cs, n_cap, int(n_EI_candidates), m, _max_fit_rows())
+    t_feed = perf_counter()
+    if resident:
+        hv, ha, hl, hok = _rhist.device_history(trials, cs, h, n_cap,
+                                                fantasies=fant)
+    else:
+        hv, ha, hl, hok = _tpe._padded_history(h, n_cap)
+    _tpe._obs_ms(reg, "suggest.upload_ms",
+                 (perf_counter() - t_feed) * 1e3)
+    t_disp = perf_counter()
+    rows = fn(np.uint32(int(seed) % (2 ** 32)), hv, ha, hl, hok)
+    _tpe._obs_ms(reg, "backend.gp.dispatch_ms",
+                 (perf_counter() - t_disp) * 1e3)
+    return ("pending", cs, list(new_ids), (rows, None), exp_key)
+
+
+def suggest(new_ids, domain, trials, seed, **kwargs):
+    """GP-EI proposals for ``new_ids`` — dispatch + immediate force, so
+    the sync and pipelined paths share one implementation (the contract
+    ``check_sync_parity`` pins)."""
+    return _tpe.suggest_materialize(
+        suggest_dispatch(new_ids, domain, trials, seed, **kwargs))
+
+
+suggest.dispatch = suggest_dispatch
+suggest.materialize = _tpe.suggest_materialize
+suggest.start_transfer = _tpe.suggest_start_transfer
+suggest.handle_ready = _tpe.suggest_handle_ready
+
+#: registry hook (hyperopt_tpu.backends.contract resolves through this)
+BACKENDS = {"gp": suggest}

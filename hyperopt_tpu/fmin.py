@@ -552,22 +552,12 @@ def fmin(fn, space, algo=None, max_evals=None,
     if algo is None:
         algo = "tpe"
     if isinstance(algo, str):
-        # Convenience aliases (TPU-first addition; the reference requires
-        # the callable form, which of course still works).
-        from . import anneal, atpe, qmc, rand, tpe
-        aliases = {"tpe": tpe.suggest, "tpe_quantile": tpe.suggest_quantile,
-                   "tpe_sobol": partial(tpe.suggest, startup="qmc"),
-                   "tpe_mv": partial(tpe.suggest, split="quantile",
-                                     multivariate=True,
-                                     n_EI_candidates=128),
-                   "rand": rand.suggest, "random": rand.suggest,
-                   "qmc": qmc.suggest, "sobol": qmc.suggest,
-                   "halton": qmc.suggest_halton,
-                   "anneal": anneal.suggest, "atpe": atpe.suggest}
-        if algo not in aliases:
-            raise ValueError(f"unknown algo {algo!r}; one of "
-                             f"{sorted(aliases)} or a suggest callable")
-        algo = aliases[algo]
+        # String names resolve through the backend registry (TPU-first
+        # addition; the reference requires the callable form, which of
+        # course still works).  register_backend-registered heads are
+        # addressable here by name, same as the builtins.
+        from .backends import contract as _backends
+        algo = _backends.resolve(algo)
 
     if rstate is None:
         env_seed = os.environ.get("HYPEROPT_FMIN_SEED", "")

@@ -7,6 +7,12 @@ probability ``p`` — e.g. an ε-greedy blend of random search and TPE::
     fmin(fn, space, max_evals=100,
          algo=partial(mix.suggest,
                       p_suggest=[(0.1, rand.suggest), (0.9, tpe.suggest)]))
+
+Sub-algorithms may also be backend-registry names (TPU-first addition),
+so mixes compose with every registered head — including ``gp`` and
+``es`` — without importing the algo modules::
+
+    algo=partial(mix.suggest, p_suggest=[(0.2, "rand"), (0.8, "gp")])
 """
 
 from __future__ import annotations
@@ -15,11 +21,20 @@ import numpy as np
 
 
 def suggest(new_ids, domain, trials, seed, p_suggest):
-    """Call one of ``p_suggest``'s algorithms, chosen with its probability."""
+    """Call one of ``p_suggest``'s algorithms, chosen with its probability.
+
+    Each entry is ``(p, algo)`` with ``algo`` a suggest callable or a
+    backend-registry name (resolved via
+    :func:`hyperopt_tpu.backends.resolve`, so unknown names raise the
+    registry's typed error)."""
     ps = [p for p, _ in p_suggest]
     if not np.isclose(sum(ps), 1.0, atol=1e-3):
         raise ValueError(f"p_suggest probabilities sum to {sum(ps)}, not 1")
     rng = np.random.default_rng(int(seed) % (2 ** 32))
     idx = rng.choice(len(ps), p=np.asarray(ps) / sum(ps))
     _, algo = p_suggest[idx]
+    if isinstance(algo, str):
+        from .backends import contract as _backends
+
+        algo = _backends.resolve(algo)
     return algo(new_ids, domain, trials, seed=int(rng.integers(2 ** 31 - 1)))

@@ -593,41 +593,27 @@ class StoreServer:
     _SUGGEST_KW = frozenset({
         "prior_weight", "n_startup_jobs", "n_EI_candidates", "gamma",
         "linear_forgetting", "split", "multivariate", "startup",
-        "cat_prior"})
+        "cat_prior", "popsize", "sigma0", "lr", "rank_shaping"})
 
     _ALGOS = None
 
     @classmethod
     def _server_algos(cls):
-        """Lazy algorithm table (imports tpe/rand/etc. on first suggest,
-        keeping plain-store servers free of the JAX import).
+        """Lazy algorithm table from the backend registry
+        (``hyperopt_tpu.backends.contract.server_table``): every
+        registered head — builtins and ``register_backend`` additions —
+        is servable by name, with console verbosity suppressed where the
+        head supports it.  Imports happen on first suggest, keeping
+        plain-store servers free of the JAX import.
 
-        The TPE entry is dispatch + immediate materialize — by
-        construction the same computation as client-side ``tpe.suggest``
-        (which IS ``suggest_dispatch`` + force, tpe.py), so server and
-        client proposals are bit-identical for the same (history, seed).
+        Registry heads are dispatch + immediate materialize by the
+        SuggestBackend contract, so server and client proposals are
+        bit-identical for the same (history, seed).
         """
         if cls._ALGOS is None:
-            from .. import anneal, qmc, rand, tpe
+            from ..backends import contract as _backends
 
-            def _tpe(new_ids, domain, trials, seed, **kw):
-                handle = tpe.suggest_dispatch(new_ids, domain, trials,
-                                              seed, verbose=False, **kw)
-                return tpe.suggest_materialize(handle)
-
-            def _tpe_quantile(new_ids, domain, trials, seed, **kw):
-                kw.setdefault("split", "quantile")
-                return _tpe(new_ids, domain, trials, seed, **kw)
-
-            cls._ALGOS = {
-                "tpe": _tpe,
-                "tpe_quantile": _tpe_quantile,
-                "rand": rand.suggest,
-                "random": rand.suggest,
-                "qmc": qmc.suggest,
-                "halton": qmc.suggest_halton,
-                "anneal": anneal.suggest,
-            }
+            cls._ALGOS = _backends.server_table()
         return cls._ALGOS
 
     @staticmethod
@@ -667,7 +653,9 @@ class StoreServer:
         algo_name = req.get("algo", "tpe")
         algo = self._server_algos().get(algo_name)
         if algo is None:
-            raise ValueError(
+            from ..backends import UnknownBackend
+
+            raise UnknownBackend(
                 f"suggest: unknown algo {algo_name!r} "
                 f"(have {sorted(self._server_algos())})")
         if "seed" not in req:

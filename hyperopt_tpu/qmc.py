@@ -150,3 +150,7 @@ def suggest(new_ids, domain, trials, seed, engine="sobol"):
 
 def suggest_halton(new_ids, domain, trials, seed):
     return suggest(new_ids, domain, trials, seed, engine="halton")
+
+
+#: registry hook (hyperopt_tpu.backends.contract resolves through this)
+BACKENDS = {"qmc": suggest, "sobol": suggest, "halton": suggest_halton}

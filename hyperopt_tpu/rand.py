@@ -36,3 +36,7 @@ def suggest_batch(new_ids, domain, trials, seed):
     """Return raw (vals, active) arrays for ``new_ids`` without packaging."""
     key = prng_key(int(seed) % (2 ** 32))
     return domain.cs.sample(key, len(new_ids))
+
+
+#: registry hook (hyperopt_tpu.backends.contract resolves through this)
+BACKENDS = {"rand": suggest, "random": suggest}

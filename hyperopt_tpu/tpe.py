@@ -1400,3 +1400,16 @@ suggest_quantile.dispatch = _quantile_dispatch
 suggest_quantile.materialize = suggest_materialize
 suggest_quantile.start_transfer = suggest_start_transfer
 suggest_quantile.handle_ready = suggest_handle_ready
+
+
+#: registry hook (hyperopt_tpu.backends.contract resolves through this).
+#: The configured variants are keyword-only partials — FMinIter and the
+#: contract's ``halves_of`` re-bind their keywords onto the dispatch
+#: half, so they stay pipeline-capable.
+BACKENDS = {
+    "tpe": suggest,
+    "tpe_quantile": suggest_quantile,
+    "tpe_sobol": partial(suggest, startup="qmc"),
+    "tpe_mv": partial(suggest, split="quantile", multivariate=True,
+                      n_EI_candidates=128),
+}

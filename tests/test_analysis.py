@@ -126,6 +126,27 @@ def test_jp004_branch_fires_and_static_param_exempt():
     assert not rules_fired(run_checker("jit-purity", none_test), "JP004")
 
 
+def test_jp_covers_backends_subpackage():
+    # The suggest-backend heads (hyperopt_tpu/backends/gp.py, es.py)
+    # carry jitted kernels; prove the walker descends into the
+    # subpackage rather than only scanning top-level modules.
+    bad = {"hyperopt_tpu/backends/fx.py": (
+        "import jax\n"
+        "def surrogate(x):\n"
+        "    return x.item()\n"
+        "g = jax.jit(surrogate)\n")}
+    ok = {"hyperopt_tpu/backends/fx.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def surrogate(x):\n"
+        "    return jnp.sum(x * 2)\n"
+        "g = jax.jit(surrogate)\n")}
+    fired = rules_fired(run_checker("jit-purity", bad), "JP001")
+    assert fired
+    assert fired[0].file == "hyperopt_tpu/backends/fx.py"
+    assert not run_checker("jit-purity", ok)
+
+
 def test_jp005_use_after_donation_fires_and_rebind_silent():
     bad = _jp("import jax\n"
               "def step(a):\n"

@@ -328,6 +328,42 @@ class TestBenchmarkArtifacts:
             assert doc["wal"]["appends"] > 0, name
             assert doc["wal"]["torn_tail"] == 0, name
 
+    def test_algo_zoo_ab_artifact_schema(self):
+        """ISSUE 10 acceptance artifact: per-head best-loss sweep over the
+        5-domain zoo x 20 seeds through the backend registry, with
+        per-suggest latency columns and the GP-beats-rand-on-≥4/5
+        headline — written by benchmarks/algo_zoo_ab.py."""
+        paths = sorted(glob.glob(os.path.join(_BENCH_DIR,
+                                              "algo_zoo_ab_*.json")))
+        assert paths, "no benchmarks/algo_zoo_ab_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "algo_zoo_ab", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            assert len(doc["seeds"]) >= 20, name
+            assert {"rand", "tpe", "gp", "es"} <= set(doc["heads"]), name
+            domains = [r["domain"] for r in doc["rows"]]
+            assert len(domains) >= 5, name
+            assert "gauss_wave2" in domains, name   # the conditional space
+            for r in doc["rows"]:
+                assert set(doc["heads"]) <= set(r["heads"]), f"{name}: {r}"
+                for head, h in r["heads"].items():
+                    assert len(h["best"]) == len(doc["seeds"]), \
+                        f"{name}: {r['domain']}/{head}"
+                    assert h["suggest_ms_mean"] > 0, \
+                        f"{name}: {r['domain']}/{head}"
+                    assert h["suggest_ms_p50"] > 0, \
+                        f"{name}: {r['domain']}/{head}"
+            # the acceptance headline: GP-EI beats rand on >= 4/5 domains
+            n_win = sum(r["gp_beats_rand"] for r in doc["rows"])
+            assert doc["gp_beats_rand_domains"] == n_win, name
+            assert n_win >= 4, (
+                f"{name}: GP-EI only beats rand on {n_win}/"
+                f"{len(doc['rows'])} domains — below the 4/5 acceptance bar")
+
     def test_device_ab_artifact_matches_its_bench(self):
         # the r6 device A/B (5 domains x 20 seeds, one conditional space)
         path = os.path.join(_BENCH_DIR, "quality_ab_fmin_vs_fmin_device.json")

@@ -390,6 +390,39 @@ class TestServerSuggest:
         finally:
             srv.shutdown()
 
+    def test_registry_backends_served_by_name(self, tmp_path):
+        """The suggest verb's algo table comes from the backend registry:
+        gp and es are servable by name (with their knobs whitelisted in
+        ``_SUGGEST_KW``) and emit documents bit-identical to the
+        client-side head for the same (history, seed); unknown names
+        raise the registry's typed error (``UnknownBackend``, a
+        ValueError on the server, a RuntimeError on the wire)."""
+        from hyperopt_tpu.backends import resolve
+
+        srv = ServiceServer(str(tmp_path / "wal"), token="t")
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="t")
+            domain = _mk_domain()
+            nt.save_domain(domain)
+            # identical completed histories on both sides, past startup
+            local = base.Trials(exp_key="e1")
+            docs = resolve("rand")(list(range(12)), domain, local, 5)
+            done = [_complete(d, d["misc"]["vals"]["x"][0] ** 2)
+                    for d in docs]
+            local.insert_trial_docs(done)
+            local.refresh()
+            nt._insert_trial_docs(json.loads(json.dumps(done)))
+            for name, kw in (("gp", {"n_EI_candidates": 32}),
+                             ("es", {"popsize": 4})):
+                cli = resolve(name)(list(range(12, 14)), domain, local,
+                                    99, **kw)
+                saw = nt.suggest(99, new_ids=[12, 13], insert=False,
+                                 algo=name, **kw)
+                assert json.loads(json.dumps(cli)) == saw, name
+        finally:
+            srv.shutdown()
+
 
 # ---------------------------------------------------------------------------
 # WAL: replay, snapshot/compaction, torn tail, idem repopulation

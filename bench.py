@@ -68,6 +68,7 @@ PHASE_DEADLINES = {
     "fleet": 600.0,
     "device_fmin": 600.0,
     "cpu_ref": 300.0,
+    "obs": 300.0,
     "result": 60.0,
 }
 
@@ -616,6 +617,20 @@ def child():
         _say("partial", partial)
     except Exception as e:
         partial["cpu_ref_error"] = f"{type(e).__name__}: {e}"
+        _say("partial", partial)
+
+    # Observability overhead (ISSUE r11): metric hot-path ns/op with the
+    # registry disabled vs enabled, scrape/export latency and store
+    # footprint at 1k (fast) or 1k+10k series, and the per-tick cost of
+    # the health/SLO interpretation passes.  Host-only — no device work.
+    _say("phase", {"name": "obs"})
+    try:
+        from benchmarks.obs_health import collect as _obs_collect
+
+        partial["obs"] = _obs_collect(fast=fast)
+        _say("partial", partial)
+    except Exception as e:
+        partial["obs_error"] = f"{type(e).__name__}: {e}"
         _say("partial", partial)
 
     _say("phase", {"name": "result"})

@@ -1,0 +1,165 @@
+"""CPU bench: health/SLO observability overhead and scrape scaling.
+
+ISSUE r11's overhead contract: the new interpretation layer (time-series
+store, OpenMetrics exposition, health verdicts, SLO burn rates) hooks
+nothing into the metric hot paths — the disabled path must stay at the
+bare registry-check cost (~0.2 µs/op bar), and everything else is paid
+per *scrape*, not per operation.  Probes:
+
+1. **Hot-path microbench** — gauge.set + counter.inc + histogram.observe
+   ns/op with the registry disabled (what production pays when metrics
+   are off) and enabled (what an instrumented server pays).
+2. **Scrape scaling** — ``TimeSeriesStore.scrape()`` latency, OpenMetrics
+   encode time, and resident store footprint at 1k and 10k series (the
+   fleet-mode cardinality ceiling).
+3. **Interpretation passes** — one history-only ``health.assess()`` and
+   one 3-spec ``SloMonitor.evaluate()``, the per-tick cost of the
+   server's ``observe_pass``.
+
+Run::
+
+    env JAX_PLATFORMS=cpu python benchmarks/obs_health.py
+
+Writes ``benchmarks/obs_health_cpu_<stamp>.json`` (schema guarded by
+tests/test_artifacts_contract.py).  The budget note lives in DESIGN.md §6.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+N_MICRO = 200_000
+N_HIST = 32              # histogram series mixed into each scrape row
+SCRAPE_REPS = 5
+
+T0 = 1_000_000.0         # synthetic clock for the SLO/scrape passes
+
+
+def _median(xs):
+    xs = sorted(xs)
+    return xs[len(xs) // 2]
+
+
+def hot_path_ns(n=N_MICRO):
+    """ns per metric op (gauge.set + counter.inc + histogram.observe
+    averaged) with the registry disabled vs enabled."""
+    from hyperopt_tpu.obs.metrics import MetricsRegistry
+
+    out = {}
+    for label, enabled in (("disabled", False), ("enabled", True)):
+        reg = MetricsRegistry(enabled=enabled)
+        g, c, h = reg.gauge("g"), reg.counter("c"), reg.histogram("h")
+        for _ in range(1000):           # warm the attribute caches
+            g.set(1.0); c.inc(); h.observe(0.1)     # noqa: E702
+        t0 = time.perf_counter()
+        for _ in range(n):
+            g.set(1.0); c.inc(); h.observe(0.1)     # noqa: E702
+        per = (time.perf_counter() - t0) / (3 * n)
+        out[f"{label}_ns_per_op"] = round(per * 1e9, 1)
+    return out
+
+
+def scrape_row(n_series):
+    """One scaling row: scrape latency / OpenMetrics encode time /
+    store footprint with ``n_series`` live series (mostly gauges plus a
+    histogram band, the fleet-mode shape)."""
+    from hyperopt_tpu.obs import export
+    from hyperopt_tpu.obs.metrics import MetricsRegistry
+    from hyperopt_tpu.obs.timeseries import TimeSeriesStore
+
+    reg = MetricsRegistry(enabled=True)
+    for i in range(n_series - N_HIST):
+        reg.gauge(f"g.{i}").set(float(i))
+    for i in range(N_HIST):
+        h = reg.histogram(f"h.{i}")
+        for v in (0.001, 0.01, 0.1):
+            h.observe(v)
+    ts = TimeSeriesStore(reg)
+    scrapes = []
+    for rep in range(SCRAPE_REPS):
+        scrapes.append(ts.scrape(now=T0 + rep))
+    t0 = time.perf_counter()
+    text = export.render_openmetrics(reg.snapshot(states=True))
+    export_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "n_series": n_series,
+        "scrape_ms": round(_median(scrapes) * 1e3, 3),
+        "export_ms": round(export_ms, 3),
+        "export_bytes": len(text.encode("utf-8")),
+        "store_bytes": ts.nbytes(),
+        "store_samples": ts.n_samples(),
+    }
+
+
+def interpretation_ms():
+    """Per-tick cost of the verdict + burn-rate passes (history-only
+    assess over a 100-trial experiment; 3-spec monitor over a scraped
+    store)."""
+    from hyperopt_tpu.obs import health
+    from hyperopt_tpu.obs.metrics import MetricsRegistry
+    from hyperopt_tpu.obs.slo import SloMonitor, default_slos
+    from hyperopt_tpu.obs.timeseries import TimeSeriesStore
+
+    docs = [{"tid": i, "state": 2,
+             "result": {"loss": 10.0 / (i + 1), "status": "ok"},
+             "misc": {"vals": {"x": [float(i)]}}} for i in range(100)]
+    t0 = time.perf_counter()
+    rep = health.assess(docs)
+    assess_ms = (time.perf_counter() - t0) * 1e3
+
+    reg = MetricsRegistry(enabled=True)
+    ts = TimeSeriesStore(reg)
+    for _ in range(64):
+        reg.histogram("netstore.verb.suggest.s").observe(0.01)
+    reg.gauge("fleet.live_fraction").set(1.0)
+    reg.gauge("wal.fsync_lag_s").set(0.05)
+    for rep_i in range(3):
+        ts.scrape(now=T0 + 10 * rep_i)
+    mon = SloMonitor(default_slos(), ts, reg=reg)
+    t0 = time.perf_counter()
+    status = mon.evaluate(now=T0 + 20)
+    evaluate_ms = (time.perf_counter() - t0) * 1e3
+    assert rep["verdict"] == "healthy" and len(status) == 3
+    return {"health_assess_ms": round(assess_ms, 3),
+            "slo_evaluate_ms": round(evaluate_ms, 3)}
+
+
+def collect(fast=False):
+    """The bench payload (no timestamp — callers stamp it), also
+    embedded by bench.py's ``obs`` phase."""
+    hot = hot_path_ns(n=20_000 if fast else N_MICRO)
+    rows = [scrape_row(n) for n in ((1000,) if fast else (1000, 10000))]
+    doc = {"hot_path": hot, "rows": rows}
+    doc.update(interpretation_ms())
+    doc["headline"] = {
+        "disabled_within_200ns": hot["disabled_ns_per_op"] < 200.0,
+        "enabled_ns_per_op": hot["enabled_ns_per_op"],
+        "scrape_ms_largest": rows[-1]["scrape_ms"],
+    }
+    return doc
+
+
+def main():
+    doc = {
+        "metric": "obs_health_overhead_and_scrape",
+        "backend": "cpu",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    doc.update(collect())
+    stamp = time.strftime("%Y%m%d")
+    out = os.path.join(_ROOT, "benchmarks", f"obs_health_cpu_{stamp}.json")
+    with open(out, "w") as fh:
+        json.dump(doc, fh, indent=1)
+        fh.write("\n")
+    print(json.dumps(doc, indent=1))
+    print(f"wrote {out}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

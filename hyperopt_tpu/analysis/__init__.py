@@ -5,7 +5,7 @@ for the rule catalog):
 
 * :mod:`.jit_purity`      JP001–JP005 — trace-time purity of jit/vmap paths
 * :mod:`.lock_order`      LK001–LK003 — lock discipline in threaded layers
-* :mod:`.registry_drift`  RD001–RD008 — env/fault/verb/metric catalogs
+* :mod:`.registry_drift`  RD001–RD010 — env/fault/verb/metric/SLO catalogs
 * :mod:`.artifacts`       AH001       — benchmark artifact schema guards
 
 Run as ``python -m hyperopt_tpu.analysis [--json] [--baseline FILE]``;

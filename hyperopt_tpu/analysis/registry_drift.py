@@ -1,6 +1,6 @@
 """RD — name-registry coherence between source and catalogs.
 
-Four registries, each checked in both directions:
+Five registries, each checked in both directions:
 
 * env vars     ``HYPEROPT_TPU_*`` string literals read in source vs the
                docs/API.md catalog.
@@ -18,6 +18,12 @@ Four registries, each checked in both directions:
                names back-ticked in API.md's Observability sections
                (``<placeholder>`` segments become ``*``).
                RD006 emitted-but-uncataloged · RD007 cataloged-but-unemitted
+* SLO names    ``SloSpec("name", ...)`` declarations in source vs the
+               concrete ``slo.<name>.{firing,burn_fast,burn_slow,value}``
+               gauge tokens back-ticked in docs/API.md.  The suffix
+               restriction keeps the ``slo.alerts.fired`` counters from
+               reading as a declared SLO called "alerts".
+               RD009 declared-but-uncataloged · RD010 cataloged-but-undeclared
 
 All extraction is AST / text based — nothing is imported, so a metric
 emitted behind an env guard or a lazily-registered fault point is still
@@ -37,12 +43,13 @@ import re
 from .core import Finding, dotted_name, joined_str_prefix, str_const
 
 RULES = ("RD001", "RD002", "RD003", "RD004",
-         "RD005", "RD006", "RD007", "RD008")
+         "RD005", "RD006", "RD007", "RD008", "RD009", "RD010")
 
 _ENV_RE = re.compile(r"HYPEROPT_TPU_[A-Z0-9_]+")
 _DOC_TOKEN_RE = re.compile(r"`([a-z][a-z0-9_]*(?:\.[a-z0-9_.<>*-]+)+)`")
 _EMITTERS = {"counter", "gauge", "histogram"}
 _NONMETRIC_SUFFIXES = (".py", ".md", ".json", ".jsonl", ".txt", ".log")
+_SLO_SUFFIXES = ("firing", "burn_fast", "burn_slow", "value")
 
 
 def _doc_line(text: str, token: str) -> int:
@@ -99,6 +106,7 @@ class _Extract:
         self.client_verbs: dict = {}   # verb -> (file, line)
         self.dispatch_verbs: dict = {} # verb -> (file, line)
         self.metrics: dict = {}        # name/pattern -> (file, line)
+        self.slo_specs: dict = {}      # SLO name -> (file, line)
         for module in project.package_modules():
             self._scan(module)
 
@@ -122,6 +130,14 @@ class _Extract:
                 verb = str_const(node.args[0])
                 if verb:
                     self.client_verbs.setdefault(verb, (rel, node.lineno))
+            elif tail == "SloSpec":
+                name = str_const(node.args[0]) if node.args else None
+                if name is None:
+                    for kw in node.keywords:
+                        if kw.arg == "name":
+                            name = str_const(kw.value)
+                if name:
+                    self.slo_specs.setdefault(name, (rel, node.lineno))
             elif isinstance(node.func, ast.Attribute) and \
                     node.func.attr in _EMITTERS and node.args:
                 # matches both reg.counter("x") and registry().counter("x")
@@ -232,4 +248,29 @@ def check(project) -> list:
                 pat,
                 f"metric '{pat}' is cataloged in docs/API.md but never "
                 "emitted"))
+
+    # RD009 / RD010 — declared SLO names vs the cataloged slo.* gauges.
+    # Only tokens shaped ``slo.<name>.<suffix>`` with a per-spec gauge
+    # suffix and a concrete (wildcard-free) middle segment count as a
+    # cataloged SLO name — ``slo.alerts.fired`` (a counter) and
+    # ``slo.<name>.firing`` (the placeholder form) do not.
+    slo_doc: dict = {}
+    for tok in _DOC_TOKEN_RE.findall(api):
+        parts = tok.split(".")
+        if len(parts) == 3 and parts[0] == "slo" and \
+                parts[2] in _SLO_SUFFIXES and \
+                "<" not in parts[1] and "*" not in parts[1]:
+            slo_doc.setdefault(parts[1], tok)
+    for name, (rel, line) in sorted(ext.slo_specs.items()):
+        if slo_doc and name not in slo_doc:
+            findings.append(Finding(
+                "RD009", rel, line, name,
+                f"SLO '{name}' is declared in source (SloSpec) but none "
+                f"of its slo.{name}.* gauges are cataloged in docs/API.md"))
+    for name, tok in sorted(slo_doc.items()):
+        if name not in ext.slo_specs:
+            findings.append(Finding(
+                "RD010", "docs/API.md", _doc_line(api, tok), name,
+                f"SLO '{name}' is cataloged in docs/API.md ({tok}) but "
+                "no SloSpec declares it in source"))
     return findings

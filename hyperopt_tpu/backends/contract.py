@@ -223,6 +223,24 @@ def halves_of(fn):
     return tuple(halves)
 
 
+def introspect_of(fn):
+    """The head's optional health-introspection hook, or None.
+
+    A head may attach ``suggest.introspect(domain, trials, seed=0) ->
+    dict`` — pure host-side diagnostics (surrogate fit quality,
+    acquisition statistics, split shape) that ``obs.health`` turns into
+    per-experiment verdicts.  Like :func:`halves_of`, keyword-only
+    ``functools.partial`` variants unwrap to the carrying callable; the
+    hook must never mutate trials, touch kernel caches, or require an
+    accelerator.
+    """
+    import functools
+
+    while isinstance(fn, functools.partial):
+        fn = fn.func
+    return getattr(fn, "introspect", None)
+
+
 def conformance_domain():
     """Small mixed space (continuous + categorical) every check runs on."""
     from .. import base, hp

@@ -241,10 +241,100 @@ def suggest(new_ids, domain, trials, seed, **kwargs):
         suggest_dispatch(new_ids, domain, trials, seed, **kwargs))
 
 
+def introspect(domain, trials, seed=0, n_candidates=64):
+    """Health-hook diagnostics (``obs.health``): refit the same
+    Matérn-5/2 grid host-side in numpy and report log-marginal-
+    likelihood plus candidate-sweep EI statistics.
+
+    Runs eagerly (no new XLA programs compiled) on at most
+    ``HYPEROPT_TPU_GP_MAX_N`` rows, so a health probe never perturbs
+    the kernel caches the serving path depends on.  ``ei_rel`` is the
+    best candidate EI converted back to raw loss units and divided by
+    the observed loss scale — ~0 means the acquisition surface is flat
+    (EI collapse) regardless of the standardized-space magnitude.
+    """
+    cs = domain.cs
+    h = trials.history(cs)
+    ok = np.asarray(h["ok"], bool)
+    n_ok = int(ok.sum())
+    out = {"backend": "gp", "n_obs": n_ok}
+    if n_ok < 4 or cs.n_params == 0:
+        out["insufficient"] = True
+        return out
+    vals = np.asarray(h["vals"], np.float64)[ok]
+    act = np.asarray(h["active"], bool)[ok]
+    loss = np.asarray(h["loss"], np.float64)[ok]
+    max_n = _max_fit_rows()
+    if n_ok > max_n:
+        sel = np.argsort(loss)[:max_n]
+        vals, act, loss = vals[sel], act[sel], loss[sel]
+    meta = _codec.unit_meta(cs)
+    is_cat = np.asarray(meta["kind"] == _codec.K_CAT)
+    z = np.asarray(_codec.encode(meta, jnp.asarray(vals, jnp.float32),
+                                 jnp.asarray(act), cat="index"),
+                   np.float64)
+    n = z.shape[0]
+    mu_y = loss.mean()
+    sd_y = loss.std() + 1e-6
+    y = (loss - mu_y) / sd_y
+
+    def matk(zi, zj, ls):
+        d = zi[:, None, :] - zj[None, :, :]
+        d2 = np.where(is_cat, 0.25 * (d != 0.0), d * d)
+        r2 = d2.sum(-1) / (ls * ls)
+        s = np.sqrt(5.0 * r2 + 1e-12)
+        return (1.0 + s + (5.0 / 3.0) * r2) * np.exp(-s)
+
+    best = None
+    for ls in _LS_GRID:
+        for noise in _NOISE_GRID:
+            km = matk(z, z, float(ls)) \
+                + (1e-6 + float(noise)) * np.eye(n)
+            try:
+                chol = np.linalg.cholesky(km)
+            except np.linalg.LinAlgError:   # pragma: no cover - jittered
+                continue
+            alpha = np.linalg.solve(km, y)
+            lml = float(-0.5 * y @ alpha
+                        - np.log(np.diag(chol)).sum())
+            if best is None or lml > best[0]:
+                best = (lml, float(ls), float(noise), alpha, km)
+    if best is None:        # pragma: no cover - grid fully singular
+        out["insufficient"] = True
+        return out
+    lml, ls, noise, alpha, km = best
+    cv, ca = cs.sample_traced(jax.random.PRNGKey(int(seed)),
+                              int(n_candidates))
+    zc = np.asarray(_codec.encode(meta, cv, ca, cat="index"), np.float64)
+    kstar = matk(zc, z, ls)
+    mu = kstar @ alpha
+    w = np.linalg.solve(km, kstar.T)
+    var = np.clip(1.0 + noise - np.einsum("ij,ji->i", kstar, w), 1e-12,
+                  None)
+    sigma = np.sqrt(var)
+    best_y = y.min()
+    zs = (best_y - mu) / sigma
+    cdf = 0.5 * (1.0 + np.asarray(
+        jax.scipy.special.erf(jnp.asarray(zs / np.sqrt(2.0)))))
+    pdf = np.exp(-0.5 * zs * zs) / np.sqrt(2.0 * np.pi)
+    ei = (best_y - mu) * cdf + sigma * pdf          # standardized units
+    ei_max = float(ei.max())
+    ei_raw = float(ei_max * sd_y)
+    scale = max(float(loss.max() - loss.min()),
+                1e-3 * abs(float(loss.min())), 1e-9)
+    out.update({
+        "logml": lml, "ls": ls, "noise": noise, "sd_y": float(sd_y),
+        "ei_max": ei_max, "ei_mean": float(ei.mean()), "ei_raw": ei_raw,
+        "ei_rel": float(ei_raw / scale),
+    })
+    return out
+
+
 suggest.dispatch = suggest_dispatch
 suggest.materialize = _tpe.suggest_materialize
 suggest.start_transfer = _tpe.suggest_start_transfer
 suggest.handle_ready = _tpe.suggest_handle_ready
+suggest.introspect = introspect
 
 #: registry hook (hyperopt_tpu.backends.contract resolves through this)
 BACKENDS = {"gp": suggest}

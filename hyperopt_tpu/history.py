@@ -232,6 +232,8 @@ def _fn(name: str):
         fn = _FNS.get(name)
         if fn is None:
             donate = (0, 1, 2, 3) if _donate_ok() else ()
+            if donate and name in ("append", "append_b", "clear_b"):
+                _registry().counter("device.donated_programs").inc()
             if name == "append":
                 # Exact-shape in-place aliasing; a donating program.
                 fn = jax.jit(_append_impl, donate_argnums=donate)
@@ -304,6 +306,10 @@ def generation(trials) -> int:
 # None, hottest last.  Only consulted when a cap is set; dead referents
 # fall out for free as their _STORE entries vanish.
 _LRU: "OrderedDict" = OrderedDict()
+
+# Every live BatchedResident (fleet lane stack), weakly held — consulted
+# only by obs.device's HBM accounting, never on a hot path.
+_BATCHED: "weakref.WeakSet" = weakref.WeakSet()
 
 
 def _lru_touch(trials, key):
@@ -527,7 +533,8 @@ class BatchedResident:
     contract as the solo store.
     """
 
-    __slots__ = ("b", "cap", "p", "n", "tids", "gens", "filled", "bufs")
+    __slots__ = ("b", "cap", "p", "n", "tids", "gens", "filled", "bufs",
+                 "__weakref__")
 
     def __init__(self, b: int, cap: int, p: int):
         self.b = b
@@ -541,6 +548,10 @@ class BatchedResident:
                           np.zeros((b, cap, p), bool),
                           np.full((b, cap), np.inf, np.float32),
                           np.zeros((b, cap), bool)), None)
+        # Telemetry-only weak registration: lets obs.device report live
+        # lane-stack HBM without any ownership or lifetime coupling.
+        with _LOCK:
+            _BATCHED.add(self)
 
 
 def _lane_coherent(st: BatchedResident, i: int, h, gen: int) -> bool:

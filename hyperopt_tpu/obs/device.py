@@ -1,0 +1,85 @@
+"""Device-runtime telemetry: HBM held by resident history, kernel-cache
+occupancy, transfer/donation counts.
+
+The resident-history subsystem (``history.py``) keeps device buffers
+alive across suggests — solo rings in ``history._STORE`` and fleet lane
+stacks (``BatchedResident``) registered in ``history._BATCHED`` — but
+until now nothing reported how much HBM they pin.  ``collect()`` walks
+both tables **on demand** under ``history._LOCK`` (zero hot-path
+overhead: no allocation or append is instrumented) and publishes:
+
+* ``device.hbm.resident_bytes`` / ``device.hbm.resident_rings`` — live
+  bytes and entry count across every solo resident ring, from the
+  canonical buffer shapes (``cap × row_bytes(p)`` per ring, the same
+  ``_row_bytes`` accounting the upload counters use);
+* ``device.hbm.lane_stack_bytes`` / ``device.hbm.lane_stacks`` — the
+  fleet twins, ``B × cap × row_bytes(p)`` per stack;
+* ``device.kernel_cache.entries`` — distinct compiled-program cache
+  keys seen by the always-on kernel-cache tap
+  (``metrics.kernel_cache_stats``), i.e. occupancy per
+  ``(backend, bucket-tier)`` key space;
+* ``device.donated_programs`` (counter, emitted by ``history._fn``) —
+  how many in-place-aliasing (donating) programs were built.
+
+Cumulative transfer volume stays where it always was
+(``history.upload_bytes``); ``report()`` folds it in so one call
+answers "what is the device runtime holding and moving".
+"""
+
+from __future__ import annotations
+
+from . import metrics as _metrics
+
+__all__ = ["collect", "report"]
+
+
+def _ring_bytes():
+    """(n_rings, total_bytes, n_stacks, stack_bytes) under history._LOCK."""
+    from .. import history as _hist
+    rings = 0
+    ring_b = 0
+    stacks = 0
+    stack_b = 0
+    with _hist._LOCK:
+        for states in list(_hist._STORE.values()):
+            for res in list(states.values()):
+                p = int(res.bufs[0].shape[-1]) if res.bufs else 0
+                rings += 1
+                ring_b += int(res.cap) * _hist._row_bytes(p)
+        for st in list(_hist._BATCHED):
+            stacks += 1
+            stack_b += int(st.b) * int(st.cap) * _hist._row_bytes(int(st.p))
+    return rings, ring_b, stacks, stack_b
+
+
+def report() -> dict:
+    """Point-in-time device-runtime report (no gauges touched)."""
+    rings, ring_b, stacks, stack_b = _ring_bytes()
+    kc = _metrics.kernel_cache_stats()
+    return {
+        "resident_rings": rings,
+        "resident_bytes": ring_b,
+        "lane_stacks": stacks,
+        "lane_stack_bytes": stack_b,
+        "kernel_cache": {
+            "entries": len(kc.get("by_key", {})),
+            "requests": kc.get("requests", 0),
+            "misses": kc.get("misses", 0),
+        },
+    }
+
+
+def collect(reg=None) -> dict:
+    """Compute :func:`report` and publish it as gauges on ``reg``
+    (default: the process registry).  Called by the netstore scrape
+    loop so the HBM series land in the time-series store and the
+    OpenMetrics exposition."""
+    reg = reg if reg is not None else _metrics.registry()
+    rep = report()
+    reg.gauge("device.hbm.resident_bytes").set(rep["resident_bytes"])
+    reg.gauge("device.hbm.resident_rings").set(rep["resident_rings"])
+    reg.gauge("device.hbm.lane_stack_bytes").set(rep["lane_stack_bytes"])
+    reg.gauge("device.hbm.lane_stacks").set(rep["lane_stacks"])
+    reg.gauge("device.kernel_cache.entries").set(
+        rep["kernel_cache"]["entries"])
+    return rep

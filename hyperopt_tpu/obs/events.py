@@ -82,6 +82,7 @@ EVENT_TYPES = frozenset(
         "trial_queued",
         "store_heartbeat",
         "rpc",
+        "slo_alert",
     }
 )
 

@@ -307,11 +307,11 @@ def gmm_sample(key, logw, mu, sigma, trunc_lo, trunc_hi, n,
     else:
         comp = jax.random.categorical(kc, log_wmass, shape=(n,))
     # MXU lookups (see onehot_lookup): fit_parzen pads its OUTPUT slots
-    # with mu=0, sigma=1, weight=0 (ops/parzen.py — the +inf padding
-    # exists only on its input x), so padded components carry -inf
-    # log_wmass and are never selected; the fills are arbitrary finite
-    # stand-ins (1.0 for sigma keeps the divisions below NaN-free even
-    # transiently).
+    # with mu=0, sigma=1, weight=0 — i.e. logw=-inf once the caller
+    # takes the log (ops/parzen.py; the +inf padding exists only on its
+    # input x) — so padded components carry -inf log_wmass and are never
+    # selected; the mu/sigma fills are arbitrary finite stand-ins (1.0
+    # for sigma keeps the divisions below NaN-free even transiently).
     m = onehot_lookup(comp, mu, 0.0, batch=onehot_batch)
     s = onehot_lookup(comp, sigma, 1.0, batch=onehot_batch)
     pa = jax.scipy.special.ndtr((trunc_lo - m) / s)

@@ -69,7 +69,12 @@ from .filestore import FileTrials, FileWorker, _pickler
 from ..base import JOB_STATE_RUNNING, Trials, docs_from_samples
 from ..exceptions import InjectedFault, NetstoreUnavailable, QuotaExceeded
 from ..obs import context as _context
+from ..obs import device as _obs_device
+from ..obs import export as _obs_export
+from ..obs import health as _obs_health
 from ..obs import metrics as _metrics
+from ..obs import slo as _obs_slo
+from ..obs import timeseries as _obs_ts
 from ..obs.events import EVENTS
 from .. import faults as _faults
 
@@ -118,7 +123,9 @@ class StoreServer:
                  token: str | None = None,
                  requeue_stale_every: float | None = None,
                  stale_timeout: float = 60.0,
-                 tenants=None):
+                 tenants=None,
+                 scrape_interval: float | None = None,
+                 slos=None):
         self.root = os.path.abspath(root)
         self._trials: dict = {}          # (tenant_name, exp_key) -> store
         self._lock = threading.RLock()
@@ -157,6 +164,18 @@ class StoreServer:
         self.stale_timeout = stale_timeout
         self._janitor: threading.Thread | None = None
         self._janitor_stop = threading.Event()
+        # Observability interpretation layer (obs/): every server owns a
+        # time-series store + SLO monitor; the periodic scrape loop that
+        # feeds them only runs when ``scrape_interval`` is set (the
+        # disabled path costs nothing — no hot-path hooks exist).
+        self.scrape_interval = scrape_interval
+        self.timeseries = _obs_ts.TimeSeriesStore()
+        self.slo_monitor = _obs_slo.SloMonitor(
+            slos if slos is not None else _obs_slo.default_slos(),
+            self.timeseries)
+        self._health_cache: dict | None = None
+        self._scraper: threading.Thread | None = None
+        self._scraper_stop = threading.Event()
         self._started = False
         self._closed = False
         self._lifecycle_lock = threading.Lock()
@@ -166,12 +185,15 @@ class StoreServer:
             def log_message(self, fmt, *args):   # quiet by default
                 logger.debug("netstore: " + fmt, *args)
 
-            def _send_json(self, code, body: bytes):
+            def _send(self, code, body: bytes, ctype: str):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _send_json(self, code, body: bytes):
+                self._send(code, body, "application/json")
 
             def _reject(self):
                 _metrics.registry().counter("netstore.auth.rejected").inc()
@@ -233,7 +255,19 @@ class StoreServer:
                 if not self._authed():
                     return
                 if self.path.split("?", 1)[0] == "/metrics":
-                    body = json.dumps(server.metrics_payload()).encode()
+                    payload = server.metrics_payload()
+                    # Content negotiation: a standard Prometheus/
+                    # OpenMetrics scraper announces itself via Accept
+                    # and gets the wire-correct text exposition
+                    # (local + fleet-merged series); everything else
+                    # keeps the historical JSON document.
+                    if _obs_export.wants_openmetrics(
+                            self.headers.get("Accept", "")):
+                        body = _obs_export.render_openmetrics(
+                            payload).encode("utf-8")
+                        self._send(200, body, _obs_export.CONTENT_TYPE)
+                        return
+                    body = json.dumps(payload).encode()
                     self._send_json(200, body)
                     return
                 self._send_json(404, json.dumps(
@@ -247,6 +281,7 @@ class StoreServer:
     def start(self):
         self._started = True
         self._start_janitor()
+        self._start_scraper()
         t = threading.Thread(target=self._httpd.serve_forever, daemon=True,
                              name="netstore-server")
         t.start()
@@ -255,6 +290,7 @@ class StoreServer:
     def serve_forever(self):
         self._started = True
         self._start_janitor()
+        self._start_scraper()
         self._httpd.serve_forever()
 
     def shutdown(self):
@@ -269,8 +305,11 @@ class StoreServer:
                 return
             self._closed = True
         self._janitor_stop.set()
+        self._scraper_stop.set()
         if self._janitor is not None:
             self._janitor.join(timeout=5.0)
+        if self._scraper is not None:
+            self._scraper.join(timeout=5.0)
         if self._started:
             self._httpd.shutdown()
         self._httpd.server_close()
@@ -282,6 +321,51 @@ class StoreServer:
                                          daemon=True,
                                          name="netstore-janitor")
         self._janitor.start()
+
+    def _start_scraper(self):
+        if not self.scrape_interval or self._scraper is not None:
+            return
+        self._scraper = threading.Thread(target=self._scraper_loop,
+                                         daemon=True,
+                                         name="netstore-scraper")
+        self._scraper.start()
+
+    def _scraper_loop(self):
+        while not self._scraper_stop.wait(self.scrape_interval):
+            try:
+                self.observe_pass()
+            except Exception:    # scraper must outlive any bad series
+                logger.exception("netstore scraper: observe pass failed")
+
+    def observe_pass(self, now: float | None = None) -> list:
+        """One interpretation tick (the scrape loop's body, callable
+        directly by tests and benches): publish device-runtime and
+        fleet-liveness gauges, scrape the registry into the time-series
+        store, evaluate the SLO monitor, and refresh the cheap
+        (history-only) health verdicts the live dashboard shows.
+        Returns the SLO status list."""
+        _obs_device.collect()
+        self._fleet_liveness_gauge()
+        self.timeseries.scrape(now=now)
+        status = self.slo_monitor.evaluate(now=now)
+        try:
+            self._health_cache = self._assess_health(introspect=False)
+        except Exception:
+            logger.exception("netstore scraper: health pass failed")
+        return status
+
+    def _fleet_liveness_gauge(self) -> float:
+        """Fraction of pushed workers whose last heartbeat is fresh
+        (< 30 s, the dashboard's own STALE rule); 1.0 with no fleet.
+        Feeds the ``worker_liveness`` SLO via the time-series store."""
+        now = time.time()
+        with self._fleet_lock:
+            ages = [now - rec.get("t", now)
+                    for rec in self._fleet.values()]
+        live = sum(1 for a in ages if a < 30.0)
+        frac = (live / len(ages)) if ages else 1.0
+        _metrics.registry().gauge("fleet.live_fraction").set(frac)
+        return frac
 
     def _janitor_loop(self):
         # wait() (not sleep) so shutdown() interrupts a long period
@@ -463,7 +547,72 @@ class StoreServer:
             "workers": workers,
             "merged": _metrics.merge_snapshots(members),
         }
+        # Interpretation layer: last computed health verdicts (scraper
+        # pass or health verb) and current SLO alert state, so `show
+        # live` can render HEALTH/ALERTS panels from this one payload.
+        if self._health_cache is not None:
+            snap["health"] = self._health_cache
+        status = self.slo_monitor.status()
+        if status:
+            snap["alerts"] = status
         return snap
+
+    # -- optimizer health ----------------------------------------------------
+
+    def _assess_health(self, tenant_name=..., exp_key=None,
+                       introspect=True) -> dict:
+        """Health reports keyed ``"tenant/exp_key"`` (bare ``exp_key``
+        in single-tenant mode).  ``tenant_name=...`` means every
+        tenant (the scraper's view); a concrete name (or None in
+        single-tenant mode) restricts to that namespace.  Store state
+        is snapshotted under the server lock; the assessments — which
+        may run a backend introspection fit — happen OUTSIDE it, so a
+        health probe never stalls serving verbs."""
+        items = []
+        with self._lock:
+            for (tn, ek), ft in list(self._trials.items()):
+                if tenant_name is not ... and tn != tenant_name:
+                    continue
+                if exp_key is not None and ek != exp_key:
+                    continue
+                export = getattr(ft, "export_docs", None)
+                if export is not None:
+                    docs = export()
+                else:
+                    ft.refresh()
+                    docs = list(ft._dynamic_trials)
+                items.append((tn, ek, ft, docs,
+                              getattr(ft, "_srv_last_algo", None)))
+        reports = {}
+        for tn, ek, ft, docs, algo_name in items:
+            label = f"{tn}/{ek}" if tn else ek
+            domain = suggest_fn = None
+            if introspect and algo_name:
+                suggest_fn = self._server_algos().get(algo_name)
+                try:
+                    domain = self._domain_for(ft)
+                except Exception:
+                    domain = None
+            rep = _obs_health.assess(
+                docs, domain=domain, trials=ft, suggest_fn=suggest_fn,
+                introspect=introspect)
+            rep["algo"] = algo_name
+            _obs_health.publish(label, rep)
+            reports[label] = rep
+        return reports
+
+    def _health_verb(self, req: dict, tenant=None) -> dict:
+        """The read-only ``health`` verb body: fresh assessments
+        (introspection included unless ``introspect: false``) for the
+        caller's namespace — all of the tenant's experiments with
+        ``all: true``, else just the request's ``exp_key``."""
+        tname = getattr(tenant, "name", tenant)
+        exp_key = None if req.get("all") else req.get("exp_key", "default")
+        reports = self._assess_health(
+            tenant_name=tname, exp_key=exp_key,
+            introspect=bool(req.get("introspect", True)))
+        self._health_cache = dict(self._health_cache or {}, **reports)
+        return reports
 
     # -- tenant quotas -------------------------------------------------------
 
@@ -511,6 +660,11 @@ class StoreServer:
             # Same payload as GET /metrics so RPC clients
             # (NetTrials.metrics) don't need a second transport.
             return {"metrics": self.metrics_payload()}
+        if verb == "health":
+            # Read-only interpretation verb: per-(tenant, exp_key)
+            # optimizer-health verdicts.  Never WAL-logged (not in
+            # ServiceServer._WAL_VERBS) and never mutates a store.
+            return {"health": self._health_verb(req, tenant=tenant)}
         with self._lock:
             ft = self._store(req.get("exp_key", "default"), tenant=tenant)
             if verb == "docs":
@@ -651,6 +805,9 @@ class StoreServer:
         """
         fleet_rows = req.pop("_fleet_rows", None)
         algo_name = req.get("algo", "tpe")
+        # Memo for the health verb: which head last served this store
+        # (its introspection hook is the one worth running).
+        ft._srv_last_algo = algo_name
         algo = self._server_algos().get(algo_name)
         if algo is None:
             from ..backends import UnknownBackend
@@ -924,6 +1081,17 @@ class NetTrials(Trials):
     def metrics(self) -> dict:
         """Server-side metrics registry snapshot (``GET /metrics`` twin)."""
         return self._rpc("metrics")["metrics"]
+
+    def health(self, all: bool = False, introspect: bool = True) -> dict:
+        """Per-experiment optimizer-health verdicts (read-only verb):
+        ``{label: report}`` with ``report["verdict"]`` in
+        ``obs.health.VERDICTS``.  ``all=True`` widens from this client's
+        exp_key to every experiment in the caller's tenant namespace;
+        ``introspect=False`` skips the backend surrogate diagnostics."""
+        kw = {"introspect": introspect}
+        if all:
+            kw["all"] = True
+        return self._rpc("health", **kw)["health"]
 
     # -- server-side suggest -------------------------------------------------
 

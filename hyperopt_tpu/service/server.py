@@ -245,7 +245,9 @@ class ServiceServer(StoreServer):
                  fsync: str = "always", snapshot_every: int | None = None,
                  requeue_stale_every: float | None = None,
                  stale_timeout: float = 60.0,
-                 cohort_window_ms: float | None = None):
+                 cohort_window_ms: float | None = None,
+                 scrape_interval: float | None = None,
+                 slos=None):
         self.wal_root = os.path.abspath(wal_dir)
         self._replaying = False
         self._wal = Wal(self.wal_root, fsync=fsync)
@@ -257,7 +259,8 @@ class ServiceServer(StoreServer):
                              if cohort_window_ms else None)
         super().__init__(self.wal_root, host=host, port=port, token=token,
                          requeue_stale_every=requeue_stale_every,
-                         stale_timeout=stale_timeout, tenants=tenants)
+                         stale_timeout=stale_timeout, tenants=tenants,
+                         scrape_interval=scrape_interval, slos=slos)
         self._recover()
 
     # -- stores are RAM ------------------------------------------------------
@@ -521,6 +524,12 @@ def main(argv=None):
                    help="fleet mode: hold concurrent tenants' suggest "
                         "verbs up to MS and serve each window from one "
                         "vmapped cohort dispatch (0/unset: off)")
+    p.add_argument("--scrape-interval", type=float, default=None,
+                   metavar="S",
+                   help="observability: scrape the metrics registry "
+                        "into the in-process time-series store every S "
+                        "seconds and evaluate SLO burn-rate alerts + "
+                        "health verdicts (unset: off, zero overhead)")
     args = p.parse_args(argv)
 
     tenants = None
@@ -534,7 +543,8 @@ def main(argv=None):
                            snapshot_every=args.snapshot_every,
                            requeue_stale_every=args.requeue_stale_every,
                            stale_timeout=args.stale_timeout,
-                           cohort_window_ms=args.cohort_window_ms)
+                           cohort_window_ms=args.cohort_window_ms,
+                           scrape_interval=args.scrape_interval)
     print(f"service: serving {args.wal_dir} at {server.url}", flush=True)
 
     import signal

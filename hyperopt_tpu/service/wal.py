@@ -75,6 +75,7 @@ class Wal:
         self.snap_path = os.path.join(self.root, _SNAP_FILE)
         self._fh = open(self.path, "a", encoding="utf-8")
         self._since_sync = 0
+        self._last_fsync_mono = time.monotonic()
         self.seq = 0                    # last seq handed out; set by recovery
 
     def append(self, rec: dict) -> int:
@@ -101,10 +102,15 @@ class Wal:
                                       >= self.batch_every):
             os.fsync(self._fh.fileno())
             self._since_sync = 0
+            self._last_fsync_mono = time.monotonic()
             _metrics.registry().counter("wal.fsyncs").inc()
         reg = _metrics.registry()
         reg.counter("wal.appends").inc()
         reg.counter("wal.bytes").inc(len(line))
+        # Durability lag: how far behind a durable fsync this acked
+        # append is (0 under fsync=always) — the wal_fsync_lag SLO feed.
+        reg.gauge("wal.fsync_lag_s").set(
+            time.monotonic() - self._last_fsync_mono)
         return self.seq
 
     def snapshot(self, payload: dict) -> None:

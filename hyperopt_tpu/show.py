@@ -175,6 +175,15 @@ def merge_traces(dirs, out_path=None, out=None) -> dict:
         path = (d if d.endswith(".jsonl")
                 else os.path.join(d, "loop_events.jsonl"))
         meta, events = _load_events_file(path)
+        if meta.get("wall0") is None or meta.get("mono0") is None:
+            # A file without the {wall0, mono0} meta anchor can't be
+            # clock-normalized into the shared frame: its t_wall would
+            # land the lane wherever that process's clock happened to
+            # be, silently corrupting cross-lane ordering.  Skip it
+            # before lane numbering so kept lanes stay contiguous.
+            print(f"warning: {path}: missing {{wall0, mono0}} meta "
+                  "anchor; skipping (cannot clock-normalize)", file=out)
+            continue
         sources.append((path, meta, events))
 
     from .obs.events import events_to_chrome
@@ -182,13 +191,11 @@ def merge_traces(dirs, out_path=None, out=None) -> dict:
     trace_events, all_anchors = [], []
     for i, (path, meta, events) in enumerate(sources):
         pid = i + 1  # one Perfetto lane per source process
-        wall0, mono0 = meta.get("wall0"), meta.get("mono0")
+        wall0, mono0 = meta["wall0"], meta["mono0"]
         skew = meta.get("skew_s", 0.0) or 0.0
-        if wall0 is not None and mono0 is not None:
-            def ts_fn(rec, _w=wall0, _m=mono0, _s=skew):
-                return _w + (rec["t_mono"] - _m) - _s
-        else:
-            ts_fn = None  # pre-header file: fall back to recorded t_wall
+
+        def ts_fn(rec, _w=wall0, _m=mono0, _s=skew):
+            return _w + (rec["t_mono"] - _m) - _s
         evs, anchors = events_to_chrome(events, pid=pid, ts_fn=ts_fn)
         label = (meta.get("worker_id") or meta.get("role")
                  or os.path.basename(os.path.dirname(os.path.abspath(path)))
@@ -381,6 +388,43 @@ def render_live(snap: dict, out=None, prev=None) -> dict:
                   f"{int(wc.get('worker.trials', 0)):>5d}  fails "
                   f"{wg.get('worker.consecutive_failures', 0)}{stale}",
                   file=out)
+
+    # HEALTH: per-(tenant, exp_key) optimizer-health verdicts from the
+    # server's last assessment pass (snap["health"], the `health` verb's
+    # cache) — stagnation / EI-collapse surface here before loss curves
+    # make them obvious.
+    health = snap.get("health") or {}
+    if health:
+        print(f"health:  {'store':<26s} {'verdict':<12s} {'done':>5s} "
+              f"{'best':>12s}  flags", file=out)
+        for label in sorted(health):
+            rep = health[label] or {}
+            checks = rep.get("checks", {})
+            flags = ",".join(k for k in ("stagnating", "ei_collapse",
+                                         "dup_high", "split_degenerate")
+                             if checks.get(k)) or "-"
+            best = rep.get("best_loss")
+            best_s = "-" if best is None else f"{best:.5g}"
+            print(f"         {label:<26s} {rep.get('verdict', '?'):<12s} "
+                  f"{int(rep.get('n_done', 0)):>5d} {best_s:>12s}  {flags}",
+                  file=out)
+
+    # ALERTS: SLO burn-rate state from the server's monitor
+    # (snap["alerts"]); firing specs are the ones eating error budget
+    # faster than it accrues in BOTH windows.
+    alerts = snap.get("alerts") or []
+    if alerts:
+        fmt_b = lambda b: "    -" if b is None else f"{b:5.2f}"  # noqa: E731
+        print(f"alerts:  {'slo':<20s} {'state':<8s} {'burn.fast':>9s} "
+              f"{'burn.slow':>9s} {'value':>10s} {'target':>10s}", file=out)
+        for st in alerts:
+            state = "FIRING" if st.get("firing") else "ok"
+            val = st.get("value")
+            val_s = "-" if val is None else f"{val:.4g}"
+            print(f"         {st['name']:<20s} {state:<8s} "
+                  f"{fmt_b(st.get('burn_fast')):>9s} "
+                  f"{fmt_b(st.get('burn_slow')):>9s} "
+                  f"{val_s:>10s} {st.get('target'):>10}", file=out)
     return (now, done)
 
 

@@ -1375,10 +1375,46 @@ def suggest_handle_ready(handle) -> bool:
         return True
 
 
+def introspect(domain, trials, seed=0, gamma=_default_gamma,
+               linear_forgetting=_default_linear_forgetting):
+    """Health-hook diagnostics (``obs.health``): the good/bad γ-split
+    TPE would compute on the current history, host-side.
+
+    Mirrors ``_TpeKernel._split``'s default ``'sqrt'`` schedule
+    (``n_below = min(ceil(gamma·sqrt(N)), LF, N)``).  The split is
+    *degenerate* — the surrogate pair carries no ranking signal — when
+    the below set has fewer than two members or the observed losses
+    have no spread at all.
+    """
+    cs = domain.cs
+    h = trials.history(cs)
+    ok = np.asarray(h["ok"], bool)
+    loss = np.sort(np.asarray(h["loss"], np.float64)[ok])
+    n_ok = int(loss.shape[0])
+    out = {"backend": "tpe", "n_obs": n_ok, "gamma": float(gamma)}
+    if n_ok == 0:
+        out["insufficient"] = True
+        return out
+    n_below = int(np.ceil(gamma * np.sqrt(n_ok)))
+    n_below = min(n_below, int(linear_forgetting), n_ok)
+    spread = float(loss[-1] - loss[0])
+    out.update({
+        "n_below": n_below,
+        "n_above": n_ok - n_below,
+        "loss_spread": spread,
+        "below_mean": float(loss[:n_below].mean()) if n_below else None,
+        "above_mean": (float(loss[n_below:].mean())
+                       if n_ok > n_below else None),
+        "split_degenerate": n_below < 2 or spread <= _TINY,
+    })
+    return out
+
+
 suggest.dispatch = suggest_dispatch
 suggest.materialize = suggest_materialize
 suggest.start_transfer = suggest_start_transfer
 suggest.handle_ready = suggest_handle_ready
+suggest.introspect = introspect
 
 
 def suggest_quantile(new_ids, domain, trials, seed, **kwargs):
@@ -1400,6 +1436,7 @@ suggest_quantile.dispatch = _quantile_dispatch
 suggest_quantile.materialize = suggest_materialize
 suggest_quantile.start_transfer = suggest_start_transfer
 suggest_quantile.handle_ready = suggest_handle_ready
+suggest_quantile.introspect = introspect
 
 
 #: registry hook (hyperopt_tpu.backends.contract resolves through this).

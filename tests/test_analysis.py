@@ -360,6 +360,45 @@ def test_rd006_fstring_metric_matches_placeholder_catalog():
     assert not rules_fired(clean, "RD007")
 
 
+def test_rd009_rd010_slo_names_both_directions():
+    src = {"hyperopt_tpu/fx.py": (
+        "def defaults():\n"
+        "    return (SloSpec('lat_p95', metric='fx.s'),\n"
+        "            SloSpec(name='liveness', metric='fx.live'))\n")}
+    drifted = run_checker(
+        "registry-drift", src,
+        files={"docs/API.md": "`slo.lat_p95.firing` `slo.ghost.value`\n"})
+    # 'liveness' declared but none of its gauges cataloged.
+    assert [f.symbol for f in rules_fired(drifted, "RD009")] == ["liveness"]
+    # 'ghost' cataloged but no SloSpec declares it.
+    assert [f.symbol for f in rules_fired(drifted, "RD010")] == ["ghost"]
+    clean = run_checker(
+        "registry-drift", src,
+        files={"docs/API.md":
+               "`slo.lat_p95.firing` `slo.liveness.burn_fast`\n"})
+    assert not rules_fired(clean, "RD009")
+    assert not rules_fired(clean, "RD010")
+
+
+def test_rd009_rd010_suffix_and_placeholder_tokens_excluded():
+    # Neither the slo.alerts.* transition counters nor the
+    # `slo.<name>.firing` placeholder form read as a declared SLO name.
+    src = {"hyperopt_tpu/fx.py": (
+        "def defaults():\n"
+        "    return (SloSpec('lat_p95', metric='fx.s'),)\n")}
+    clean = run_checker(
+        "registry-drift", src,
+        files={"docs/API.md": ("`slo.lat_p95.firing` `slo.alerts.fired` "
+                               "`slo.alerts.resolved` `slo.<name>.firing`\n")})
+    assert not rules_fired(clean, "RD009")
+    assert not rules_fired(clean, "RD010")
+    # With no cataloged SLO gauges at all, RD009 stays silent (no doc
+    # catalog to reconcile against) but RD010 has nothing to fire on.
+    bare = run_checker("registry-drift", src, files={"docs/API.md": ""})
+    assert not rules_fired(bare, "RD009")
+    assert not rules_fired(bare, "RD010")
+
+
 # ---------------------------------------------------------------------------
 # AH — artifact honesty
 # ---------------------------------------------------------------------------

@@ -239,6 +239,38 @@ class TestBenchmarkArtifacts:
                 f"{name}: context stamping's disabled path broke its "
                 "200ns/op budget")
 
+    def test_obs_health_artifact_schema(self):
+        """ISSUE r11 acceptance artifact: the health/SLO observability
+        overhead bench — metric hot-path ns/op (disabled vs enabled),
+        scrape/export scaling at 1k and 10k series, and the per-tick
+        interpretation-pass costs — written by benchmarks/obs_health.py."""
+        paths = sorted(glob.glob(os.path.join(_BENCH_DIR,
+                                              "obs_health_*.json")))
+        assert paths, "no benchmarks/obs_health_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "obs_health_overhead_and_scrape", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            hot = doc["hot_path"]
+            assert 0 < hot["disabled_ns_per_op"] < \
+                hot["enabled_ns_per_op"], name
+            rows = {r["n_series"]: r for r in doc["rows"]}
+            assert set(rows) == {1000, 10000}, name
+            for r in rows.values():
+                assert r["scrape_ms"] > 0, f"{name}: {r}"
+                assert r["export_ms"] > 0, f"{name}: {r}"
+                assert r["store_bytes"] > 0, f"{name}: {r}"
+            assert doc["health_assess_ms"] > 0, name
+            assert doc["slo_evaluate_ms"] > 0, name
+            # the ISSUE acceptance bar: the disabled path must stay at
+            # the bare registry-check cost
+            assert doc["headline"]["disabled_within_200ns"] is True, (
+                f"{name}: metric hot path's disabled arm broke its "
+                "200ns/op budget")
+
     def test_merged_trace_artifact_schema(self):
         """ISSUE r6 acceptance artifact: the 2-process chaos run's merged
         Perfetto trace — one lane per process, ≥1 cross-process trial

@@ -191,6 +191,48 @@ class TestHistogramMerge:
         assert merged["histograms"]["h"]["count"] == 2
         assert "state" in merged["histograms"]["h"]  # re-mergeable
 
+    def test_merge_snapshots_empty_and_single_process_identity(self):
+        empty = {"counters": {}, "gauges": {}, "histograms": {}}
+        assert merge_snapshots([]) == empty
+        assert merge_snapshots([None, {}]) == empty
+
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("c").inc(2)
+        reg.gauge("g").set(1.5)
+        reg.histogram("h").observe(0.01)
+        snap = reg.snapshot(states=True)
+        solo = merge_snapshots([snap])
+        assert solo["counters"] == snap["counters"]
+        assert solo["gauges"] == snap["gauges"]
+        assert solo["histograms"]["h"]["count"] == 1
+        assert solo["histograms"]["h"]["state"]["counts"] == \
+            snap["histograms"]["h"]["state"]["counts"]
+
+    def test_merge_snapshots_one_sided_metric_stays_associative(self):
+        """A metric only some members emit (e.g. a verb only one server
+        served) merges to that member's state, in any grouping."""
+        def snap(hists, counters=()):
+            reg = MetricsRegistry(enabled=True)
+            for name, vals in hists.items():
+                for v in vals:
+                    reg.histogram(name).observe(v)
+            for name in counters:
+                reg.counter(name).inc()
+            return reg.snapshot(states=True)
+
+        a = snap({"verb.suggest.s": [0.01, 0.02]}, counters=("only_a",))
+        b = snap({"verb.suggest.s": [0.04], "verb.refresh.s": [0.08]})
+        c = snap({"verb.refresh.s": [0.16]})
+        left = merge_snapshots([merge_snapshots([a, b]), c])
+        right = merge_snapshots([a, merge_snapshots([b, c])])
+        for m in (left, right):
+            assert m["histograms"]["verb.suggest.s"]["count"] == 3
+            assert m["histograms"]["verb.refresh.s"]["count"] == 2
+            assert m["counters"]["only_a"] == 1
+        for name in ("verb.suggest.s", "verb.refresh.s"):
+            assert left["histograms"][name]["state"] == \
+                right["histograms"][name]["state"]
+
     def test_summary_has_p99(self):
         reg = MetricsRegistry(enabled=True)
         h = reg.histogram("h")
@@ -534,6 +576,34 @@ class TestMergeTraces:
                  if e.get("ph") == "M"]
         assert any("server" in n for n in names)
         assert any("w:1:beef" in n for n in names)
+
+    def test_anchorless_file_skipped_with_warning(self, tmp_path):
+        """A lane whose meta lost its ``{wall0, mono0}`` clock anchor
+        cannot be normalized into the shared frame; the merger must skip
+        it with a warning — not abort, and not silently mis-place it."""
+        good = tmp_path / "server.jsonl"
+        bad = tmp_path / "worker.jsonl"
+        _write_events_file(good, {"pid": 1, "wall0": 1000.0, "mono0": 0.0,
+                                  "skew_s": 0.0},
+                           [{"type": "trial_start", "trial": 1,
+                             "t_mono": 5.0, "t_wall": 1005.0,
+                             "thread": "MainThread"}])
+        _write_events_file(bad, {"pid": 2, "skew_s": 0.0},
+                           [{"type": "trial_start", "trial": 2,
+                             "t_mono": 1.0, "t_wall": 1001.0,
+                             "thread": "MainThread"}])
+        from hyperopt_tpu.show import merge_traces
+
+        buf = io.StringIO()
+        doc = merge_traces([str(good), str(bad)], out=buf)
+        evs = [e for e in doc["traceEvents"]
+               if e.get("cat", "").startswith("hyperopt_tpu")]
+        assert {e["pid"] for e in evs} == {1}       # only the good lane
+        assert doc["otherData"]["n_lanes"] == 1
+        assert doc["otherData"]["merged_from"] == [str(good)]
+        warning = buf.getvalue()
+        assert "worker.jsonl" in warning
+        assert "wall0" in warning and "skipping" in warning
 
     def test_merge_writes_loadable_artifact(self, tmp_path):
         a = tmp_path / "a.jsonl"

@@ -1,0 +1,667 @@
+"""Optimizer-health & device-runtime observability (ISSUE r11): bounded
+time-series store, OpenMetrics text exposition, per-experiment health
+verdicts, and SLO burn-rate alerting.
+
+The areas pinned here: windowed reads (delta/rate, windowed histogram
+states via cumulative differencing, tier fallback), the strict
+OpenMetrics round-trip (including the fleet-merged ``scope="fleet"``
+series and scraper-side ``histogram_quantile`` agreement), ``Accept``
+negotiation on the token-gated ``GET /metrics``, health verdicts from
+seeded histories plus the backend introspection hooks (GP EI collapse,
+TPE split degeneracy) surfaced through ``assess()`` / the ``health``
+verb / the ``show live`` HEALTH panel, multi-window burn-rate
+fire-then-clear (synthetic clocks AND ``rpc.send`` fault chaos with the
+``slo_alert`` event riding the merged trace), and the disabled-path
+overhead bound.
+
+All clock-sensitive tests drive synthetic ``now=`` timestamps — nothing
+here sleeps to move a window.
+"""
+
+import io
+import json
+import time
+import urllib.request
+
+import pytest
+
+from hyperopt_tpu import JOB_STATE_DONE, faults, hp, rand
+from hyperopt_tpu.base import Domain
+from hyperopt_tpu.obs import export, health
+from hyperopt_tpu.obs.events import EventLog
+from hyperopt_tpu.obs.metrics import MetricsRegistry
+from hyperopt_tpu.obs.slo import SloMonitor, SloSpec, default_slos
+from hyperopt_tpu.obs.timeseries import TimeSeriesStore
+
+T0 = 1_000_000.0            # synthetic epoch, far from any real clock
+
+
+def _reg():
+    return MetricsRegistry(enabled=True)
+
+
+def _docs(losses, x=None):
+    """Minimal completed-trial docs for history-only health checks."""
+    return [{"tid": i, "state": JOB_STATE_DONE,
+             "result": {"loss": float(l), "status": "ok"},
+             "misc": {"vals": {"x": [float(i if x is None else x)]}}}
+            for i, l in enumerate(losses)]
+
+
+# ---------------------------------------------------------------------------
+# time-series store
+# ---------------------------------------------------------------------------
+
+
+class TestTimeSeriesStore:
+    def test_counter_delta_and_rate(self):
+        reg = _reg()
+        ts = TimeSeriesStore(reg)
+        c = reg.counter("req")
+        for i in range(5):
+            c.inc(2)
+            ts.scrape(now=T0 + i)
+        assert ts.n_scrapes == 5
+        assert ts.delta("req", 4.0, now=T0 + 4) == pytest.approx(8.0)
+        assert ts.rate("req", 4.0, now=T0 + 4) == pytest.approx(2.0)
+        # fewer than two bracketing samples -> None, not a guess
+        assert ts.delta("req", 4.0, now=T0) is None
+        assert ts.delta("missing", 4.0, now=T0 + 4) is None
+
+    def test_tier_keeps_last_of_period_and_reaches_back(self):
+        """Once the raw ring has evicted, reads fall back to the tier
+        ring (last-sample-of-period entries) that reaches furthest
+        back."""
+        reg = _reg()
+        ts = TimeSeriesStore(reg, raw_cap=4, tiers=((10.0, 8),))
+        g = reg.gauge("v")
+        for i in range(30):
+            g.set(float(i))
+            ts.scrape(now=T0 + i)
+        got = ts.samples("v", window_s=25.0, now=T0 + 29)
+        # 10s periods ending at t+9/t+19/t+29 - last write of each wins.
+        assert [v for _, v in got] == [9.0, 19.0, 29.0]
+
+    def test_pick_samples_prefers_finest_covering_ring(self):
+        """Regression: the read path must return the FINEST ring whose
+        retention covers the window start, not the coarsest non-empty
+        one."""
+        reg = _reg()
+        ts = TimeSeriesStore(reg, raw_cap=4, tiers=((1.0, 16), (10.0, 4)))
+        g = reg.gauge("v")
+        for i in range(12):
+            g.set(float(i))
+            ts.scrape(now=T0 + i)
+        got = ts.samples("v", window_s=10.0, now=T0 + 11)
+        # raw (last 4) can't cover t0+1; the 1s tier can (all 12 kept)
+        # and must win over the 2-entry 10s tier.
+        assert len(got) == 11
+        assert [v for _, v in got][:2] == [1.0, 2.0]
+
+    def test_windowed_histogram_state_quantile_and_tail_frac(self):
+        reg = _reg()
+        ts = TimeSeriesStore(reg)
+        h = reg.histogram("lat")
+        for _ in range(8):
+            h.observe(0.01)
+        ts.scrape(now=T0)
+        for _ in range(2):
+            h.observe(0.5)
+        ts.scrape(now=T0 + 10)
+        win = ts.window_state("lat", 10.0, now=T0 + 10)
+        assert win["count"] == 2          # cumulative diff: only the 0.5s
+        assert ts.window_frac_above("lat", 0.25, 10.0,
+                                    now=T0 + 10) == pytest.approx(1.0)
+        q = ts.window_quantile("lat", 0.5, 10.0, now=T0 + 10)
+        assert 0.25 < q <= 1.0            # bucket containing 0.5
+        # the whole-history window sees all ten observations
+        full = ts.window_state("lat", 100.0, now=T0 + 10)
+        assert full["count"] == 10
+        assert ts.window_frac_above("lat", 0.25, 100.0,
+                                    now=T0 + 10) == pytest.approx(0.2)
+        assert ts.window_quantile("lat", 0.5, 100.0, now=T0 + 10) < 0.25
+
+    def test_scrape_publishes_self_telemetry(self):
+        reg = _reg()
+        ts = TimeSeriesStore(reg)
+        reg.counter("c").inc()
+        ts.scrape(now=T0)
+        snap = reg.snapshot(states=True)
+        assert snap["gauges"]["obs.timeseries.series"] >= 1
+        assert snap["gauges"]["obs.timeseries.bytes"] > 0
+        assert snap["histograms"]["obs.timeseries.scrape_s"]["count"] == 1
+
+    def test_ingest_skew_normalization_and_merged_window(self):
+        # remote process, clock 5s AHEAD of ours (skew_s = +5)
+        reg_r = _reg()
+        ts_r = TimeSeriesStore(reg_r)
+        reg_r.histogram("netstore.verb.suggest.s").observe(0.1)
+        reg_r.gauge("depth").set(3.0)
+        ts_r.scrape(now=T0 + 5.0)
+        dump = ts_r.export_series()
+
+        reg_l = _reg()
+        ts_l = TimeSeriesStore(reg_l)
+        for _ in range(3):
+            reg_l.histogram("netstore.verb.suggest.s").observe(0.1)
+        ts_l.scrape(now=T0)
+        ts_l.ingest("w1", dump, skew_s=5.0)
+        # the ingested gauge sample lands on OUR clock at exactly T0
+        assert ts_l.samples("w1:depth", now=T0 + 1) == [(T0, 3.0)]
+        merged = ts_l.merged_window_state(
+            ["netstore.verb.suggest.s", "w1:netstore.verb.suggest.s"],
+            60.0, now=T0 + 1)
+        assert merged["count"] == 4       # 3 local + 1 ingested
+
+
+# ---------------------------------------------------------------------------
+# OpenMetrics exposition
+# ---------------------------------------------------------------------------
+
+
+class TestOpenMetrics:
+    def test_round_trip_values_and_types(self):
+        reg = _reg()
+        reg.counter("reqs").inc(3)
+        reg.gauge("depth").set(2.5)
+        h = reg.histogram("lat.s")
+        for v in (0.01, 0.02, 0.3):
+            h.observe(v)
+        text = export.render_openmetrics(reg.snapshot(states=True))
+        assert text.endswith("# EOF\n")
+        fams = export.parse_openmetrics(text)
+        cnt = fams["hyperopt_tpu_reqs"]
+        assert cnt["type"] == "counter"
+        assert cnt["samples"] == [("_total", {"scope": "local"}, 3.0)]
+        assert fams["hyperopt_tpu_depth"]["samples"][0][2] == 2.5
+        hist = fams["hyperopt_tpu_lat_s"]
+        assert hist["type"] == "histogram"
+        g = export.histogram_groups(hist)[(("scope", "local"),)]
+        assert g["count"] == 3
+        assert g["sum"] == pytest.approx(0.33)
+        # buckets arrive cumulative with a +Inf terminator
+        les, cums = zip(*sorted(g["buckets"]))
+        assert les[-1] == float("inf") and cums[-1] == 3
+
+    def test_scraper_quantile_agrees_with_store(self):
+        """What a Prometheus ``histogram_quantile`` computes from the
+        wire equals what the in-process windowed read computes."""
+        reg = _reg()
+        ts = TimeSeriesStore(reg)
+        h = reg.histogram("lat.s")
+        for v in (0.01, 0.02, 0.3, 0.5, 0.7):
+            h.observe(v)
+        ts.scrape(now=T0)
+        fams = export.parse_openmetrics(
+            export.render_openmetrics(reg.snapshot(states=True)))
+        g = export.histogram_groups(
+            fams["hyperopt_tpu_lat_s"])[(("scope", "local"),)]
+        for q in (0.5, 0.8, 0.95):
+            assert export.histogram_quantile(g, q) == \
+                ts.window_quantile("lat.s", q, 60.0, now=T0)
+
+    def test_fleet_scope_series_share_the_family(self):
+        reg = _reg()
+        reg.counter("reqs").inc(1)
+        h = reg.histogram("verb.s")
+        h.observe(0.1)
+        snap = reg.snapshot(states=True)
+        merged_state = dict(snap["histograms"]["verb.s"]["state"])
+        merged_state["counts"] = [c * 3 for c in merged_state["counts"]]
+        merged_state["count"] *= 3
+        merged_state["sum"] *= 3
+        payload = dict(snap)
+        payload["fleet"] = {"merged": {
+            "counters": {"reqs": 7},
+            "histograms": {"verb.s": {"state": merged_state}},
+        }}
+        fams = export.parse_openmetrics(export.render_openmetrics(payload))
+        by_scope = {labels["scope"]: v for _, labels, v
+                    in fams["hyperopt_tpu_reqs"]["samples"]}
+        assert by_scope == {"local": 1.0, "fleet": 7.0}
+        groups = export.histogram_groups(fams["hyperopt_tpu_verb_s"])
+        assert groups[(("scope", "local"),)]["count"] == 1
+        assert groups[(("scope", "fleet"),)]["count"] == 3
+
+    def test_shared_scalar_histogram_names_disambiguate(self):
+        """The registry deliberately shares dotted names across typed
+        tables (``tpe._obs_ms``: counter + histogram;
+        ``pipeline.occupancy``: gauge + histogram).  OpenMetrics
+        families cannot, so the histogram keeps the bare name and the
+        scalar twins rename — ``_cumulative`` for counters,
+        ``_current`` for gauges — in every scope, even one where only
+        the scalar side is present."""
+        reg = _reg()
+        reg.counter("backend.es.dispatch_ms").inc(12.5)
+        reg.histogram("backend.es.dispatch_ms").observe(12.5)
+        reg.gauge("pipeline.occupancy").set(4.0)
+        reg.histogram("pipeline.occupancy").observe(4.0)
+        payload = dict(reg.snapshot(states=True))
+        payload["fleet"] = {"merged": {
+            "counters": {"backend.es.dispatch_ms": 25.0}}}
+        fams = export.parse_openmetrics(export.render_openmetrics(payload))
+        assert fams["hyperopt_tpu_backend_es_dispatch_ms"]["type"] == \
+            "histogram"
+        cnt = fams["hyperopt_tpu_backend_es_dispatch_ms_cumulative"]
+        assert cnt["type"] == "counter"
+        by_scope = {labels["scope"]: v for _, labels, v in cnt["samples"]}
+        assert by_scope == {"local": 12.5, "fleet": 25.0}
+        assert fams["hyperopt_tpu_pipeline_occupancy"]["type"] == \
+            "histogram"
+        g = fams["hyperopt_tpu_pipeline_occupancy_current"]
+        assert g["type"] == "gauge"
+        assert g["samples"] == [("", {"scope": "local"}, 4.0)]
+
+    def test_strict_parser_rejections(self):
+        with pytest.raises(ValueError, match="EOF"):
+            export.parse_openmetrics("# TYPE a counter\na_total 1\n")
+        with pytest.raises(ValueError, match="no preceding TYPE"):
+            export.parse_openmetrics("orphan 1\n# EOF\n")
+        with pytest.raises(ValueError, match="duplicate sample"):
+            export.parse_openmetrics(
+                "# TYPE a gauge\na 1\na 2\n# EOF\n")
+        non_cumulative = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 5\nh_bucket{le="+Inf"} 3\n'
+            "h_count 3\nh_sum 1\n# EOF\n")
+        with pytest.raises(ValueError, match="cumulative"):
+            export.parse_openmetrics(non_cumulative)
+        no_inf = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="0.1"} 3\nh_count 3\nh_sum 1\n# EOF\n')
+        with pytest.raises(ValueError, match="Inf"):
+            export.parse_openmetrics(no_inf)
+
+    def test_accept_negotiation_predicate(self):
+        assert export.wants_openmetrics(
+            "application/openmetrics-text; version=1.0.0")
+        assert export.wants_openmetrics("text/plain")
+        assert not export.wants_openmetrics("application/json")
+        assert not export.wants_openmetrics("")
+        assert not export.wants_openmetrics(None)
+
+
+# ---------------------------------------------------------------------------
+# health verdicts
+# ---------------------------------------------------------------------------
+
+
+class TestHealthVerdicts:
+    def test_history_only_verdicts(self):
+        improving = [10.0 / (i + 1) for i in range(30)]
+        rep = health.assess(_docs(improving))
+        assert rep["verdict"] == "healthy"
+        assert rep["checks"]["stagnating"] is False
+        assert rep["checks"]["improvement_rel"] > 0.5
+
+        flat = [5.0 - 0.5 * i for i in range(8)] + [1.0] * 22
+        rep = health.assess(_docs(flat))
+        assert rep["verdict"] == "stagnating"
+        assert rep["checks"]["improvement_rel"] == pytest.approx(0.0)
+
+        # too little history: stagnation undecided, not alarmed
+        rep = health.assess(_docs([3.0, 2.0, 1.0]))
+        assert rep["verdict"] == "healthy"
+        assert rep["checks"]["stagnating"] is None
+
+    def test_duplicated_candidates_warn(self):
+        # improving losses (no stagnation signal yet) but every
+        # suggested point is identical -> candidate-set duplication
+        rep = health.assess(_docs([1.0 / (i + 1) for i in range(10)],
+                                  x=2.0))
+        assert rep["checks"]["dup_rate"] == pytest.approx(0.9)
+        assert rep["verdict"] == "warn"
+
+    def test_gp_ei_collapse_on_flat_losses(self):
+        from hyperopt_tpu.backends import contract, gp
+
+        dom = contract.conformance_domain()
+        t = contract.seeded_trials(dom, n=24, seed=0)
+        for d in t.trials:                 # zero-spread loss history
+            d["result"]["loss"] = 1.0
+        rep = health.assess(t.trials, domain=dom, trials=t,
+                            suggest_fn=gp.suggest)
+        info = rep["introspection"]
+        assert info["backend"] == "gp"
+        assert info["ei_rel"] < 1e-3
+        assert rep["checks"]["ei_collapse"] is True
+        assert rep["verdict"] == "ei_collapse"
+        # JSON-safe: the health verb ships this over the wire
+        json.dumps(rep)
+
+    def test_gp_healthy_on_real_history(self):
+        from hyperopt_tpu.backends import contract, gp
+
+        dom = contract.conformance_domain()
+        t = contract.seeded_trials(dom, n=24, seed=0)
+        rep = health.assess(t.trials, domain=dom, trials=t,
+                            suggest_fn=gp.suggest)
+        assert rep["checks"]["ei_collapse"] is False
+        assert rep["verdict"] == "healthy"
+        assert "logml" in rep["introspection"]
+
+    def test_tpe_split_introspection(self):
+        from hyperopt_tpu import tpe
+        from hyperopt_tpu.backends import contract
+
+        dom = contract.conformance_domain()
+        hook = contract.introspect_of(tpe.suggest)
+        assert hook is not None
+        t24 = contract.seeded_trials(dom, n=24, seed=0)
+        info = hook(dom, t24, seed=0)
+        assert info["n_below"] + info["n_above"] == 24
+        assert info["split_degenerate"] is False
+        # a tiny history cannot form a good side of >= 2 -> degenerate,
+        # which assess() surfaces as a warn (not a hard verdict)
+        t4 = contract.seeded_trials(dom, n=4, seed=0)
+        info4 = hook(dom, t4, seed=0)
+        assert info4["split_degenerate"] is True
+        rep = health.assess(t4.trials, domain=dom, trials=t4,
+                            suggest_fn=tpe.suggest)
+        assert rep["verdict"] == "warn"
+
+    def test_introspect_unwraps_partials_and_survives_errors(self):
+        import functools
+
+        from hyperopt_tpu.backends import contract, gp
+
+        wrapped = functools.partial(gp.suggest, n_EI_candidates=8)
+        assert contract.introspect_of(wrapped) is gp.introspect
+
+        def boom(domain, trials, seed=0):
+            raise RuntimeError("surrogate exploded")
+
+        def fake_suggest():
+            pass
+
+        fake_suggest.introspect = boom
+        rep = health.assess(_docs([1.0]), domain=object(), trials=object(),
+                            suggest_fn=fake_suggest)
+        assert "error" in rep["introspection"]
+        assert rep["checks"]["ei_collapse"] is None   # diagnostics only
+
+    def test_publish_gauges(self):
+        reg = _reg()
+        health.publish("e1", {"code": 3}, reg=reg)
+        health.publish("e2", {"code": 0}, reg=reg)
+        snap = reg.snapshot()
+        assert snap["gauges"]["health.verdict.e1"] == 3
+        assert snap["gauges"]["health.verdict.e2"] == 0
+        assert snap["counters"]["health.assessments"] == 2
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate alerting
+# ---------------------------------------------------------------------------
+
+
+class TestSloBurnRate:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="kind"):
+            SloSpec("x", metric="m", kind="availability")
+        with pytest.raises(ValueError, match="budget"):
+            SloSpec("x", metric="m", budget=0.0)
+
+    def test_default_slos_names(self):
+        assert [s.name for s in default_slos()] == \
+            ["suggest_p95", "worker_liveness", "wal_fsync_lag"]
+
+    def test_latency_slo_fires_then_clears(self):
+        reg = _reg()
+        ts = TimeSeriesStore(reg)
+        log = EventLog()
+        log.enable()
+        spec = SloSpec("suggest_p95", metric="netstore.verb.suggest.s",
+                       kind="latency_p95", target=0.25, budget=0.25,
+                       fast_window=10, slow_window=60)
+        mon = SloMonitor((spec,), ts, reg=reg, events=log)
+        h = reg.histogram("netstore.verb.suggest.s")
+
+        for _ in range(4):
+            h.observe(0.01)
+        ts.scrape(now=T0)
+        (st,) = mon.evaluate(now=T0)
+        assert st["firing"] is False
+
+        for _ in range(8):                 # breach: all above target
+            h.observe(1.0)
+        ts.scrape(now=T0 + 20)
+        (st,) = mon.evaluate(now=T0 + 20)
+        # fast window diffs the breach only -> burn 1.0/0.25 = 4; the
+        # slow window still folds in the healthy prefix.
+        assert st["burn_fast"] == pytest.approx(4.0)
+        assert st["burn_slow"] == pytest.approx((8 / 12) / 0.25)
+        assert st["firing"] is True
+        assert mon.alerts() == [st]
+
+        for _ in range(6):                 # recovery
+            h.observe(0.01)
+        ts.scrape(now=T0 + 40)
+        (st,) = mon.evaluate(now=T0 + 40)
+        assert st["burn_fast"] == pytest.approx(0.0)
+        assert st["firing"] is False
+        assert mon.alerts() == []
+
+        snap = reg.snapshot()
+        assert snap["counters"]["slo.alerts.fired"] == 1
+        assert snap["counters"]["slo.alerts.resolved"] == 1
+        assert snap["gauges"]["slo.suggest_p95.firing"] == 0.0
+        states = [e["state"] for e in log.snapshot()
+                  if e["type"] == "slo_alert"]
+        assert states == ["firing", "resolved"]
+
+    def test_both_windows_must_corroborate_to_fire(self):
+        """A fast-window blip with a clean slow window never fires."""
+        reg = _reg()
+        ts = TimeSeriesStore(reg)
+        spec = SloSpec("suggest_p95", metric="m.s", kind="latency_p95",
+                       target=0.25, budget=0.25, fast_window=10,
+                       slow_window=60)
+        mon = SloMonitor((spec,), ts, reg=reg, events=EventLog())
+        h = reg.histogram("m.s")
+        for _ in range(40):
+            h.observe(0.01)
+        ts.scrape(now=T0)
+        for _ in range(4):                 # short blip
+            h.observe(1.0)
+        ts.scrape(now=T0 + 20)
+        (st,) = mon.evaluate(now=T0 + 20)
+        assert st["burn_fast"] >= 1.0
+        assert st["burn_slow"] < 1.0
+        assert st["firing"] is False
+
+    def test_gauge_min_slo(self):
+        reg = _reg()
+        ts = TimeSeriesStore(reg)
+        spec = SloSpec("worker_liveness", metric="fleet.live_fraction",
+                       kind="gauge_min", target=0.9, budget=0.5,
+                       fast_window=10, slow_window=40)
+        mon = SloMonitor((spec,), ts, reg=reg, events=EventLog())
+        g = reg.gauge("fleet.live_fraction")
+        for i, v in enumerate((1.0, 1.0)):
+            g.set(v)
+            ts.scrape(now=T0 + i)
+        for i, v in enumerate((0.2, 0.3)):
+            g.set(v)
+            ts.scrape(now=T0 + 15 + i)
+        (st,) = mon.evaluate(now=T0 + 16)
+        assert st["firing"] is True        # fast 2/2 bad, slow 2/4 bad
+        assert st["value"] == 0.3          # latest in-window sample
+        g.set(1.0)
+        ts.scrape(now=T0 + 30)
+        (st,) = mon.evaluate(now=T0 + 30)
+        assert st["firing"] is False
+
+    def test_empty_store_stays_quiet(self):
+        mon = SloMonitor(default_slos(), TimeSeriesStore(_reg()),
+                         reg=_reg(), events=EventLog())
+        for st in mon.evaluate(now=T0):
+            assert st["firing"] is False
+            assert st["burn_fast"] is None
+        assert mon.alerts() == []
+
+
+# ---------------------------------------------------------------------------
+# server integration: negotiation, health verb, live panels, chaos
+# ---------------------------------------------------------------------------
+
+
+def _quad_space():
+    return {"x": hp.uniform("x", -5, 5)}
+
+
+def _quad(d):
+    return (d["x"] - 3.0) ** 2
+
+
+def _seed_completed(nt, dom, losses):
+    docs = rand.suggest(nt.new_trial_ids(len(losses)), dom, nt, 0)
+    for d, loss in zip(docs, losses):
+        d["state"] = JOB_STATE_DONE
+        d["result"] = {"status": "ok", "loss": float(loss)}
+    nt.insert_trial_docs(docs)
+
+
+class TestServerObservability:
+    def test_negotiation_health_verb_and_live_panels(self, tmp_path):
+        from hyperopt_tpu import show
+        from hyperopt_tpu.parallel import NetTrials, StoreServer
+
+        srv = StoreServer(str(tmp_path / "store"), token="s3kr1t")
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="s3kr1t")
+            dom = Domain(_quad, _quad_space())
+            # early improvement, then a 22-trial plateau
+            _seed_completed(nt, dom,
+                            [5.0 - 0.5 * i for i in range(8)] + [1.0] * 22)
+
+            rep = nt.health()
+            assert rep["e1"]["verdict"] == "stagnating"
+            assert rep["e1"]["n_done"] == 30
+            rep_all = nt.health(all=True, introspect=False)
+            assert rep_all["e1"]["introspection"] is None
+
+            status = srv.observe_pass(now=T0)
+            assert [s["name"] for s in status] == \
+                [s.name for s in default_slos()]
+
+            # default GET stays JSON with the historical schema + the
+            # new health/alerts blocks
+            req = urllib.request.Request(
+                srv.url + "/metrics",
+                headers={"X-Netstore-Token": "s3kr1t"})
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                assert "json" in resp.headers["Content-Type"]
+                snap = json.loads(resp.read())
+            assert {"enabled", "counters", "gauges", "histograms",
+                    "fleet", "health", "alerts"} <= set(snap)
+            assert snap["health"]["e1"]["verdict"] == "stagnating"
+
+            # Accept negotiation flips the same endpoint to OpenMetrics
+            req = urllib.request.Request(
+                srv.url + "/metrics",
+                headers={"X-Netstore-Token": "s3kr1t",
+                         "Accept": "application/openmetrics-text"})
+            with urllib.request.urlopen(req, timeout=10.0) as resp:
+                assert resp.headers["Content-Type"] == export.CONTENT_TYPE
+                fams = export.parse_openmetrics(
+                    resp.read().decode("utf-8"))
+            verdicts = [f for f in fams if "health_verdict" in f]
+            assert verdicts, sorted(fams)
+
+            # the live dashboard renders the verdict and alert tables
+            buf = io.StringIO()
+            show.render_live(snap, out=buf)
+            frame = buf.getvalue()
+            assert "health:" in frame and "stagnating" in frame
+            assert "alerts:" in frame and "suggest_p95" in frame
+        finally:
+            srv.shutdown()
+
+    def test_rpc_fault_chaos_fires_alert_into_merged_trace(self, tmp_path):
+        from hyperopt_tpu import show
+        from hyperopt_tpu.parallel import NetTrials, StoreServer
+
+        srv = StoreServer(str(tmp_path / "store"))
+        srv.start()
+        log = EventLog()
+        log.enable()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1")
+            ts = TimeSeriesStore()            # global registry
+            spec = SloSpec("suggest_p95", metric="netstore.client.rpc.s",
+                           kind="latency_p95", target=0.04, budget=0.5,
+                           fast_window=10, slow_window=40)
+            mon = SloMonitor((spec,), ts, events=log)
+
+            nt.refresh()
+            ts.scrape(now=T0 - 50)            # anchor: excludes history
+
+            # chaos: every RPC eats two rpc.send faults, so the client's
+            # retry backoff pushes its observed latency >= ~150 ms
+            for i in range(3):
+                with faults.injected("rpc.send", prob=1.0, times=2,
+                                     seed=i):
+                    nt.refresh()
+            ts.scrape(now=T0)
+            (st,) = mon.evaluate(now=T0)
+            assert st["burn_fast"] >= 1.0 and st["burn_slow"] >= 1.0
+            assert st["firing"] is True
+
+            for _ in range(3):                # recovery: clean RPCs
+                nt.refresh()
+            ts.scrape(now=T0 + 20)
+            (st,) = mon.evaluate(now=T0 + 20)
+            assert st["firing"] is False
+
+            alerts = [e for e in log.snapshot() if e["type"] == "slo_alert"]
+            assert [e["state"] for e in alerts] == ["firing", "resolved"]
+            assert all(e["name"] == "suggest_p95" for e in alerts)
+
+            # ... and the alert rides the normal trace dump/merge path
+            lane = tmp_path / "server"
+            lane.mkdir()
+            log.dump_jsonl(str(lane / "loop_events.jsonl"))
+            doc = show.merge_traces([str(lane)], out=io.StringIO())
+            marks = [e for e in doc["traceEvents"]
+                     if e.get("cat") == "hyperopt_tpu:slo_alert"]
+            assert len(marks) == 2
+            assert {e["name"] for e in marks} == {"suggest_p95"}
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# overhead
+# ---------------------------------------------------------------------------
+
+
+class TestDisabledOverhead:
+    def test_disabled_registry_hot_path_bound(self):
+        """The observability surface this PR adds must stay free when
+        metrics are off: same bound as the r6 instrumentation tests."""
+        reg = MetricsRegistry(enabled=False)
+        g = reg.gauge("slo.suggest_p95.firing")
+        c = reg.counter("health.assessments")
+        h = reg.histogram("netstore.client.rpc.s")
+        n = 20000
+        t0 = time.perf_counter()
+        for _ in range(n):
+            g.set(1.0)
+            c.inc()
+            h.observe(0.1)
+        per_op = (time.perf_counter() - t0) / (3 * n)
+        assert per_op < 5e-6
+
+    def test_disabled_registry_scrape_sees_frozen_series(self):
+        """A disabled registry snapshots zero-frozen series; scraping it
+        yields flat counters and no histogram state at all."""
+        reg = MetricsRegistry(enabled=False)
+        reg.counter("c").inc(5)
+        reg.histogram("h").observe(0.1)
+        ts = TimeSeriesStore(reg)
+        ts.scrape(now=T0)
+        ts.scrape(now=T0 + 10)
+        assert ts.delta("c", 10.0, now=T0 + 10) == 0.0
+        assert ts.window_state("h", 10.0, now=T0 + 10) is None

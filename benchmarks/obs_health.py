@@ -130,6 +130,34 @@ def interpretation_ms():
             "slo_evaluate_ms": round(evaluate_ms, 3)}
 
 
+def flight_cost_ns(n=N_MICRO):
+    """ns per call of the r12 postmortem hooks on their DISARMED path —
+    the price every production op pays for the always-available flight
+    recorder and cost ledger.  A/B against the same ~66 ns module-global
+    boolean budget as ``faults.maybe_fail`` (the r11 contract)."""
+    from hyperopt_tpu import faults
+    from hyperopt_tpu.obs import costs, flight
+
+    assert not flight._armed and not costs.armed()
+    out = {}
+    probes = (
+        ("flight_on_crash", lambda e=ValueError("x"):
+            flight.on_crash("bench", e)),
+        ("costs_observe_dispatch", lambda: costs.observe_dispatch("k", 1.0)),
+        ("costs_record_compile", lambda:
+            costs.record_compile("tpe", ("k",), None, n_cap=8, P=2, m=1)),
+        ("faults_maybe_fail", lambda: faults.maybe_fail("bench.point")),
+    )
+    for label, fn in probes:
+        for _ in range(1000):            # warm
+            fn()
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        out[f"{label}_ns"] = round((time.perf_counter() - t0) / n * 1e9, 1)
+    return out
+
+
 def collect(fast=False):
     """The bench payload (no timestamp — callers stamp it), also
     embedded by bench.py's ``obs`` phase."""
@@ -137,10 +165,16 @@ def collect(fast=False):
     rows = [scrape_row(n) for n in ((1000,) if fast else (1000, 10000))]
     doc = {"hot_path": hot, "rows": rows}
     doc.update(interpretation_ms())
+    fc = flight_cost_ns(n=20_000 if fast else N_MICRO)
+    doc["flight_cost_disabled"] = fc
     doc["headline"] = {
         "disabled_within_200ns": hot["disabled_ns_per_op"] < 200.0,
         "enabled_ns_per_op": hot["enabled_ns_per_op"],
         "scrape_ms_largest": rows[-1]["scrape_ms"],
+        # r12 contract: disarmed flight/cost hooks stay within the same
+        # order as the faults boolean check (~66 ns measured bar).
+        "flight_cost_disabled_within_200ns": all(
+            v < 200.0 for v in fc.values()),
     }
     return doc
 

@@ -41,6 +41,8 @@ import jax.numpy as jnp
 from .. import tpe as _tpe
 from .. import history as _rhist
 from . import _codec
+from ..obs import costs as _costs
+from ..obs.metrics import kernel_cache_event
 from ..obs.metrics import registry as _metrics_registry
 
 _default_sigma0 = 0.25
@@ -114,10 +116,25 @@ def _get_suggest_fn(cs, n_cap, m, popsize, sigma0, lr, rank_shaping):
         cs._es_kernels = cache
     key = (n_cap, m, popsize, float(sigma0), float(lr), bool(rank_shaping))
     fn = cache.get(key)
-    if fn is None:
+    hit = fn is not None
+    if not hit:
         fn = _build_suggest_fn(cs, n_cap, m, popsize, sigma0, lr,
                                rank_shaping)
+        fn._cost_key = ("es",) + key
         cache[key] = fn
+    # ES programs join the shared compile-shape + cost-ledger accounting.
+    kernel_cache_event(fn._cost_key, hit)
+    if not hit:
+        def _lower(fn=fn):
+            f32 = jnp.float32
+            sd = jax.ShapeDtypeStruct
+            p = cs.n_params
+            return fn.lower(
+                sd((), jnp.uint32),
+                sd((n_cap, p), f32), sd((n_cap, p), jnp.bool_),
+                sd((n_cap,), f32), sd((n_cap,), jnp.bool_)).compile()
+        _costs.record_compile("es", fn._cost_key, _lower, n_cap=n_cap,
+                              P=cs.n_params, m=m)
     return fn
 
 
@@ -157,8 +174,9 @@ def suggest_dispatch(new_ids, domain, trials, seed, n_startup_jobs=None,
     _tpe._obs_ms(reg, "suggest.upload_ms", (perf_counter() - t_feed) * 1e3)
     t_disp = perf_counter()
     rows = fn(np.uint32(int(seed) % (2 ** 32)), hv, ha, hl, hok)
-    _tpe._obs_ms(reg, "backend.es.dispatch_ms",
-                 (perf_counter() - t_disp) * 1e3)
+    dms = (perf_counter() - t_disp) * 1e3
+    _tpe._obs_ms(reg, "backend.es.dispatch_ms", dms)
+    _costs.observe_dispatch(fn._cost_key, dms)
     return ("pending", cs, list(new_ids), (rows, None), exp_key)
 
 

@@ -55,6 +55,8 @@ import jax.numpy as jnp
 from .. import tpe as _tpe
 from .. import history as _rhist
 from . import _codec
+from ..obs import costs as _costs
+from ..obs.metrics import kernel_cache_event
 from ..obs.metrics import registry as _metrics_registry
 
 _default_n_startup_jobs = 10
@@ -176,9 +178,25 @@ def _get_suggest_fn(cs, n_cap, n_cand, m, max_n):
         cs._gp_kernels = cache
     key = (n_cap, n_cand, m, max_n)
     fn = cache.get(key)
-    if fn is None:
+    hit = fn is not None
+    if not hit:
         fn = _build_suggest_fn(cs, n_cap, n_cand, m, max_n)
+        fn._cost_key = ("gp",) + key
         cache[key] = fn
+    # GP programs share the kernel-cache compile-shape accounting (and
+    # through it the cost ledger's request join) with the TPE heads.
+    kernel_cache_event(fn._cost_key, hit)
+    if not hit:
+        def _lower(fn=fn):
+            f32 = jnp.float32
+            sd = jax.ShapeDtypeStruct
+            p = cs.n_params
+            return fn.lower(
+                sd((), jnp.uint32),
+                sd((n_cap, p), f32), sd((n_cap, p), jnp.bool_),
+                sd((n_cap,), f32), sd((n_cap,), jnp.bool_)).compile()
+        _costs.record_compile("gp", fn._cost_key, _lower, n_cap=n_cap,
+                              P=cs.n_params, m=m)
     return fn
 
 
@@ -228,8 +246,9 @@ def suggest_dispatch(new_ids, domain, trials, seed,
                  (perf_counter() - t_feed) * 1e3)
     t_disp = perf_counter()
     rows = fn(np.uint32(int(seed) % (2 ** 32)), hv, ha, hl, hok)
-    _tpe._obs_ms(reg, "backend.gp.dispatch_ms",
-                 (perf_counter() - t_disp) * 1e3)
+    dms = (perf_counter() - t_disp) * 1e3
+    _tpe._obs_ms(reg, "backend.gp.dispatch_ms", dms)
+    _costs.observe_dispatch(fn._cost_key, dms)
     return ("pending", cs, list(new_ids), (rows, None), exp_key)
 
 

@@ -82,6 +82,7 @@ FAULT_POINTS = frozenset(
         "pipeline.dispatch",
         "wal.write",
         "wal.replay",
+        "flight.dump",
     }
 )
 

@@ -40,12 +40,35 @@ import numpy as np
 
 from . import base, tpe
 from . import history as _rhist
+from .obs import bundle as _bundle
 from .obs.events import EVENTS
 from .obs.metrics import registry as _registry
 
-__all__ = ["CohortScheduler", "space_signature", "cohort_tier",
-           "suggest_materialize", "suggest_start_transfer",
+__all__ = ["CohortScheduler", "fleet_report", "space_signature",
+           "cohort_tier", "suggest_materialize", "suggest_start_transfer",
            "suggest_handle_ready"]
+
+#: Live schedulers, for the flight-bundle ``fleet`` section.
+_SCHEDULERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def fleet_report() -> dict:
+    """Cohort-state snapshot for postmortem bundles: per scheduler, each
+    cohort's lane tier and live occupancy — the context a bundle needs
+    to read its ``fleet_dispatch`` events and per-tier cost rows."""
+    scheds = []
+    for s in list(_SCHEDULERS):
+        with s._lock:
+            cohorts = []
+            for (sig, n_cap, m), st in s._states.items():
+                occ = sum(1 for w in st.lanes if w is not None and
+                          w() is not None)
+                cohorts.append({"n_cap": n_cap, "m": m,
+                                "tier": len(st.lanes), "occupied": occ,
+                                "resident": st.store is not None})
+        scheds.append({"cohorts": cohorts,
+                       "n_spaces": len(s._rep_cs)})
+    return {"n_schedulers": len(scheds), "schedulers": scheds}
 
 
 def space_signature(cs) -> tuple:
@@ -188,6 +211,8 @@ class CohortScheduler:
             linear_forgetting=self.linear_forgetting, split=self.split,
             multivariate=self.multivariate, startup=self.startup,
             cat_prior=self.cat_prior)
+        _SCHEDULERS.add(self)
+        _bundle.register_provider("fleet", fleet_report)
 
     # -- planning ------------------------------------------------------------
 

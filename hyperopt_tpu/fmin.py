@@ -36,6 +36,7 @@ from .base import (
 )
 from .exceptions import AllTrialsFailed, is_transient
 from .obs import context as _context
+from .obs import flight as _flight
 from .obs import metrics as _metrics
 from .obs.events import EVENTS
 from .space import compile_space
@@ -488,11 +489,20 @@ class FMinIter:
 
     def exhaust(self):
         """Run until ``max_evals`` complete (or a stop condition fires)."""
+        # Arm the flight recorder when a dump dir is configured
+        # (HYPEROPT_TPU_FLIGHT_DIR) — a no-op otherwise, so every run
+        # gets black-box capture for free once the env knob is set.
+        _flight.install()
         self.tracer.start_device_trace()
         t0 = time.perf_counter()
         try:
             self._loop()
             self.block_until_done()
+        except BaseException as e:
+            # Freeze the black box before the exception unwinds the
+            # driver; on_crash ignores operator interrupts.
+            _flight.on_crash("fmin", e)
+            raise
         finally:
             wall = time.perf_counter() - t0
             if wall > 0:

@@ -42,9 +42,13 @@ touches the traced/compiled XLA programs.
 
 from __future__ import annotations
 
+from . import bundle  # noqa: F401
 from . import context  # noqa: F401
+from . import costs  # noqa: F401
+from . import flight  # noqa: F401
 from .events import EVENTS, EventLog, events_to_chrome  # noqa: F401
 from .metrics import (  # noqa: F401
+    LabelLru,
     MetricsRegistry,
     kernel_cache_event,
     kernel_cache_stats,

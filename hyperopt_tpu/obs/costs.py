@@ -1,0 +1,246 @@
+"""Per-kernel cost attribution: compile wall time + XLA cost analysis.
+
+Every kernel-cache compile site (``tpe.get_kernel``, the fleet vmap
+tiers, ``backends/gp``, ``backends/es`` — the Pallas-EI variants are
+distinct cache keys of the TPE kernel, so they get their own rows)
+already feeds :func:`~hyperopt_tpu.obs.metrics.kernel_cache_event`.
+This module adds the *cost* side of that accounting: on a cache miss,
+an **armed** recorder AOT-lowers and compiles the program's hot entry
+(``fn.lower(*shapes).compile()``) and records
+
+* compile wall time,
+* XLA ``cost_analysis`` (flops, bytes accessed) and
+  ``memory_analysis`` (peak / argument / output / temp bytes) where the
+  backend exposes them (best-effort: CPU backends may return nothing),
+
+keyed by the **same** ``repr(key)`` the kernel-cache counters use, so
+:func:`ledger_report` can join compile cost with live request counts
+(``kernel_cache_stats()["by_key"]``) and per-dispatch wall times into
+one ledger answering "ms and bytes per suggestion, by program".
+
+Cost model: DISARMED (the default) every hook is a single module-global
+boolean check — the same discipline as ``obs.context`` / ``faults.py``,
+measured alongside them in ``benchmarks/obs_health.py`` against the
+~66 ns/op budget.  ARMED (``HYPEROPT_TPU_COSTS=1`` or :func:`arm`), a
+cache miss pays one extra AOT compile of the program it just built —
+the serving compile itself is untouched — and a dispatch pays one
+dict update under a lock.  Recording failures are contained: the
+ledger must never break the serve path (``cost.errors`` counts them).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import metrics as _metrics
+
+__all__ = [
+    "arm",
+    "armed",
+    "clear",
+    "disarm",
+    "ledger_report",
+    "observe_dispatch",
+    "record_compile",
+]
+
+#: Module-global fast path: every hook starts with ``if not _armed``.
+_armed = os.environ.get("HYPEROPT_TPU_COSTS", "") in ("1", "on", "true")
+
+_LOCK = threading.Lock()
+#: repr(cache key) -> compile-cost entry (see record_compile).
+_LEDGER: dict = {}
+#: repr(cache key) -> live per-dispatch accumulator (see observe_dispatch).
+_LIVE: dict = {}
+
+#: Which shared live histograms attribute to which kernel family —
+#: consulted by ledger_report for the "live" join of each entry.
+_FAMILY_SERIES = {
+    "tpe": ("suggest.upload_ms", "suggest.dispatch_ms",
+            "suggest.fetch_sync_ms"),
+    "fleet": ("suggest.upload_ms", "suggest.dispatch_ms",
+              "suggest.fetch_sync_ms"),
+    "gp": ("suggest.upload_ms", "backend.gp.dispatch_ms"),
+    "es": ("suggest.upload_ms", "backend.es.dispatch_ms"),
+}
+
+
+def armed() -> bool:
+    return _armed
+
+
+def arm() -> None:
+    global _armed
+    _armed = True
+
+
+def disarm() -> None:
+    global _armed
+    _armed = False
+
+
+def clear() -> None:
+    """Drop all recorded entries (tests/benches)."""
+    with _LOCK:
+        _LEDGER.clear()
+        _LIVE.clear()
+
+
+def _cost_analysis(compiled) -> dict:
+    """Best-effort XLA cost/memory analysis off a compiled program.
+
+    ``cost_analysis()`` returns a dict (newer jax) or a list of dicts
+    (one per computation, older jax); ``memory_analysis()`` returns an
+    object with ``*_size_in_bytes`` attributes.  Either may be missing
+    or raise on a given backend — absent numbers stay ``None`` rather
+    than poisoning the entry.
+    """
+    out = {"flops": None, "bytes_accessed": None,
+           "peak_memory_bytes": None, "argument_bytes": None,
+           "output_bytes": None, "temp_bytes": None,
+           "generated_code_bytes": None}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            out["flops"] = ca.get("flops")
+            out["bytes_accessed"] = ca.get("bytes accessed")
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            for field, attr in (
+                    ("peak_memory_bytes", "temp_size_in_bytes"),
+                    ("argument_bytes", "argument_size_in_bytes"),
+                    ("output_bytes", "output_size_in_bytes"),
+                    ("temp_bytes", "temp_size_in_bytes"),
+                    ("generated_code_bytes",
+                     "generated_code_size_in_bytes")):
+                v = getattr(ma, attr, None)
+                if v is not None:
+                    out[field] = int(v)
+            # Peak = arguments + outputs + temporaries when XLA gives the
+            # pieces; keep temp alone if the others are absent.
+            parts = [out["argument_bytes"], out["output_bytes"],
+                     out["temp_bytes"]]
+            if all(p is not None for p in parts):
+                out["peak_memory_bytes"] = sum(parts)
+    except Exception:
+        pass
+    return out
+
+
+def record_compile(kernel: str, key, lower=None, *, n_cap=None, P=None,
+                   m=None, tier=None, compile_s=None):
+    """Record one kernel-cache **miss**'s compile cost.
+
+    ``kernel`` is the family name (``tpe`` / ``fleet`` / ``gp`` /
+    ``es``); ``key`` is the cache-key tuple the site also passed to
+    ``kernel_cache_event`` — ``repr(key)`` is the join key.  ``lower``
+    is a zero-arg callable performing the AOT lowering
+    (``fn.lower(*shapes).compile()``) and returning the compiled
+    program; it only runs when armed.  Alternatively a pre-measured
+    ``compile_s`` may be passed.  Returns the ledger entry (or None
+    when disarmed / on a contained failure).
+    """
+    if not _armed:
+        return None
+    reg = _metrics.registry()
+    entry = {"kernel": kernel, "key": repr(key), "n_cap": n_cap, "P": P,
+             "m": m, "tier": tier, "compile_s": compile_s}
+    try:
+        if lower is not None:
+            t0 = time.perf_counter()
+            compiled = lower()
+            entry["compile_s"] = time.perf_counter() - t0
+            entry.update(_cost_analysis(compiled))
+    except Exception:
+        reg.counter("cost.errors").inc()
+        return None
+    with _LOCK:
+        _LEDGER[entry["key"]] = entry
+        n = len(_LEDGER)
+    reg.counter("cost.compiles").inc()
+    if entry["compile_s"] is not None:
+        reg.histogram("cost.compile_s").observe(entry["compile_s"])
+    reg.gauge("cost.entries").set(n)
+    return entry
+
+
+def observe_dispatch(key, ms: float) -> None:
+    """Attribute one live dispatch's wall time to its program.
+
+    Called from the suggest hot paths with the same cache key the
+    compile site used; disarmed cost is the module-global boolean.
+    """
+    if not _armed:
+        return
+    ks = repr(key)
+    with _LOCK:
+        acc = _LIVE.get(ks)
+        if acc is None:
+            acc = _LIVE[ks] = {"calls": 0, "total_ms": 0.0,
+                               "min_ms": None, "max_ms": None}
+        acc["calls"] += 1
+        acc["total_ms"] += ms
+        if acc["min_ms"] is None or ms < acc["min_ms"]:
+            acc["min_ms"] = ms
+        if acc["max_ms"] is None or ms > acc["max_ms"]:
+            acc["max_ms"] = ms
+
+
+def ledger_report(reg=None) -> dict:
+    """The joined per-kernel cost ledger.
+
+    One row per recorded compile, joined with the always-on kernel-cache
+    request counts (same ``repr(key)``), the per-key live dispatch
+    accumulator, and the family's shared ``suggest.*_ms`` /
+    ``backend.*.dispatch_ms`` histogram summaries.  Derived columns:
+    ``ms_per_suggestion`` (mean live dispatch ms / proposals per call)
+    and ``bytes_per_suggestion`` (program bytes accessed / proposals).
+    """
+    reg = reg if reg is not None else _metrics.registry()
+    kcs = _metrics.kernel_cache_stats()
+    by_key = kcs.get("by_key", {})
+    with _LOCK:
+        entries = {k: dict(v) for k, v in _LEDGER.items()}
+        live = {k: dict(v) for k, v in _LIVE.items()}
+    snap = reg.snapshot()
+    hists = snap.get("histograms", {})
+    rows = []
+    for ks in sorted(entries):
+        e = entries[ks]
+        cache = by_key.get(ks, {})
+        e["requests"] = cache.get("requests", 0)
+        e["misses"] = cache.get("misses", 0)
+        acc = live.get(ks)
+        if acc:
+            e["dispatches"] = acc["calls"]
+            e["dispatch_ms_mean"] = acc["total_ms"] / acc["calls"]
+            e["dispatch_ms_min"] = acc["min_ms"]
+            e["dispatch_ms_max"] = acc["max_ms"]
+        m = e.get("m") or 1
+        if acc:
+            e["ms_per_suggestion"] = e["dispatch_ms_mean"] / m
+        if e.get("bytes_accessed") is not None:
+            e["bytes_per_suggestion"] = e["bytes_accessed"] / m
+        rows.append(e)
+    fams = sorted({e["kernel"] for e in rows} or _FAMILY_SERIES)
+    live_series = {}
+    for fam in fams:
+        for name in _FAMILY_SERIES.get(fam, ()):
+            h = hists.get(name)
+            if h and h.get("count"):
+                live_series[name] = {k: h.get(k) for k in
+                                     ("count", "mean", "p50", "p95")}
+    return {
+        "entries": rows,
+        "live_ms": live_series,
+        "kernel_cache": {"requests": kcs.get("requests", 0),
+                         "misses": kcs.get("misses", 0)},
+        "armed": _armed,
+    }

@@ -83,6 +83,7 @@ EVENT_TYPES = frozenset(
         "store_heartbeat",
         "rpc",
         "slo_alert",
+        "flight_dump",
     }
 )
 
@@ -109,6 +110,7 @@ class EventLog:
         self._tls = threading.local()
         self._enabled = False
         self.n_emitted = 0  # total ever emitted (buffer may have dropped some)
+        self.n_dropped = 0  # events the full ring displaced (overflow tally)
         # One wall/mono anchor pair: t_wall is always derived from t_mono so
         # the two clocks can never disagree about event ordering.
         self._wall0 = time.time()
@@ -151,6 +153,7 @@ class EventLog:
         with self._lock:
             self._buf.clear()
             self.n_emitted = 0
+            self.n_dropped = 0
 
     # -- emission --------------------------------------------------------
     def _stack(self) -> list:
@@ -195,6 +198,11 @@ class EventLog:
                 if rec.get("trial") is None and ctx.get("tid") is not None:
                     rec["trial"] = ctx["tid"]
         with self._lock:
+            if len(self._buf) == self.capacity:
+                # deque(maxlen=...) silently displaces the oldest record;
+                # tally it so coverage claims ("the ring holds the whole
+                # run") stay honest in bundles and `show trace`.
+                self.n_dropped += 1
             self._buf.append(rec)
             self.n_emitted += 1
         return rec
@@ -236,7 +244,9 @@ class EventLog:
         """
         events = self.snapshot()
         with open(path, "w") as fh:
-            fh.write(json.dumps({"type": "meta", **self.meta()}) + "\n")
+            fh.write(json.dumps({"type": "meta", **self.meta(),
+                                 "n_emitted": self.n_emitted,
+                                 "n_dropped": self.n_dropped}) + "\n")
             for rec in events:
                 fh.write(json.dumps(rec) + "\n")
         return len(events)

@@ -166,9 +166,19 @@ def assess(docs, domain=None, trials=None, suggest_fn=None, *,
     return report
 
 
+# Bounded live-label set: experiment churn would otherwise grow one
+# ``health.verdict.<store>`` gauge per store ever assessed.  Evictions
+# bump ``obs.series_evicted`` (HYPEROPT_TPU_SERIES_LABEL_CAP caps it).
+_VERDICT_LABELS = _metrics.LabelLru()
+
+
 def publish(label: str, report: dict, reg=None) -> None:
     """Publish one report as the ``health.verdict.<store>`` gauge
-    (value: ``VERDICT_CODE``) and bump ``health.assessments``."""
+    (value: ``VERDICT_CODE``) and bump ``health.assessments``.  The
+    live gauge set is LRU-bounded; the verdict for an evicted store is
+    republished on its next assessment."""
     reg = reg if reg is not None else _metrics.registry()
+    for old in _VERDICT_LABELS.touch(label):
+        reg.remove(f"health.verdict.{old}")
     reg.gauge(f"health.verdict.{label}").set(report["code"])
     reg.counter("health.assessments").inc()

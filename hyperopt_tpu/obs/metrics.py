@@ -59,6 +59,7 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "LabelLru",
     "MetricsRegistry",
     "registry",
     "metrics_enabled",
@@ -242,6 +243,31 @@ class MetricsRegistry:
             if m is None:
                 m = self._histograms[name] = Histogram(name, self, buckets)
             return m
+
+    # -- removal (label-cardinality control) -----------------------------
+    def remove(self, name: str) -> int:
+        """Drop a series by exact name from all three tables.  Returns
+        how many metrics were removed (0..3).  Handles to a removed
+        metric keep working but mutate an orphan no snapshot sees —
+        the price of get-or-create handles staying lock-free."""
+        with self._lock:
+            n = 0
+            for table in (self._counters, self._gauges, self._histograms):
+                if table.pop(name, None) is not None:
+                    n += 1
+            return n
+
+    def remove_prefix(self, prefix: str) -> int:
+        """Drop every series whose name starts with ``prefix`` (evicting
+        one tenant's whole per-verb family at once).  Returns the count."""
+        with self._lock:
+            n = 0
+            for table in (self._counters, self._gauges, self._histograms):
+                dead = [k for k in table if k.startswith(prefix)]
+                for k in dead:
+                    del table[k]
+                n += len(dead)
+            return n
 
     # -- kernel cache (always-on) ---------------------------------------
     def kernel_cache_event(self, key, hit: bool) -> None:
@@ -435,6 +461,56 @@ def merge_snapshots(snaps) -> dict:
         "gauges": dict(sorted(gauges.items())),
         "histograms": histograms,
     }
+
+
+class LabelLru:
+    """Bounded set of live metric labels with LRU eviction.
+
+    Dynamic-label series (``health.verdict.<store>``, per-tenant verb
+    counters) grow without bound under experiment churn.  Each emitting
+    site keeps one ``LabelLru``; :meth:`touch` marks a label live and
+    returns the labels evicted to stay under ``cap``.  The caller
+    removes the evicted labels' series (``remove`` / ``remove_prefix``)
+    — this class tracks recency only, so it stays usable for both
+    exact-name gauges and per-tenant name prefixes.  Each eviction
+    bumps ``obs.series_evicted``.
+
+    ``cap`` falls back to ``HYPEROPT_TPU_SERIES_LABEL_CAP`` (default
+    256), mirroring the ``HYPEROPT_TPU_RESIDENT_HISTORY_CAP`` pattern.
+    """
+
+    DEFAULT_CAP = 256
+
+    def __init__(self, cap: Optional[int] = None,
+                 reg: Optional[MetricsRegistry] = None):
+        if cap is None:
+            raw = os.environ.get("HYPEROPT_TPU_SERIES_LABEL_CAP", "")
+            try:
+                cap = int(raw) if raw else self.DEFAULT_CAP
+            except ValueError:
+                cap = self.DEFAULT_CAP
+        self.cap = max(1, int(cap))
+        self._reg = reg
+        self._lock = threading.Lock()
+        self._labels: dict = {}   # label -> None, insertion-ordered
+
+    def touch(self, label: str) -> list:
+        """Mark ``label`` most-recently-used; return evicted labels."""
+        with self._lock:
+            self._labels.pop(label, None)
+            self._labels[label] = None
+            evicted = []
+            while len(self._labels) > self.cap:
+                evicted.append(next(iter(self._labels)))
+                del self._labels[evicted[-1]]
+        if evicted:
+            reg = self._reg if self._reg is not None else _REGISTRY
+            reg.counter("obs.series_evicted").inc(len(evicted))
+        return evicted
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._labels)
 
 
 _REGISTRY = MetricsRegistry()

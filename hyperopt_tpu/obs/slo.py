@@ -28,7 +28,9 @@ pipeline and render as instants in Perfetto and in the ALERTS panel of
 ``show live`` — and bump ``slo.alerts.fired`` / ``slo.alerts.resolved``.
 Continuous state is published as ``slo.<name>.firing`` /
 ``slo.<name>.burn_fast`` / ``slo.<name>.burn_slow`` /
-``slo.<name>.value`` gauges.
+``slo.<name>.value`` gauges.  A firing transition additionally pokes
+the flight recorder (``obs.flight.on_slo_fired``) so an armed process
+freezes a postmortem bundle the moment the budget burns.
 
 The declared default specs (``default_slos``) are reconciled against
 the docs/API.md catalog by analyzer rules RD009/RD010.
@@ -40,6 +42,7 @@ import time
 from dataclasses import dataclass
 
 from . import events as _events
+from . import flight as _flight
 from . import metrics as _metrics
 
 __all__ = ["SloSpec", "SloMonitor", "default_slos"]
@@ -145,6 +148,9 @@ class SloMonitor:
                     log.emit("slo_alert", name=spec.name, state="firing",
                              metric=spec.metric, target=spec.target,
                              burn_fast=burn_fast, burn_slow=burn_slow)
+                    _flight.on_slo_fired(spec.name, metric=spec.metric,
+                                         burn_fast=burn_fast,
+                                         burn_slow=burn_slow)
             else:
                 if burn_fast is not None and \
                         burn_fast < spec.burn_threshold:

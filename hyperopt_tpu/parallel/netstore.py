@@ -68,9 +68,12 @@ from urllib.request import Request, urlopen
 from .filestore import FileTrials, FileWorker, _pickler
 from ..base import JOB_STATE_RUNNING, Trials, docs_from_samples
 from ..exceptions import InjectedFault, NetstoreUnavailable, QuotaExceeded
+from ..obs import bundle as _obs_bundle
 from ..obs import context as _context
+from ..obs import costs as _obs_costs
 from ..obs import device as _obs_device
 from ..obs import export as _obs_export
+from ..obs import flight as _flight
 from ..obs import health as _obs_health
 from ..obs import metrics as _metrics
 from ..obs import slo as _obs_slo
@@ -176,6 +179,16 @@ class StoreServer:
         self._health_cache: dict | None = None
         self._scraper: threading.Thread | None = None
         self._scraper_stop = threading.Event()
+        # Bounded per-tenant label set (LRU): tenant churn would
+        # otherwise grow the netstore.tenant.<name>.* families forever.
+        self._tenant_labels = _metrics.LabelLru()
+        # Flight-bundle sections owned by this server: the time-series
+        # window, SLO alert states and cached health verdicts travel in
+        # every postmortem dump while the server lives.
+        _obs_bundle.register_provider("series", self.timeseries.export_series)
+        _obs_bundle.register_provider("slo", self.slo_monitor.status)
+        _obs_bundle.register_provider(
+            "health", lambda: self._health_cache or {})
         self._started = False
         self._closed = False
         self._lifecycle_lock = threading.Lock()
@@ -304,6 +317,8 @@ class StoreServer:
             if self._closed:
                 return
             self._closed = True
+        for section in ("series", "slo", "health"):
+            _obs_bundle.unregister_provider(section)
         self._janitor_stop.set()
         self._scraper_stop.set()
         if self._janitor is not None:
@@ -496,6 +511,11 @@ class StoreServer:
                 if replayed:
                     reg.counter("netstore.idem.hits").inc()
                 return out
+        except Exception as e:
+            # Black-box the failing dispatch before the error surfaces
+            # to the client (one boolean when the recorder is disarmed).
+            _flight.on_crash("dispatch", e)
+            raise
         finally:
             # Per-verb call count + latency histogram: the contention
             # signal for the single-writer lock under many workers.
@@ -504,6 +524,11 @@ class StoreServer:
                 time.perf_counter() - t0)
             if tname is not None:
                 # Per-tenant labels for `show live` and quota forensics.
+                # The live label set is LRU-bounded: an evicted tenant's
+                # whole series family is dropped (recreated from zero on
+                # its next call) and obs.series_evicted counts it.
+                for old in self._tenant_labels.touch(tname):
+                    reg.remove_prefix(f"netstore.tenant.{old}.")
                 reg.counter(
                     f"netstore.tenant.{tname}.verb.{verb}.calls").inc()
                 reg.histogram(
@@ -555,6 +580,12 @@ class StoreServer:
         status = self.slo_monitor.status()
         if status:
             snap["alerts"] = status
+        # Cost-attribution ledger (armed via HYPEROPT_TPU_COSTS): the
+        # service-mode server compiles suggest kernels in-process, so
+        # its ledger rows feed the `cost:` panel of `show live`.
+        costs = _obs_costs.ledger_report(reg=_metrics.registry())
+        if costs.get("entries") or costs.get("armed"):
+            snap["costs"] = costs
         return snap
 
     # -- optimizer health ----------------------------------------------------
@@ -665,6 +696,16 @@ class StoreServer:
             # optimizer-health verdicts.  Never WAL-logged (not in
             # ServiceServer._WAL_VERBS) and never mutates a store.
             return {"health": self._health_verb(req, tenant=tenant)}
+        if verb == "bundle":
+            # Read-only flight pull: the full postmortem payload (events
+            # ring + meta anchor, metrics, provider sections, redacted
+            # env) so an operator lands a remote shard's black box on
+            # local disk (bundle.write_payload) without shelling in.
+            # Never WAL-logged, never touches a store, token-gated like
+            # every verb.
+            return {"bundle": _obs_bundle.collect_payload(
+                "verb", extra={"trigger": "verb",
+                               "tenant": getattr(tenant, "name", None)})}
         with self._lock:
             ft = self._store(req.get("exp_key", "default"), tenant=tenant)
             if verb == "docs":
@@ -1093,6 +1134,18 @@ class NetTrials(Trials):
             kw["all"] = True
         return self._rpc("health", **kw)["health"]
 
+    def bundle(self, out_dir: str | None = None) -> dict:
+        """Pull the server's flight-recorder payload (read-only verb).
+
+        Returns the bundle payload dict; with ``out_dir`` also writes it
+        as an on-disk bundle directory (the exact form a local flight
+        dump produces, so ``show bundle`` / ``show trace --merge``
+        consume it unchanged)."""
+        payload = self._rpc("bundle")["bundle"]
+        if out_dir:
+            _obs_bundle.write_payload(out_dir, payload)
+        return payload
+
     # -- server-side suggest -------------------------------------------------
 
     def suggest(self, seed: int, n: int | None = None, new_ids=None,
@@ -1223,6 +1276,11 @@ def main(argv=None):
                         "loop_events.jsonl (+ chrome trace) here on exit; "
                         "feed several processes' dirs to "
                         "`hyperopt-tpu-show trace --merge`")
+    p.add_argument("--flight-dir", default=None,
+                   help="arm the flight recorder: freeze a postmortem "
+                        "bundle here on SLO alert fire, unhandled verb "
+                        "error or SIGTERM (default: the "
+                        "HYPEROPT_TPU_FLIGHT_DIR env var; unset = off)")
     args = p.parse_args(argv)
 
     if args.serve:
@@ -1252,6 +1310,12 @@ def main(argv=None):
             signal.signal(signal.SIGTERM, _on_sigterm)
         except ValueError:          # not the main thread (embedded use)
             pass
+        # Arm AFTER the SIGTERM handler so the flight handler chains it:
+        # a TERM first freezes the bundle, then the graceful exit runs.
+        flight_dir = _flight.install(args.flight_dir)
+        if flight_dir:
+            print(f"netstore: flight recorder armed -> {flight_dir}",
+                  flush=True)
         try:
             server.serve_forever()
         except (KeyboardInterrupt, SystemExit):

@@ -80,6 +80,7 @@ from .base import (
 from . import faults as _faults
 from .exceptions import AllTrialsFailed, is_transient
 from .obs import context as _context
+from .obs import flight as _flight
 from .obs import metrics as _metrics
 from .obs.events import EVENTS
 from .parallel.pool import CompletionQueueEvaluator
@@ -255,6 +256,7 @@ class PipelinedExecutor:
             finally:
                 ev.shutdown()
         if stop_exc is not None:
+            _flight.on_crash("pipeline", stop_exc)
             raise stop_exc
         if self._fallback:
             return "fallback"

@@ -39,6 +39,8 @@ import time
 
 from .. import faults as _faults
 from ..base import JOB_STATE_RUNNING, coarse_utcnow
+from ..obs import bundle as _obs_bundle
+from ..obs import flight as _flight
 from ..obs import metrics as _metrics
 from ..obs.events import EVENTS
 from ..parallel.netstore import StoreServer
@@ -262,6 +264,15 @@ class ServiceServer(StoreServer):
                          stale_timeout=stale_timeout, tenants=tenants,
                          scrape_interval=scrape_interval, slos=slos)
         self._recover()
+        # Flight-bundle WAL section: tail offsets + a content hash of
+        # the live store state, so a postmortem can be cross-checked
+        # against (and replayed from) the durable log it froze with.
+        _obs_bundle.register_provider("wal", self._wal_bundle_section)
+
+    def _wal_bundle_section(self) -> dict:
+        with self._lock:
+            return {"seq": self._wal.seq, "snap_seq": self._snap_seq,
+                    "state_hash": _obs_bundle.state_hash(self.state_bytes())}
 
     # -- stores are RAM ------------------------------------------------------
 
@@ -479,6 +490,7 @@ class ServiceServer(StoreServer):
 
     def shutdown(self):
         super().shutdown()
+        _obs_bundle.unregister_provider("wal")
         self._wal.close()
 
 
@@ -530,6 +542,11 @@ def main(argv=None):
                         "into the in-process time-series store every S "
                         "seconds and evaluate SLO burn-rate alerts + "
                         "health verdicts (unset: off, zero overhead)")
+    p.add_argument("--flight-dir", default=None,
+                   help="arm the flight recorder: freeze a postmortem "
+                        "bundle here on SLO alert fire, unhandled verb "
+                        "error or SIGTERM (default: the "
+                        "HYPEROPT_TPU_FLIGHT_DIR env var; unset = off)")
     args = p.parse_args(argv)
 
     tenants = None
@@ -556,6 +573,11 @@ def main(argv=None):
         signal.signal(signal.SIGTERM, _on_sigterm)
     except ValueError:              # not the main thread (embedded use)
         pass
+    # Arm AFTER the SIGTERM handler so the flight handler chains it:
+    # a TERM first freezes the bundle, then the graceful exit runs.
+    flight_dir = _flight.install(args.flight_dir)
+    if flight_dir:
+        print(f"service: flight recorder armed -> {flight_dir}", flush=True)
     try:
         server.serve_forever()
     except (KeyboardInterrupt, SystemExit):

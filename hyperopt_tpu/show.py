@@ -12,6 +12,7 @@ Usage::
         -o merged_trace.json                       # fleet Perfetto trace
     python -m hyperopt_tpu.show live http://host:8999 [--token ...]
     python -m hyperopt_tpu.show wal /srv/wal-dir    # WAL/snapshot summary
+    python -m hyperopt_tpu.show bundle /tmp/bundle-123-000-slo  # postmortem
 """
 
 from __future__ import annotations
@@ -121,8 +122,20 @@ def summarize_trace(trace_dir: str, out=None) -> None:
               f"{wall['attributed_s']:.4f}s "
               f"({100.0 * wall['coverage']:.1f}% coverage)", file=out)
     if os.path.exists(events_path):
-        n_events = sum(1 for _ in open(events_path))
-        print(f"events: {n_events} in loop_events.jsonl", file=out)
+        n_events, n_dropped = 0, 0
+        with open(events_path) as fh:
+            for line in fh:
+                n_events += 1
+                if n_events == 1:
+                    try:
+                        head = json.loads(line)
+                    except ValueError:
+                        head = {}
+                    if isinstance(head, dict) and head.get("type") == "meta":
+                        n_dropped = int(head.get("n_dropped") or 0)
+        dropped = (f" ({n_dropped} displaced at the ring)"
+                   if n_dropped else "")
+        print(f"events: {n_events} in loop_events.jsonl{dropped}", file=out)
     chrome = os.path.join(trace_dir, "chrome_trace.json")
     if os.path.exists(chrome):
         print(f"chrome trace: {chrome} (load in Perfetto / "
@@ -425,7 +438,63 @@ def render_live(snap: dict, out=None, prev=None) -> dict:
                   f"{fmt_b(st.get('burn_fast')):>9s} "
                   f"{fmt_b(st.get('burn_slow')):>9s} "
                   f"{val_s:>10s} {st.get('target'):>10}", file=out)
+
+    # COST: the per-kernel cost ledger (snap["costs"], populated when the
+    # serving process runs with HYPEROPT_TPU_COSTS=1) — compile wall time
+    # + XLA flops/bytes per program, joined with live dispatch ms.
+    _render_cost_panel(snap.get("costs"), counters, out)
     return (now, done)
+
+
+def _cost_ledger_rows(costs: dict):
+    """Format a cost ledger's ``entries`` into (header, rows) table lines."""
+    header = (f"  {'kernel':<7s} {'n_cap':>6s} {'P':>4s} {'m':>5s} "
+              f"{'compile_s':>9s} {'Mflops':>8s} {'MiB':>8s} "
+              f"{'disp':>6s} {'ms/sugg':>8s}")
+    dash = lambda v, w: f"{v:>{w}}" if v is not None else f"{'-':>{w}}"  # noqa: E731
+    rows = []
+    for e in costs.get("entries") or []:
+        cs = e.get("compile_s")
+        fl = e.get("flops")
+        ba = e.get("bytes_accessed")
+        mps = e.get("ms_per_suggestion")
+        rows.append(
+            f"  {e.get('kernel', '?'):<7s} {dash(e.get('n_cap'), 6)} "
+            f"{dash(e.get('P'), 4)} {dash(e.get('m'), 5)} "
+            f"{dash(None if cs is None else f'{cs:.3f}', 9)} "
+            f"{dash(None if fl is None else f'{fl / 1e6:.2f}', 8)} "
+            f"{dash(None if ba is None else f'{ba / 2**20:.2f}', 8)} "
+            f"{int(e.get('dispatches', 0)):>6d} "
+            f"{dash(None if mps is None else f'{mps:.3f}', 8)}")
+    return header, rows
+
+
+def _render_cost_panel(costs, counters, out) -> None:
+    """The ``cost:`` dashboard panel — shared by ``live`` and ``bundle``."""
+    costs = costs or {}
+    header, rows = _cost_ledger_rows(costs)
+    if rows:
+        kc = costs.get("kernel_cache", {})
+        print(f"cost:    {len(rows)} ledger entr(ies)   kernel-cache "
+              f"{int(kc.get('requests', 0))} req / "
+              f"{int(kc.get('misses', 0))} miss", file=out)
+        print(header, file=out)
+        for row in rows:
+            print(row, file=out)
+        live_ms = costs.get("live_ms") or {}
+        for name in sorted(live_ms):
+            h = live_ms[name]
+            mean = h.get("mean")
+            p95 = h.get("p95")
+            print(f"  {name:<28s} {int(h.get('count', 0)):>7d} calls  "
+                  f"mean {mean if mean is None else f'{mean:.2f}'}ms  "
+                  f"p95 {p95 if p95 is None else f'{p95:.2f}'}ms", file=out)
+    elif counters.get("cost.compiles"):
+        # The recorder is armed somewhere in the fleet but this process
+        # holds no ledger rows (compiles happened in another process).
+        print(f"cost:    {int(counters['cost.compiles'])} compile(s) "
+              f"recorded elsewhere in the fleet (no local ledger rows)",
+              file=out)
 
 
 def live(url: str, token=None, interval: float = 2.0, once: bool = False,
@@ -496,6 +565,72 @@ def show_wal(wal_dir: str, as_json: bool = False, out=None) -> int:
     if info["torn_tail"]:
         print(f"torn tail: {info['torn_tail']} line(s) dropped "
               "(crash mid-append; the verb was never acked)", file=out)
+    return 0
+
+
+# -- flight-recorder bundles -------------------------------------------------
+
+def show_bundle(bundle_dir: str, out=None) -> int:
+    """Render a flight-recorder postmortem bundle directory
+    (:mod:`hyperopt_tpu.obs.bundle`): manifest, event-ring coverage,
+    section inventory, SLO/health verdicts, WAL anchor and the
+    per-kernel cost ledger."""
+    out = out if out is not None else sys.stdout
+    from .obs import bundle as _bundle
+
+    try:
+        payload = _bundle.read_bundle(bundle_dir)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=out)
+        return 1
+    man = payload.get("manifest") or {}
+    print(f"bundle: {bundle_dir}", file=out)
+    print(f"  schema {man.get('schema')}   reason {man.get('reason')!r}   "
+          f"pid {man.get('pid')}   host {man.get('host')}", file=out)
+    if man.get("trace_id"):
+        print(f"  trace_id {man['trace_id']}  (splice into a fleet trace: "
+              f"`show trace --merge {bundle_dir} <other dirs...>`)", file=out)
+    print(f"  events: {man.get('n_events', 0)} captured, "
+          f"{man.get('n_emitted', 0)} emitted, "
+          f"{man.get('n_dropped', 0)} displaced at the ring", file=out)
+    if man.get("extra"):
+        print(f"  extra: {man['extra']}", file=out)
+    print(f"  sections: {', '.join(man.get('sections') or [])}", file=out)
+
+    # Event-type census of the captured ring (meta header excluded).
+    types = Counter(rec.get("type") for rec in payload.get("events") or []
+                    if rec.get("type") not in (None, "meta"))
+    if types:
+        census = "  ".join(f"{t}:{n}" for t, n in types.most_common(8))
+        print(f"  ring: {census}", file=out)
+
+    slo = payload.get("slo")
+    if isinstance(slo, list) and slo:
+        firing = [st for st in slo if isinstance(st, dict)
+                  and st.get("firing")]
+        print(f"slo: {len(slo)} spec(s), {len(firing)} firing"
+              + (" — " + ", ".join(st.get("name", "?") for st in firing)
+                 if firing else ""), file=out)
+    health = payload.get("health")
+    if isinstance(health, dict) and health and "error" not in health:
+        verdicts = Counter((rep or {}).get("verdict", "?")
+                           for rep in health.values())
+        print("health: " + "  ".join(f"{v}:{n}" for v, n in
+                                     sorted(verdicts.items())), file=out)
+    wal = payload.get("wal")
+    if isinstance(wal, dict) and "error" not in wal:
+        print(f"wal: seq {wal.get('seq')}  snap_seq {wal.get('snap_seq')}  "
+              f"state_hash {wal.get('state_hash')}", file=out)
+    env = payload.get("env")
+    if isinstance(env, dict):
+        n_red = sum(1 for v in env.values() if v == "<redacted>")
+        print(f"env: {len(env)} key(s) captured"
+              + (f", {n_red} redacted" if n_red else ""), file=out)
+
+    costs = payload.get("costs")
+    if isinstance(costs, dict) and "error" not in costs:
+        counters = ((payload.get("metrics") or {}).get("counters") or {})
+        _render_cost_panel(costs, counters, out)
     return 0
 
 
@@ -570,6 +705,17 @@ def main(argv=None):
                         help="emit the raw inspect() dict")
         wargs = wp.parse_args(argv[1:])
         return show_wal(wargs.wal_dir, as_json=wargs.json)
+
+    if argv and argv[0] == "bundle":
+        bp = argparse.ArgumentParser(prog="hyperopt-tpu-show bundle",
+                                     description="render a flight-recorder "
+                                                 "postmortem bundle "
+                                                 "directory")
+        bp.add_argument("bundle_dir", help="bundle directory (a flight-"
+                                           "recorder dump or a NetTrials"
+                                           ".bundle(out_dir=...) pull)")
+        bargs = bp.parse_args(argv[1:])
+        return show_bundle(bargs.bundle_dir)
 
     if argv and argv[0] == "live":
         lp = argparse.ArgumentParser(prog="hyperopt-tpu-show live",

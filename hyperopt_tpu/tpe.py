@@ -61,6 +61,7 @@ from .ops import (
 )
 from .ops.gmm import onehot_lookup
 from .obs import kernel_cache_event
+from .obs import costs as _costs
 from .obs.metrics import registry as _metrics_registry
 from .space import (
     CATEGORICAL,
@@ -940,11 +941,30 @@ class _TpeKernel:
             hit = tier in seen
             seen.add(tier)
         kernel_cache_event(tier, hit)
-        return self._fleet_fn(m)(
+        if not hit:
+            # Armed-only AOT recompile of the tier's vmapped program for
+            # the cost ledger (compile wall time + XLA cost analysis);
+            # disarmed this is one boolean inside record_compile.
+            def _lower(b=b, m=m):
+                f32 = jnp.float32
+                sd = jax.ShapeDtypeStruct
+                nc, p = self.n_cap, self.cs.n_params
+                return self._fleet_fn(m).lower(
+                    sd((b,), jnp.uint32), sd((b,), jnp.int32),
+                    sd((b, nc, p), f32), sd((b, nc, p), jnp.bool_),
+                    sd((b, nc), f32), sd((b, nc), jnp.bool_),
+                    sd((b,), f32), sd((b,), f32)).compile()
+            _costs.record_compile("fleet", tier, _lower, n_cap=self.n_cap,
+                                  P=self.cs.n_params, m=m, tier=b)
+        t0 = perf_counter() if _costs.armed() else None
+        out = self._fleet_fn(m)(
             np.asarray(seeds, np.uint32), np.asarray(n_rows, np.int32),
             hv, ha, hl, hok,
             np.asarray(gamma, np.float32),
             np.asarray(prior_weight, np.float32))
+        if t0 is not None:
+            _costs.observe_dispatch(tier, (perf_counter() - t0) * 1e3)
+        return out
 
 
 # ---------------------------------------------------------------------------
@@ -1041,7 +1061,23 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
             cache[k] = _TpeKernel(cs, n_cap, n_cand, lf, split,
                                   multivariate, cat_prior)
     kernel_cache_event(k, hit)
-    return cache[k]
+    kern = cache[k]
+    kern._cost_key = k   # dispatch-ms attribution joins on this key
+    if not hit:
+        # Armed-only AOT compile of the single-proposal seeded entry
+        # (same shape recipe as _prewarm_async) feeding the cost ledger.
+        def _lower(kern=kern):
+            f32 = jnp.float32
+            sd = jax.ShapeDtypeStruct
+            nc, p = kern.n_cap, kern.cs.n_params
+            return kern._fn_seeded.lower(
+                sd((), jnp.uint32),
+                sd((nc, p), f32), sd((nc, p), jnp.bool_),
+                sd((nc,), f32), sd((nc,), jnp.bool_),
+                sd((), f32), sd((), f32)).compile()
+        _costs.record_compile("tpe", k, _lower, n_cap=n_cap,
+                              P=cs.n_params, m=1)
+    return kern
 
 
 def _padded_history(h, n_cap):
@@ -1296,7 +1332,9 @@ def suggest_dispatch(new_ids, domain, trials, seed,
         # too so the last trial doesn't pay a compile stall (round-3
         # advisor finding).
         _prewarm_async(kern, n=1)
-    _obs_ms(reg, "suggest.dispatch_ms", (perf_counter() - t_disp) * 1e3)
+    dms = (perf_counter() - t_disp) * 1e3
+    _obs_ms(reg, "suggest.dispatch_ms", dms)
+    _costs.observe_dispatch(getattr(kern, "_cost_key", None), dms)
     return ("pending", cs, list(new_ids), arrs, exp_key)
 
 

@@ -270,6 +270,16 @@ class TestBenchmarkArtifacts:
             assert doc["headline"]["disabled_within_200ns"] is True, (
                 f"{name}: metric hot path's disabled arm broke its "
                 "200ns/op budget")
+            # r12: the disarmed flight-recorder / cost-ledger hooks live
+            # on the same module-global-boolean budget
+            fc = doc["flight_cost_disabled"]
+            for k in ("flight_on_crash_ns", "costs_observe_dispatch_ns",
+                      "costs_record_compile_ns", "faults_maybe_fail_ns"):
+                assert fc[k] > 0, f"{name}: missing {k}"
+            assert doc["headline"][
+                "flight_cost_disabled_within_200ns"] is True, (
+                f"{name}: a disarmed flight/cost hook broke its "
+                f"200ns/op budget ({fc})")
 
     def test_merged_trace_artifact_schema(self):
         """ISSUE r6 acceptance artifact: the 2-process chaos run's merged
@@ -395,6 +405,58 @@ class TestBenchmarkArtifacts:
             assert n_win >= 4, (
                 f"{name}: GP-EI only beats rand on {n_win}/"
                 f"{len(doc['rows'])} domains — below the 4/5 acceptance bar")
+
+    def test_flight_bundle_on_disk_schema(self, tmp_path):
+        """r12 bundle contract: a freshly written flight bundle carries
+        the manifest header, a `--merge`-compatible event file with its
+        meta clock anchor, one file per manifest section, and a
+        token-redacted env snapshot."""
+        import os as _os
+
+        from hyperopt_tpu.obs import bundle as _bundle
+        from hyperopt_tpu.obs.events import EVENTS
+
+        EVENTS.enable()
+        EVENTS.emit("loop_start")
+        _os.environ["HYPEROPT_TPU_NETSTORE_TOKEN"] = "hunter2"
+        try:
+            bdir = _bundle.write_bundle(str(tmp_path / "b"), "schema-guard")
+        finally:
+            _os.environ.pop("HYPEROPT_TPU_NETSTORE_TOKEN", None)
+            EVENTS.disable()
+            EVENTS.clear()
+        with open(_os.path.join(bdir, "MANIFEST.json")) as fh:
+            man = json.load(fh)
+        assert man["schema"] == _bundle.BUNDLE_SCHEMA == 1
+        assert man["reason"] == "schema-guard"
+        for key in ("pid", "host", "n_events", "n_emitted", "n_dropped",
+                    "sections", "extra"):
+            assert key in man, key
+        assert man["n_events"] >= 1
+        assert man["n_dropped"] >= 0
+        # one file per section; events ride loop_events.jsonl
+        for sec in man["sections"]:
+            fname = ("loop_events.jsonl" if sec == "events"
+                     else f"{sec}.json")
+            assert _os.path.exists(_os.path.join(bdir, fname)), sec
+        assert {"events", "metrics", "env", "device",
+                "costs"} <= set(man["sections"])
+        # the event file's first record is the meta clock anchor the
+        # trace merger requires ({wall0, mono0}), tallying displacement
+        with open(_os.path.join(bdir, "loop_events.jsonl")) as fh:
+            head = json.loads(fh.readline())
+        assert head["type"] == "meta"
+        assert head["wall0"] is not None and head["mono0"] is not None
+        assert "n_dropped" in head
+        # token-bearing env values never reach disk
+        with open(_os.path.join(bdir, "env.json")) as fh:
+            env = json.load(fh)
+        assert env["HYPEROPT_TPU_NETSTORE_TOKEN"] == "<redacted>"
+        assert "hunter2" not in json.dumps(env)
+        # round trip through the reader used by `show bundle`
+        payload = _bundle.read_bundle(bdir)
+        assert payload["manifest"]["schema"] == 1
+        assert payload["events"][0]["type"] == "meta"
 
     def test_device_ab_artifact_matches_its_bench(self):
         # the r6 device A/B (5 domains x 20 seeds, one conditional space)

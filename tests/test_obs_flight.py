@@ -1,0 +1,477 @@
+"""Flight recorder, postmortem bundles & per-kernel cost attribution
+(ISSUE r12): the always-on black box (`obs/flight.py`), self-contained
+bundle directories (`obs/bundle.py`), the cost ledger (`obs/costs.py`),
+label-cardinality caps (`LabelLru`), and their CLI/verb surfaces.
+
+The areas pinned here: arm/dump/rate-limit/uninstall semantics and the
+never-raise contract (including the `flight.dump` fault point), the
+chaos acceptance path (a faults schedule trips an SLO alert, then kills
+the driver with an unhandled transient — both leave bundles that
+`show bundle` renders and `show trace --merge` splices by trace id),
+cost recording + the ledger join against kernel-cache counters and a
+real `fmin` run, LRU eviction of `health.verdict.<store>` gauges and
+per-tenant series with the `obs.series_evicted` counter, the read-only
+`bundle` verb over HTTP, event-ring displacement tallies, and `show
+live` rendering against empty/partial stores.
+"""
+
+import io
+import json
+import os
+import signal
+
+import pytest
+
+from functools import partial
+
+from hyperopt_tpu import faults, fmin, hp, show, tpe
+from hyperopt_tpu.exceptions import InjectedFault
+from hyperopt_tpu.obs import bundle, costs, flight, health
+from hyperopt_tpu.obs.events import EVENTS, EventLog
+from hyperopt_tpu.obs.metrics import (
+    LabelLru,
+    MetricsRegistry,
+    kernel_cache_stats,
+    registry,
+)
+from hyperopt_tpu.obs.slo import SloMonitor, SloSpec
+from hyperopt_tpu.obs.timeseries import TimeSeriesStore
+
+T0 = 1_000_000.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_flight_state():
+    """Every test starts and ends with the recorder disarmed, the cost
+    ledger empty, the fault registry clear, and the ring quiet."""
+    flight.uninstall()
+    costs.disarm()
+    costs.clear()
+    faults.clear()
+    EVENTS.disable()
+    EVENTS.clear()
+    yield
+    flight.uninstall()
+    costs.disarm()
+    costs.clear()
+    faults.clear()
+    EVENTS.disable()
+    EVENTS.clear()
+
+
+def _space():
+    return {"x": hp.uniform("x", -1, 1)}
+
+
+# ---------------------------------------------------------------------------
+# flight recorder core
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_install_without_dir_is_noop(self, monkeypatch):
+        monkeypatch.delenv("HYPEROPT_TPU_FLIGHT_DIR", raising=False)
+        assert flight.install() is None
+        assert not flight.armed()
+        assert flight.dump("x", force=True) is None
+
+    def test_install_dump_uninstall(self, tmp_path):
+        d = flight.install(str(tmp_path), sigterm=False)
+        assert d == str(tmp_path) and flight.armed()
+        assert EVENTS.enabled          # black box arms the ring
+        EVENTS.emit("loop_start")
+        path = flight.dump("unit test!", force=True, extra={"k": 1})
+        assert path is not None and os.path.isdir(path)
+        name = os.path.basename(path)
+        assert name.startswith(f"bundle-{os.getpid()}-001-")
+        assert "!" not in name         # reason slug is sanitized
+        payload = bundle.read_bundle(path)
+        assert payload["manifest"]["reason"] == "unit test!"
+        assert payload["manifest"]["extra"] == {"k": 1}
+        # the dump trigger itself is in the very bundle it produced
+        assert any(e.get("type") == "flight_dump"
+                   for e in payload["events"])
+        flight.uninstall()
+        assert not flight.armed()
+        assert flight.dump("after", force=True) is None
+
+    def test_env_dir_arms(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_FLIGHT_DIR", str(tmp_path))
+        assert flight.install(sigterm=False) == str(tmp_path)
+        assert flight.armed()
+
+    def test_rate_limit_suppresses_then_force_bypasses(self, tmp_path):
+        flight.install(str(tmp_path), sigterm=False, min_interval_s=3600)
+        reg = registry()
+        base = reg.snapshot()["counters"].get("flight.suppressed", 0)
+        assert flight.dump("first") is not None
+        assert flight.dump("second") is None        # inside the window
+        assert reg.snapshot()["counters"]["flight.suppressed"] == base + 1
+        assert flight.dump("third", force=True) is not None
+
+    def test_dump_never_raises(self, tmp_path):
+        flight.install(str(tmp_path), sigterm=False)
+        reg = registry()
+        base = reg.snapshot()["counters"].get("flight.errors", 0)
+        with faults.injected("flight.dump", prob=1.0):
+            assert flight.dump("chaos", force=True) is None
+        assert reg.snapshot()["counters"]["flight.errors"] == base + 1
+        # the recorder recovers once the fault clears
+        assert flight.dump("after", force=True) is not None
+
+    def test_on_crash_skips_operator_intent(self, tmp_path):
+        flight.install(str(tmp_path), sigterm=False)
+        flight.on_crash("site", KeyboardInterrupt())
+        flight.on_crash("site", SystemExit(0))
+        assert not any(p.startswith("bundle-")
+                       for p in os.listdir(tmp_path))
+        flight.on_crash("site", RuntimeError("boom"))
+        (bdir,) = [p for p in os.listdir(tmp_path)
+                   if p.startswith("bundle-")]
+        man = bundle.read_bundle(str(tmp_path / bdir))["manifest"]
+        assert man["extra"]["trigger"] == "crash"
+        assert "RuntimeError" in man["extra"]["error"]
+
+    def test_sigterm_chains_previous_handler(self, tmp_path):
+        hits = []
+        prev = signal.signal(signal.SIGTERM, lambda s, f: hits.append(s))
+        try:
+            flight.install(str(tmp_path), sigterm=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert hits == [signal.SIGTERM]   # chained, not swallowed
+            assert any(p.startswith("bundle-")
+                       for p in os.listdir(tmp_path))
+            flight.uninstall()                # restores the previous one
+            assert signal.getsignal(signal.SIGTERM) is not flight._on_sigterm
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: SLO trip + unhandled transient -> bundles -> surfaces
+# ---------------------------------------------------------------------------
+
+
+class TestChaosAcceptance:
+    def test_slo_fire_triggers_dump(self, tmp_path):
+        flight.install(str(tmp_path), sigterm=False)
+        reg = MetricsRegistry(enabled=True)
+        ts = TimeSeriesStore(reg)
+        spec = SloSpec("suggest_p95", metric="netstore.verb.suggest.s",
+                       kind="latency_p95", target=0.25, budget=0.25,
+                       fast_window=10, slow_window=60)
+        mon = SloMonitor((spec,), ts, reg=reg, events=EVENTS)
+        h = reg.histogram("netstore.verb.suggest.s")
+        for _ in range(8):
+            h.observe(1.0)               # every sample breaches
+        ts.scrape(now=T0 + 20)
+        (st,) = mon.evaluate(now=T0 + 20)
+        assert st["firing"] is True
+        bundles = [p for p in os.listdir(tmp_path)
+                   if p.startswith("bundle-")]
+        assert len(bundles) == 1
+        man = bundle.read_bundle(str(tmp_path / bundles[0]))["manifest"]
+        assert man["reason"] == "slo-suggest_p95"
+        assert man["extra"]["trigger"] == "slo_alert"
+
+    def test_faults_kill_fmin_leaves_renderable_spliceable_bundle(
+            self, tmp_path, monkeypatch):
+        """The ISSUE chaos run: a faults.py schedule kills the driver
+        with an unhandled transient mid-fmin; the flight recorder leaves
+        a bundle that `show bundle` renders and `show trace --merge`
+        splices into a fleet trace by its meta clock anchor."""
+        monkeypatch.setenv("HYPEROPT_TPU_FLIGHT_DIR", str(tmp_path))
+        costs.arm()                       # the bundle carries the ledger
+        algo = partial(tpe.suggest, n_startup_jobs=2)
+        with faults.injected("objective.call", prob=1.0, after=4):
+            with pytest.raises(InjectedFault):
+                fmin(lambda p: p["x"] ** 2, _space(), algo=algo,
+                     max_evals=8, rstate=7, show_progressbar=False)
+        bundles = [p for p in os.listdir(tmp_path)
+                   if p.startswith("bundle-")]
+        assert len(bundles) == 1
+        bdir = str(tmp_path / bundles[0])
+        payload = bundle.read_bundle(bdir)
+        man = payload["manifest"]
+        assert man["reason"] == "crash-fmin"
+        assert "InjectedFault" in man["extra"]["error"]
+        assert man["n_events"] > 0
+        # the ring caught the fault event and real trial activity
+        types = {e.get("type") for e in payload["events"]}
+        assert "fault_injected" in types and "trial_queued" in types
+        assert "flight_dump" in types
+        # cost ledger rode along with the solo TPE kernel's row
+        kernels = {e["kernel"] for e in payload["costs"]["entries"]}
+        assert "tpe" in kernels
+
+        # surface 1: `show bundle` renders it
+        buf = io.StringIO()
+        assert show.show_bundle(bdir, out=buf) == 0
+        text = buf.getvalue()
+        assert "crash-fmin" in text and "fault_injected" in text
+        assert "cost:" in text and "tpe" in text
+
+        # surface 2: the merger accepts the bundle dir as a lane (its
+        # loop_events.jsonl carries the {wall0, mono0} meta anchor)
+        buf = io.StringIO()
+        doc = show.merge_traces([bdir], out=buf)
+        assert doc["otherData"]["n_lanes"] == 1
+        assert "missing" not in buf.getvalue()
+        names = {e.get("name") for e in doc["traceEvents"]}
+        assert "objective.call" in names      # the injected fault
+        assert "crash-fmin" in names          # the dump trigger itself
+
+
+# ---------------------------------------------------------------------------
+# cost attribution
+# ---------------------------------------------------------------------------
+
+
+class TestCostLedger:
+    def test_disarmed_hooks_are_noops(self):
+        assert costs.record_compile("tpe", ("k",), lambda: 1 / 0,
+                                    n_cap=8, P=1, m=1) is None
+        costs.observe_dispatch(("k",), 1.0)
+        rep = costs.ledger_report()
+        assert rep["entries"] == [] and rep["armed"] is False
+
+    def test_record_and_join(self):
+        costs.arm()
+        import jax
+
+        fn = jax.jit(lambda x: x * 2.0)
+        entry = costs.record_compile(
+            "tpe", (8, 1), lambda: fn.lower(1.0).compile(),
+            n_cap=8, P=1, m=4)
+        assert entry is not None and entry["compile_s"] > 0
+        costs.observe_dispatch((8, 1), 2.0)
+        costs.observe_dispatch((8, 1), 4.0)
+        rep = costs.ledger_report()
+        (row,) = rep["entries"]
+        assert row["kernel"] == "tpe" and row["key"] == repr((8, 1))
+        assert row["dispatches"] == 2
+        assert row["dispatch_ms_mean"] == pytest.approx(3.0)
+        assert row["dispatch_ms_min"] == 2.0
+        assert row["dispatch_ms_max"] == 4.0
+        # m=4 proposals per dispatch
+        assert row["ms_per_suggestion"] == pytest.approx(0.75)
+        if row.get("bytes_accessed") is not None:
+            assert row["bytes_per_suggestion"] == \
+                row["bytes_accessed"] / 4
+
+    def test_failed_lower_is_contained(self):
+        costs.arm()
+        reg = registry()
+        base = reg.snapshot()["counters"].get("cost.errors", 0)
+        assert costs.record_compile("tpe", ("bad",), lambda: 1 / 0,
+                                    n_cap=8, P=1, m=1) is None
+        assert reg.snapshot()["counters"]["cost.errors"] == base + 1
+        assert costs.ledger_report()["entries"] == []
+
+    def test_fmin_populates_ledger_with_live_join(self):
+        """End to end: an armed cost recorder attributes the solo TPE
+        kernel's compile + live dispatches from a real fmin run, joined
+        with the kernel-cache request counters."""
+        costs.arm()
+        # A space of its own: compiled spaces (and their kernel caches)
+        # are shared across fmin calls, so reusing _space() here could
+        # hit a kernel another test already compiled — and a cache hit
+        # records nothing.
+        space = {"xl": hp.uniform("xl", -2.0, 2.0)}
+        fmin(lambda p: p["xl"] ** 2, space,
+             algo=partial(tpe.suggest, n_startup_jobs=2),
+             max_evals=6, rstate=3, show_progressbar=False)
+        rep = costs.ledger_report()
+        rows = [e for e in rep["entries"] if e["kernel"] == "tpe"]
+        assert rows, rep
+        row = rows[0]
+        assert row["compile_s"] > 0
+        assert row["m"] == 1 and row["P"] == 1
+        assert row["dispatches"] >= 1
+        assert row["ms_per_suggestion"] > 0
+        # joined with the always-on kernel-cache counters: the same key
+        kc = kernel_cache_stats()["by_key"].get(row["key"])
+        assert kc is not None and kc["requests"] >= row["dispatches"]
+        assert rep["live_ms"], "family histograms missing from the join"
+
+
+# ---------------------------------------------------------------------------
+# label-cardinality caps (satellite: LabelLru + obs.series_evicted)
+# ---------------------------------------------------------------------------
+
+
+class TestLabelLru:
+    def test_touch_evicts_lru_and_counts(self):
+        reg = MetricsRegistry(enabled=True)
+        lru = LabelLru(cap=2, reg=reg)
+        assert lru.touch("a") == []
+        assert lru.touch("b") == []
+        assert lru.touch("a") == []       # refreshed: b is now oldest
+        assert lru.touch("c") == ["b"]
+        assert len(lru) == 2
+        assert reg.snapshot()["counters"]["obs.series_evicted"] == 1
+
+    def test_cap_from_env(self, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_SERIES_LABEL_CAP", "3")
+        assert LabelLru().cap == 3
+        monkeypatch.setenv("HYPEROPT_TPU_SERIES_LABEL_CAP", "bogus")
+        assert LabelLru().cap == LabelLru.DEFAULT_CAP
+
+    def test_registry_remove_and_remove_prefix(self):
+        reg = MetricsRegistry(enabled=True)
+        reg.counter("t.a.calls").inc()
+        reg.gauge("t.a.held").set(1.0)
+        reg.histogram("t.a.s").observe(0.1)
+        reg.counter("t.b.calls").inc()
+        assert reg.remove("t.a.calls") == 1
+        assert reg.remove_prefix("t.a.") == 2
+        snap = reg.snapshot()
+        assert not any(k.startswith("t.a.") for k in snap["counters"])
+        assert "t.b.calls" in snap["counters"]
+
+    def test_health_verdict_gauges_are_bounded(self, monkeypatch):
+        monkeypatch.setattr(health, "_VERDICT_LABELS",
+                            LabelLru(cap=2, reg=MetricsRegistry(True)))
+        reg = MetricsRegistry(enabled=True)
+        rep = {"verdict": "healthy", "code": 0}
+        for label in ("s1", "s2", "s3"):
+            health.publish(label, rep, reg=reg)
+        gauges = reg.snapshot()["gauges"]
+        live = {k for k in gauges if k.startswith("health.verdict.")}
+        assert live == {"health.verdict.s2", "health.verdict.s3"}
+        # an evicted store's verdict republishes on its next assessment
+        health.publish("s1", rep, reg=reg)
+        assert "health.verdict.s1" in reg.snapshot()["gauges"]
+
+
+# ---------------------------------------------------------------------------
+# event-ring displacement tally (satellite: dropped-events counter)
+# ---------------------------------------------------------------------------
+
+
+class TestRingDisplacement:
+    def test_overflow_tallies_and_surfaces(self, tmp_path):
+        log = EventLog(capacity=4)
+        log.enable()
+        for i in range(7):
+            log.emit("loop_start", i=i)
+        assert log.n_emitted == 7
+        assert log.n_dropped == 3
+        assert len(log) == 4
+        path = tmp_path / "loop_events.jsonl"
+        log.dump_jsonl(path)
+        head = json.loads(open(path).readline())
+        assert head["type"] == "meta"
+        assert head["n_dropped"] == 3 and head["n_emitted"] == 7
+        # `show trace` surfaces the displacement
+        buf = io.StringIO()
+        show.summarize_trace(str(tmp_path), out=buf)
+        assert "(3 displaced at the ring)" in buf.getvalue()
+        log.clear()
+        assert log.n_dropped == 0 == log.n_emitted
+
+    def test_bundle_manifest_carries_tally(self, tmp_path):
+        log_cap = EVENTS.capacity
+        EVENTS.enable()
+        for i in range(log_cap + 5):
+            EVENTS.emit("loop_start", i=i)
+        payload = bundle.collect_payload("tally")
+        assert payload["manifest"]["n_dropped"] == 5
+        assert payload["events"][0]["n_dropped"] == 5
+
+
+# ---------------------------------------------------------------------------
+# the read-only `bundle` verb over HTTP
+# ---------------------------------------------------------------------------
+
+
+class TestBundleVerb:
+    def test_pull_render_and_redaction(self, tmp_path, monkeypatch):
+        from hyperopt_tpu.parallel import NetTrials, StoreServer
+
+        monkeypatch.setenv("HYPEROPT_TPU_NETSTORE_TOKEN", "")
+        srv = StoreServer(str(tmp_path / "store"), token="s3kr1t")
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="s3kr1t")
+            out_dir = str(tmp_path / "pulled")
+            payload = nt.bundle(out_dir=out_dir)
+            assert payload["manifest"]["reason"] == "verb"
+            assert payload["manifest"]["extra"]["trigger"] == "verb"
+            # server-owned sections came from the registered providers
+            assert "series" in payload and "slo" in payload
+            # the on-disk form is a first-class bundle
+            buf = io.StringIO()
+            assert show.show_bundle(out_dir, out=buf) == 0
+            assert "'verb'" in buf.getvalue()
+            # wrong token is refused (the verb is token-gated like every
+            # other; the client's eager refresh already trips the auth)
+            with pytest.raises(Exception):
+                bad = NetTrials(srv.url, exp_key="e1", token="wrong")
+                bad.bundle()
+        finally:
+            srv.shutdown()
+
+    def test_env_snapshot_redacts_tokens(self, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_NETSTORE_TOKEN", "hunter2")
+        monkeypatch.setenv("HYPEROPT_TPU_PRNG", "threefry")
+        payload = bundle.collect_payload("redact")
+        env = payload["env"]
+        assert env["HYPEROPT_TPU_NETSTORE_TOKEN"] == "<redacted>"
+        assert env["HYPEROPT_TPU_PRNG"] == "threefry"
+        assert "hunter2" not in json.dumps(payload["env"])
+
+
+# ---------------------------------------------------------------------------
+# `show live` against empty / partial stores (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestShowLivePartialStores:
+    def test_empty_snapshot_renders(self):
+        buf = io.StringIO()
+        prev = show.render_live({}, out=buf)
+        text = buf.getvalue()
+        assert "fleet: 0 worker(s)" in text
+        assert "trials done 0" in text
+        # nothing optional leaked into the frame
+        for absent in ("health:", "alerts:", "cohorts:", "cost:",
+                       "workers:", "pipeline:"):
+            assert absent not in text
+        assert prev[1] == 0
+
+    def test_partial_snapshot_counters_only(self):
+        snap = {"counters": {"fmin.trials.done": 5,
+                             "faults.injected": 2},
+                "gauges": {}, "histograms": {}}
+        buf = io.StringIO()
+        now_done = show.render_live(snap, out=buf)
+        text = buf.getvalue()
+        assert "trials done 5" in text
+        assert "faults injected 2" in text
+        assert "health:" not in text and "alerts:" not in text
+        # a second frame derives a rate from the previous sample
+        buf2 = io.StringIO()
+        snap["counters"]["fmin.trials.done"] = 9
+        show.render_live(snap, out=buf2,
+                         prev=(now_done[0] - 2.0, now_done[1]))
+        assert "trials/s" in buf2.getvalue()
+
+    def test_alerts_without_health_or_cohorts(self):
+        snap = {"counters": {}, "gauges": {}, "histograms": {},
+                "alerts": [{"name": "suggest_p95", "firing": True,
+                            "burn_fast": 4.0, "burn_slow": 2.2,
+                            "value": 0.9, "target": 0.25}]}
+        buf = io.StringIO()
+        show.render_live(snap, out=buf)
+        text = buf.getvalue()
+        assert "FIRING" in text and "suggest_p95" in text
+        assert "health:" not in text and "cohorts:" not in text
+
+    def test_cost_panel_fallback_without_ledger(self):
+        snap = {"counters": {"cost.compiles": 3}, "gauges": {},
+                "histograms": {}}
+        buf = io.StringIO()
+        show.render_live(snap, out=buf)
+        assert "cost:    3 compile(s) recorded elsewhere" \
+            in buf.getvalue()

@@ -1,0 +1,448 @@
+"""Shard-fleet load: 10k open-loop workers, 4 shards, kill-and-promote.
+
+The fleet acceptance harness for the sharded service: an in-process
+fleet of 4 :class:`~hyperopt_tpu.service.replica.ShardServer` primaries
+(each with a warm WAL-shipped replica) behind one
+:class:`~hyperopt_tpu.service.router.Router` is driven by
+
+* **10 000 simulated workers** — one distinct owner identity per trial,
+  spread over 16 ``exp_key`` stores that the pinned consistent-hash
+  ring places across the 4 shards.  Identities are multiplexed over a
+  small OS-thread pool; each completes one reserve→evaluate→write
+  cycle against the owning shard (clients talk to the primary directly,
+  routing by their own copy of the shard map);
+* an **open-loop arrival process** — a pacer enqueues cycles at a fixed
+  rate regardless of completion, so a struggling fleet shows up as
+  queueing delay in the end-to-end cycle percentiles instead of
+  silently throttling the offered load;
+* a **kill-and-promote schedule** — at fixed points in the arrival
+  stream the two most-loaded primaries are killed at the socket (the
+  shard vanishes from the network mid-traffic: every in-flight and
+  subsequent verb sees connection failures).  Clients reroute through
+  the router, the router promotes the warm replica, and the stream
+  continues.  The SIGKILL-at-the-WAL-append-boundary variant (real
+  process death, torn tail) is covered by tests/test_service_fleet.py.
+
+The acceptance bar is **exactly-once across both kills**: every store
+ends with its full contiguous tid range, every trial DONE, zero
+duplicates, every result carrying its own store's stamp, and every
+``exp_key`` living only on the shard the ring owns.
+
+Run::
+
+    env JAX_PLATFORMS=cpu python benchmarks/service_shard_load.py
+    env JAX_PLATFORMS=cpu python benchmarks/service_shard_load.py \
+        --workers 800 --rate 200     # scaled-down sanity run (no artifact)
+
+Writes ``benchmarks/service_shard_load_cpu_<stamp>.json`` with per-verb
+p50/p95/p99 server latencies, open-loop cycle percentiles, per-shard
+and per-exp-key audit rows, chaos counters and the headline gates
+(≥10k workers, ≥4 shards, ≥2 kills, completed, zero lost/dup).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+N_SHARDS = 4
+EXP_KEYS = 16
+WORKERS = 10_000                  # identities = trials: one cycle each
+THREADS = 24                      # OS threads draining the arrival queue
+ARRIVAL_RATE_CPS = 400.0          # open-loop arrivals (cycles/s)
+INSERT_CHUNK = 125                # docs per insert_docs verb
+KILL_FRACS = (0.30, 0.60)         # arrival-stream points of the 2 kills
+SEED = 0
+DRAIN_ROUNDS = 10
+SETTLE_TIMEOUT_S = 300.0
+
+
+def _mk_docs(tids, exp_key, xs):
+    from hyperopt_tpu import base
+
+    docs = []
+    for tid, x in zip(tids, xs):
+        d = base.new_trial_doc(tid, exp_key, None)
+        d["misc"]["idxs"] = {"x": [tid]}
+        d["misc"]["vals"] = {"x": [float(x)]}
+        docs.append(d)
+    return docs
+
+
+def main(workers=WORKERS, rate=ARRIVAL_RATE_CPS, write_artifact=True):
+    # Tight client retry/backoff: failover latency is paid per dead-shard
+    # verb, and the router's promote path is what we're here to exercise.
+    os.environ.setdefault("HYPEROPT_TPU_NETSTORE_RETRIES", "3")
+    os.environ.setdefault("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.005")
+
+    from hyperopt_tpu.base import (
+        JOB_STATE_DONE,
+        JOB_STATE_RUNNING,
+        STATUS_OK,
+    )
+    from hyperopt_tpu.exceptions import NetstoreUnavailable
+    from hyperopt_tpu.obs import metrics as _metrics
+    from hyperopt_tpu.parallel.netstore import RouterTrials
+    from hyperopt_tpu.service.cluster import HashRing, key_hash
+    from hyperopt_tpu.service.replica import ShardServer
+    from hyperopt_tpu.service.router import Router
+
+    _metrics.registry().snapshot(reset=True)
+    root = tempfile.mkdtemp(prefix="service_shard_load_")
+    per_key = workers // EXP_KEYS
+    workers = per_key * EXP_KEYS
+    exp_keys = [f"exp-{i:02d}" for i in range(EXP_KEYS)]
+
+    # -- fleet: 4 primaries, each shipping to a warm replica ----------------
+    primaries, replicas, shards_spec = [], [], {}
+    for i in range(N_SHARDS):
+        prim = ShardServer(wal_dir=os.path.join(root, f"s{i}p"),
+                           role="primary", fsync="batch",
+                           snapshot_every=5000)
+        prim.start()
+        repl = ShardServer(wal_dir=os.path.join(root, f"s{i}r"),
+                           role="replica", fsync="batch",
+                           snapshot_every=5000)
+        repl.start()
+        prim.attach_replica(repl.url)
+        primaries.append(prim)
+        replicas.append(repl)
+        shards_spec[f"s{i}"] = {"primary": prim.url, "replica": repl.url}
+    router = Router(shards_spec, retries=2, backoff=0.01)
+    router.start()
+
+    ring = HashRing([f"s{i}" for i in range(N_SHARDS)])
+    owners = {ek: ring.owner(None, ek) for ek in exp_keys}
+    # Kill the two most-loaded primaries (deterministic: the placement
+    # hash is pinned, so the load ranking never moves between runs).
+    by_load = sorted({sid: sum(1 for o in owners.values() if o == sid)
+                      for sid in shards_spec}.items(),
+                     key=lambda kv: (-kv[1], kv[0]))
+    kill_plan = [(KILL_FRACS[j], by_load[j][0]) for j in range(2)]
+
+    tls = threading.local()
+
+    def _client(ek):
+        cache = getattr(tls, "cache", None)
+        if cache is None:
+            cache = tls.cache = {}
+        rt = cache.get(ek)
+        if rt is None:
+            rt = cache[ek] = RouterTrials(router.url, exp_key=ek,
+                                          retries=2)
+        return rt
+
+    # -- offered work: one doc per identity, inserted up front --------------
+    rng = np.random.default_rng(SEED)
+    t_ins = time.perf_counter()
+    for ek in exp_keys:
+        rt = _client(ek)
+        tids = rt.new_trial_ids(per_key)
+        xs = rng.uniform(-5, 5, size=per_key)
+        for lo in range(0, per_key, INSERT_CHUNK):
+            rt._insert_trial_docs(
+                _mk_docs(tids[lo:lo + INSERT_CHUNK], ek,
+                         xs[lo:lo + INSERT_CHUNK]))
+    insert_s = time.perf_counter() - t_ins
+
+    # -- open-loop paced phase ----------------------------------------------
+    work: queue.Queue = queue.Queue()
+    paced_done = threading.Event()
+    stop = threading.Event()
+    lock = threading.Lock()
+    stats = {"completed": 0, "retried": 0, "fenced": 0, "empty": 0}
+    latencies: list = []          # end-to-end cycle seconds (arrival->done)
+    inflight = [0]
+    killed: list = []             # (sid, t_offset_s) in kill order
+
+    def _kill(sid):
+        prim = primaries[int(sid[1:])]
+        prim._httpd.shutdown()
+        prim._httpd.server_close()
+        with lock:
+            killed.append((sid, round(time.perf_counter() - t0, 3)))
+
+    def _cycle(item) -> bool:
+        ek, owner, _ = item
+        rt = _client(ek)
+        try:
+            doc = rt.reserve(owner)
+        except (NetstoreUnavailable, RuntimeError, OSError):
+            return False
+        if doc is None:
+            # Every identity maps to exactly one doc, so an empty
+            # reserve means a retried item raced a drain-side
+            # completion — nothing left to do for it.
+            with lock:
+                stats["empty"] += 1
+            return True
+        x = doc["misc"]["vals"]["x"][0]
+        doc["state"] = JOB_STATE_DONE
+        # The store stamp is the bleed probe: a doc surfacing in another
+        # exp_key's namespace carries the wrong stamp.
+        doc["result"] = {"status": STATUS_OK, "loss": float(x) ** 2,
+                         "exp": ek, "owner": owner}
+        try:
+            ok = rt.write_result(doc, owner=owner)
+        except (NetstoreUnavailable, RuntimeError, OSError):
+            return False
+        if not ok:
+            with lock:
+                stats["fenced"] += 1
+            return False
+        with lock:
+            stats["completed"] += 1
+            latencies.append(time.perf_counter() - item[2])
+        return True
+
+    def _worker():
+        while not stop.is_set():
+            try:
+                item = work.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            with lock:
+                inflight[0] += 1
+            try:
+                if not _cycle(item):
+                    with lock:
+                        stats["retried"] += 1
+                    time.sleep(0.02)      # failover window: do not spin
+                    work.put(item)
+            finally:
+                with lock:
+                    inflight[0] -= 1
+
+    def _pace():
+        interval = 1.0 / rate
+        pending_kills = list(kill_plan)
+        next_t = time.perf_counter()
+        for n in range(workers):
+            while pending_kills and n >= int(pending_kills[0][0] * workers):
+                _, sid = pending_kills.pop(0)
+                threading.Thread(target=_kill, args=(sid,),
+                                 daemon=True).start()
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(next_t - now)
+            next_t += interval
+            ek = exp_keys[n % EXP_KEYS]
+            work.put((ek, f"{ek}-w{n // EXP_KEYS:04d}",
+                      time.perf_counter()))
+        paced_done.set()
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=_worker, daemon=True,
+                                name=f"pool-{j}") for j in range(THREADS)]
+    for t in threads:
+        t.start()
+    pacer = threading.Thread(target=_pace, daemon=True, name="pacer")
+    pacer.start()
+
+    deadline = time.monotonic() + SETTLE_TIMEOUT_S
+    while time.monotonic() < deadline:
+        with lock:
+            busy = inflight[0]
+        if paced_done.is_set() and work.qsize() == 0 and busy == 0:
+            break
+        time.sleep(0.1)
+    stop.set()
+    pacer.join(timeout=10)
+    for t in threads:
+        t.join(timeout=10)
+    paced_s = time.perf_counter() - t0
+
+    # -- drain: complete anything a kill orphaned ---------------------------
+    # A cycle that died with the primary can leave its doc NEW again (the
+    # reserve record reached the replica but the write never did) or
+    # RUNNING under its original owner.  Both are drained to DONE here —
+    # exactly-once then shows up as zero duplicates in the audit below.
+    drain = {ek: RouterTrials(router.url, exp_key=ek, retries=2)
+             for ek in exp_keys}
+    for _ in range(DRAIN_ROUNDS):
+        pending = 0
+        for ek, rt in drain.items():
+            while True:
+                doc = rt.reserve(f"drain-{ek}")
+                if doc is None:
+                    break
+                x = doc["misc"]["vals"]["x"][0]
+                doc["state"] = JOB_STATE_DONE
+                doc["result"] = {"status": STATUS_OK,
+                                 "loss": float(x) ** 2, "exp": ek,
+                                 "owner": f"drain-{ek}"}
+                rt.write_result(doc, owner=f"drain-{ek}")
+            rt.refresh()
+            for d in rt._dynamic_trials:
+                if d["state"] == JOB_STATE_DONE:
+                    continue
+                pending += 1
+                if d["state"] == JOB_STATE_RUNNING and d.get("owner"):
+                    d["state"] = JOB_STATE_DONE
+                    x = d["misc"]["vals"]["x"][0]
+                    d["result"] = {"status": STATUS_OK,
+                                   "loss": float(x) ** 2, "exp": ek,
+                                   "owner": d["owner"]}
+                    rt.write_result(d, owner=d["owner"])
+        if pending == 0:
+            break
+    wall_s = time.perf_counter() - t0
+
+    # -- exactly-once + placement audit (chaos over: clean reads) -----------
+    key_rows, done_total, dups, leaks = [], 0, 0, 0
+    range_ok_all = True
+    for ek in exp_keys:
+        rt = drain[ek]
+        rt.refresh()
+        docs = rt._dynamic_trials
+        tids = sorted(d["tid"] for d in docs)
+        k_dups = len(tids) - len(set(tids))
+        k_done = sum(1 for d in docs if d["state"] == JOB_STATE_DONE)
+        k_leaks = sum(1 for d in docs
+                      if d["state"] == JOB_STATE_DONE
+                      and d["result"].get("exp") != ek)
+        range_ok = tids == list(range(per_key))
+        dups += k_dups
+        leaks += k_leaks
+        done_total += k_done
+        range_ok_all = range_ok_all and range_ok
+        key_rows.append({
+            "exp_key": ek, "shard": owners[ek], "trials": len(docs),
+            "done": k_done, "dups": k_dups, "tid_range_ok": range_ok,
+            "stamp_leaks": k_leaks,
+        })
+
+    killed_ids = {sid for sid, _ in killed}
+    shard_rows, placement_ok_all = [], True
+    for i in range(N_SHARDS):
+        sid = f"s{i}"
+        cur = replicas[i] if sid in killed_ids else primaries[i]
+        with cur._lock:
+            stored = {ek for (_, ek) in cur._trials}
+            seq = cur._wal.seq
+        want = {ek for ek in exp_keys if owners[ek] == sid}
+        placement_ok_all = placement_ok_all and stored == want
+        shard_rows.append({
+            "shard": sid, "killed": sid in killed_ids,
+            "serving_role": cur.role, "exp_keys": len(want),
+            "placement_ok": stored == want, "wal_seq": seq,
+        })
+
+    snap = _metrics.registry().snapshot()
+    counters = snap.get("counters", {})
+    verb_rows = []
+    for name, h in sorted(snap.get("histograms", {}).items()):
+        if name.startswith("netstore.verb.") and name.endswith(".s") \
+                and h.get("count"):
+            verb_rows.append({
+                "verb": name[len("netstore.verb."):-len(".s")],
+                "count": h["count"],
+                "p50_ms": round(1e3 * h["p50"], 3),
+                "p95_ms": round(1e3 * h["p95"], 3),
+                "p99_ms": round(1e3 * h["p99"], 3),
+            })
+
+    lat_ms = np.asarray(latencies) * 1e3
+    pct = (lambda q: round(float(np.percentile(lat_ms, q)), 3)) \
+        if lat_ms.size else (lambda q: None)
+    completed = done_total == workers and range_ok_all
+    doc = {
+        "metric": "service_shard_load_openloop",
+        "backend": "cpu",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "config": {
+            "shards": N_SHARDS,
+            "replicas_per_shard": 1,
+            "exp_keys": EXP_KEYS,
+            "workers": workers,
+            "threads": THREADS,
+            "arrival_rate_cps": rate,
+            "insert_chunk": INSERT_CHUNK,
+            "fsync": "batch",
+            "kill_plan": [{"at_frac": f, "shard": s}
+                          for f, s in kill_plan],
+        },
+        "rows": verb_rows,
+        "shards": shard_rows,
+        "exp_keys": key_rows,
+        "open_loop": {
+            "cycles": int(lat_ms.size),
+            "p50_ms": pct(50), "p95_ms": pct(95), "p99_ms": pct(99),
+            "max_ms": round(float(lat_ms.max()), 3) if lat_ms.size
+            else None,
+            "insert_phase_s": round(insert_s, 2),
+            "paced_phase_s": round(paced_s, 2),
+        },
+        "chaos": {
+            "kills": [{"shard": s, "t_s": t} for s, t in killed],
+            "promotions": int(counters.get("shard.promotions", 0)),
+            "router_failovers": int(counters.get("router.failovers", 0)),
+            "router_forwarded": int(counters.get("router.forwarded", 0)),
+            "client_reroutes": int(
+                counters.get("netstore.client.reroutes", 0)),
+            "rpc_retries": int(counters.get("netstore.rpc.retry", 0)),
+            "rpc_unavailable": int(
+                counters.get("netstore.rpc.unavailable", 0)),
+            "idem_hits": int(counters.get("netstore.idem.hits", 0)),
+            "cycles_retried": stats["retried"],
+            "writes_fenced": stats["fenced"],
+        },
+        "headline": {
+            "workers": workers,
+            "shards": N_SHARDS,
+            "kills": len(killed),
+            "promotions": int(counters.get("shard.promotions", 0)),
+            "trials_total": workers,
+            "trials_completed": done_total,
+            "completed": completed,
+            "zero_lost_dup": bool(range_ok_all and dups == 0),
+            "zero_leakage": bool(leaks == 0 and placement_ok_all),
+            "wall_s": round(wall_s, 2),
+            "cycles_per_sec": round(workers / wall_s, 2),
+        },
+    }
+
+    router.shutdown()
+    for srv in primaries + replicas:
+        try:
+            srv.shutdown()
+        except OSError:
+            pass                    # the killed primaries' sockets
+
+    print(json.dumps(doc["headline"], indent=1))
+    ok = (completed and doc["headline"]["zero_lost_dup"]
+          and doc["headline"]["zero_leakage"] and len(killed) >= 2)
+    if write_artifact:
+        stamp = time.strftime("%Y%m%d")
+        out_path = os.path.join(_ROOT, "benchmarks",
+                                f"service_shard_load_cpu_{stamp}.json")
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"wrote {out_path}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--workers", type=int, default=WORKERS,
+                    help="simulated worker identities (= trials); "
+                         "rounded down to a multiple of the 16 exp_keys")
+    ap.add_argument("--rate", type=float, default=ARRIVAL_RATE_CPS,
+                    help="open-loop arrival rate, cycles/s")
+    ap.add_argument("--no-artifact", action="store_true",
+                    help="headline only (scaled-down sanity runs)")
+    args = ap.parse_args()
+    raise SystemExit(main(workers=args.workers, rate=args.rate,
+                          write_artifact=not args.no_artifact))

@@ -19,6 +19,9 @@ Fault points wired into the core::
     pipeline.dispatch before PipelinedExecutor dispatches a suggest slot
     wal.write         before a service-server WAL record is appended
     wal.replay        per record during WAL replay at server recovery
+    flight.dump       inside a flight-recorder bundle dump
+    replica.ship      before a WAL batch/snapshot ships to a warm replica
+    router.forward    before the fleet router forwards a verb to a shard
 
 Configuration — programmatic::
 
@@ -83,6 +86,8 @@ FAULT_POINTS = frozenset(
         "wal.write",
         "wal.replay",
         "flight.dump",
+        "replica.ship",
+        "router.forward",
     }
 )
 

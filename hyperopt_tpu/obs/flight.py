@@ -37,6 +37,7 @@ import threading
 import time
 
 from . import bundle as _bundle
+from . import context as _context
 from . import metrics as _metrics
 from .events import EVENTS
 
@@ -102,6 +103,11 @@ def install(dump_dir: str | None = None, *, sigterm: bool = True,
             else float(min_interval_s))
     if arm_events and not EVENTS.enabled:
         EVENTS.enable()
+    if arm_events and not _context.armed():
+        # Adopt incoming trace contexts too: a postmortem bundle from an
+        # otherwise-untraced server still attributes its events to the
+        # calling client's trace id (spliceable by id after a crash).
+        _context.enable()
     if sigterm:
         _install_sigterm()
     _armed = True

@@ -947,8 +947,11 @@ class _Rpc:
 
     def __call__(self, verb: str, **kw) -> dict:
         kw.update(verb=verb, exp_key=self.exp_key)
-        if verb in _MUTATING_VERBS:
+        if verb in _MUTATING_VERBS and "idem" not in kw:
             # One key per logical call, shared by every retry of it.
+            # Routed callers pre-pin the key instead, so a retry that
+            # crosses a shard failover still dedupes on the promoted
+            # replica (the shipped WAL record repopulated its cache).
             kw["idem"] = uuid.uuid4().hex
         # Trace-context stamp (obs/context.py): when the caller runs
         # inside a bound context (a traced driver batch, a worker
@@ -1227,6 +1230,130 @@ class NetWorker(FileWorker):
 
     def _make_trials(self, url, exp_key):
         return NetTrials(url, exp_key=exp_key, token=self._token)
+
+
+# ---------------------------------------------------------------------------
+# Router-aware client (sharded fleet, service/router.py)
+# ---------------------------------------------------------------------------
+
+
+class _RoutedRpc:
+    """:class:`_Rpc` facade that places itself via a router's shard map.
+
+    Fetches the ``shard_map`` verb from the router at construction and
+    re-fetches every ``HYPEROPT_TPU_SHARDMAP_REFRESH_S`` seconds (or on
+    transport failure), computes the owning shard for this client's
+    ``(tenant, exp_key)`` with the same pinned hash the router uses
+    (``service/cluster.py``), and then speaks to the owning primary
+    **directly** — the router serves topology, not the data path.
+
+    Failover: a :class:`NetstoreUnavailable` from the shard forces a map
+    refresh (the router promotes the replica on its side) and one retry
+    against the new primary, with the **same** idempotency key pinned
+    before the first attempt — the promoted replica either replays the
+    shipped record's cached reply or executes the verb for the first
+    time, so the retry is exactly-once either way.
+    """
+
+    def __init__(self, router_url: str, exp_key: str,
+                 timeout: float = 30.0, token: str | None = None,
+                 retries: int | None = None,
+                 map_refresh_s: float | None = None):
+        self._router = _Rpc(router_url, exp_key, timeout=timeout,
+                            token=token, retries=retries)
+        self.exp_key = exp_key
+        self.timeout = timeout
+        self.token = _resolve_token(token)
+        self._retries = retries
+        if map_refresh_s is None:
+            map_refresh_s = float(os.environ.get(
+                "HYPEROPT_TPU_SHARDMAP_REFRESH_S", "30") or "30")
+        self.map_refresh_s = float(map_refresh_s)
+        self._lock = threading.Lock()
+        self._shard_rpc = None
+        self.shard_id = None
+        self.tenant = None
+        self.map_version = None
+        self._map_t = float("-inf")
+        self._refresh_map(force=True)
+
+    @property
+    def url(self) -> str:
+        """The owning shard primary's URL (moves under failover)."""
+        with self._lock:
+            return self._shard_rpc.url
+
+    def _refresh_map(self, force: bool = False) -> None:
+        with self._lock:
+            if (not force and time.monotonic() - self._map_t
+                    < self.map_refresh_s):
+                return
+            out = self._router("shard_map")
+            from ..service.cluster import ShardMap
+            smap = ShardMap.from_dict(out["map"])
+            self.tenant = out.get("tenant")
+            sid, ent = smap.owner(self.tenant, self.exp_key)
+            self._map_t = time.monotonic()
+            self.map_version = smap.version
+            if (self._shard_rpc is None or self.shard_id != sid
+                    or self._shard_rpc.url != ent["primary"]):
+                self._shard_rpc = _Rpc(ent["primary"], self.exp_key,
+                                       timeout=self.timeout,
+                                       token=self.token,
+                                       retries=self._retries)
+                self.shard_id = sid
+
+    def __call__(self, verb: str, **kw) -> dict:
+        self._refresh_map()
+        if verb in _MUTATING_VERBS and "idem" not in kw:
+            # Pinned HERE so the post-failover retry below reuses it.
+            kw["idem"] = uuid.uuid4().hex
+        with self._lock:
+            rpc = self._shard_rpc
+        try:
+            return rpc(verb, **kw)
+        except NetstoreUnavailable:
+            # Primary gone — and since the data path is direct, the
+            # router may not know yet.  Push this very verb THROUGH the
+            # router: its forward path retries, promotes the warm
+            # replica and answers from the new primary.  The idem key
+            # pinned above rides both attempts, so the retry dedupes if
+            # the dead primary shipped the record before the kill.
+            _metrics.registry().counter("netstore.client.reroutes").inc()
+            out = self._router(verb, **kw)
+            try:
+                self._refresh_map(force=True)    # re-place future calls
+            except (NetstoreUnavailable, RuntimeError, OSError):
+                pass                 # best effort; next call retries it
+            return out
+
+
+class RouterTrials(NetTrials):
+    """:class:`NetTrials` behind the fleet router (``service/router.py``).
+
+    Same surface, different placement: ``url`` is the ROUTER's URL; the
+    client pulls the shard map from it, hashes its own ``(tenant,
+    exp_key)`` onto the ring, and talks to the owning shard primary
+    directly, re-placing itself after failover or rebalance (see
+    :class:`_RoutedRpc`).  ``token`` authenticates against both the
+    router (edge) and the shard (authority).
+    """
+
+    def __init__(self, url: str, exp_key: str = "default", refresh=True,
+                 timeout: float = 30.0, token: str | None = None,
+                 retries: int | None = None,
+                 map_refresh_s: float | None = None):
+        self._rpc = _RoutedRpc(url, exp_key, timeout=timeout,
+                               token=token, retries=retries,
+                               map_refresh_s=map_refresh_s)
+        self._last_metrics_push = float("-inf")
+        Trials.__init__(self, exp_key=exp_key, refresh=refresh)
+        self.attachments = _NetAttachments(self._rpc)
+
+    @property
+    def shard_id(self):
+        """The shard currently owning this client's store."""
+        return self._rpc.shard_id
 
 
 # ---------------------------------------------------------------------------

@@ -1,5 +1,5 @@
 """Suggestion-as-a-service: multi-tenant, WAL-durable netstore with
-server-side TPE.
+server-side TPE — and the sharded, replicated fleet around it.
 
 Layers (each usable alone):
 
@@ -12,23 +12,38 @@ Layers (each usable alone):
   ``inspect`` (the ``hyperopt-tpu-show wal`` backend);
 * :mod:`.server` — :class:`ServiceServer`, the StoreServer subclass
   wiring the three together (append-before-execute, crash recovery,
-  server-side ``suggest`` decomposed into physical records).
+  server-side ``suggest`` decomposed into physical records);
+* :mod:`.cluster` — pinned consistent-hash ring + :class:`ShardMap`
+  (the fleet topology document);
+* :mod:`.replica` — :class:`ShardServer` (role-aware primary/replica)
+  + :class:`WalShipper` (snapshot+tail WAL shipping, scrub);
+* :mod:`.router` — :class:`Router`, the stateless consistent-hash
+  front with kill-tolerant failover and bounded-cutover rebalance.
 """
 
+from .cluster import DEFAULT_VNODES, HashRing, ShardMap, key_hash
 from .store import MemTrials
 from .tenancy import Tenant, TenantTable, TokenBucket
 from .wal import Wal, inspect, read_wal
 
 __all__ = [
-    "MemTrials", "ServiceServer", "Tenant", "TenantTable", "TokenBucket",
-    "Wal", "inspect", "read_wal",
+    "DEFAULT_VNODES", "HashRing", "MemTrials", "Router", "ServiceServer",
+    "ShardMap", "ShardServer", "Tenant", "TenantTable", "TokenBucket",
+    "Wal", "WalShipper", "inspect", "key_hash", "read_wal",
 ]
 
 
 def __getattr__(name):
-    # ServiceServer lazily: importing .server pulls in the netstore (and
-    # through suggest, potentially JAX) — tenancy/wal users shouldn't pay.
+    # The server classes lazily: importing .server/.replica/.router pulls
+    # in the netstore (and through suggest, potentially JAX) —
+    # tenancy/wal/cluster users shouldn't pay.
     if name == "ServiceServer":
         from .server import ServiceServer
         return ServiceServer
+    if name in ("ShardServer", "WalShipper"):
+        from . import replica
+        return getattr(replica, name)
+    if name == "Router":
+        from .router import Router
+        return Router
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,172 @@
+"""Consistent-hash placement for the sharded service fleet.
+
+The fleet's unit of placement is one ``(tenant, exp_key)`` store — the
+same key that namespaces everything else in the service layer.  The
+router (``service/router.py``) hashes that pair onto a ring of virtual
+nodes, one bucket of ``virtual_nodes`` points per shard, and forwards
+the verb to whichever shard owns the first point clockwise of the key.
+
+Two properties carry the whole design:
+
+* **Pinned hash.**  Placement uses a SHA-1 prefix, never Python's
+  builtin ``hash()`` — the builtin is salted per process, so a router
+  restart would silently reshuffle every store onto a different shard
+  and strand the WALs that hold their history.  With the pinned hash,
+  any router (or router-aware client) computes the same owner for the
+  same key, forever.
+* **Minimal movement.**  Adding or removing one shard moves only the
+  keys whose clockwise-first point changed — ~K/N of K keys across N
+  shards, not a full reshuffle (pinned in
+  ``tests/test_service_fleet.py``).
+
+:class:`ShardMap` is the wire-visible form: the ring parameters plus
+each shard's primary/replica URLs, stamped with a monotonically
+increasing ``version`` so clients can tell a stale map from a fresh one
+after a failover or rebalance.  The map itself is plain data — the
+router mutates it under its own lock and republishes it via the
+``shard_map`` verb.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import os
+
+__all__ = ["DEFAULT_VNODES", "HashRing", "ShardMap", "key_hash"]
+
+#: Virtual nodes per shard (``HYPEROPT_TPU_RING_VNODES``).  64 points
+#: per shard keeps the per-shard key-count spread within ~±25% for the
+#: fleet sizes the service targets, at negligible ring-build cost.
+DEFAULT_VNODES = 64
+
+
+def _vnodes(value=None) -> int:
+    if value is not None:
+        return max(1, int(value))
+    raw = os.environ.get("HYPEROPT_TPU_RING_VNODES", "")
+    try:
+        return max(1, int(raw)) if raw else DEFAULT_VNODES
+    except ValueError:
+        return DEFAULT_VNODES
+
+
+def _h64(s: str) -> int:
+    """Pinned 64-bit point: stable across processes, platforms and
+    restarts (SHA-1 prefix; the builtin ``hash()`` is per-process
+    salted and would reshuffle the ring on every restart)."""
+    return int.from_bytes(hashlib.sha1(s.encode("utf-8")).digest()[:8],
+                          "big")
+
+
+def key_hash(tenant, exp_key: str) -> int:
+    """Placement point of one ``(tenant, exp_key)`` store.  ``None``
+    tenant (single-tenant fleets) hashes as the empty name, with a NUL
+    separator so ``("ab", "c")`` and ``("a", "bc")`` cannot collide."""
+    return _h64(f"{tenant or ''}\x00{exp_key}")
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes over opaque shard ids."""
+
+    def __init__(self, shard_ids=(), virtual_nodes: int | None = None):
+        self.virtual_nodes = _vnodes(virtual_nodes)
+        self._points: list = []       # sorted (point, shard_id) pairs
+        self._ids: set = set()
+        for sid in shard_ids:
+            self.add(sid)
+
+    @property
+    def shard_ids(self) -> list:
+        return sorted(self._ids)
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def __contains__(self, sid) -> bool:
+        return sid in self._ids
+
+    def add(self, sid: str) -> None:
+        if sid in self._ids:
+            return
+        self._ids.add(sid)
+        for v in range(self.virtual_nodes):
+            self._points.append((_h64(f"{sid}#{v}"), sid))
+        self._points.sort()
+
+    def remove(self, sid: str) -> None:
+        if sid not in self._ids:
+            return
+        self._ids.discard(sid)
+        self._points = [p for p in self._points if p[1] != sid]
+
+    def owner_of_point(self, point: int):
+        """Shard id owning ``point``: first ring point clockwise."""
+        if not self._points:
+            raise ValueError("empty hash ring: no shards registered")
+        i = bisect.bisect_right(self._points, (point, "￿"))
+        if i == len(self._points):
+            i = 0                      # wrap: the ring is a circle
+        return self._points[i][1]
+
+    def owner(self, tenant, exp_key: str):
+        """Shard id owning the ``(tenant, exp_key)`` store."""
+        return self.owner_of_point(key_hash(tenant, exp_key))
+
+
+class ShardMap:
+    """The fleet topology document: ring parameters + per-shard URLs.
+
+    ``shards`` maps shard id -> ``{"primary": url, "replica": url|None}``.
+    Not thread-safe by itself — the router owns the only mutable copy
+    and serializes changes under its own lock; everyone else holds
+    immutable snapshots obtained via :meth:`to_dict`.
+    """
+
+    def __init__(self, shards: dict, virtual_nodes: int | None = None,
+                 version: int = 1):
+        self.version = int(version)
+        self.shards = {
+            str(sid): {"primary": str(ent["primary"]).rstrip("/"),
+                       "replica": (str(ent["replica"]).rstrip("/")
+                                   if ent.get("replica") else None)}
+            for sid, ent in shards.items()}
+        if not self.shards:
+            raise ValueError("shard map needs at least one shard")
+        self.ring = HashRing(self.shards, virtual_nodes=virtual_nodes)
+
+    def owner(self, tenant, exp_key: str):
+        """``(shard_id, entry)`` owning the ``(tenant, exp_key)`` store."""
+        sid = self.ring.owner(tenant, exp_key)
+        return sid, self.shards[sid]
+
+    def promote(self, sid: str) -> dict:
+        """Failover: the warm replica becomes the primary.  Returns the
+        updated entry; raises when the shard has no replica to promote.
+        """
+        ent = self.shards[sid]
+        if not ent["replica"]:
+            raise ValueError(f"shard {sid!r} has no replica to promote")
+        ent["primary"], ent["replica"] = ent["replica"], None
+        self.version += 1
+        return ent
+
+    def set_primary(self, sid: str, url: str,
+                    replica: str | None = None) -> dict:
+        """Rebalance cutover: point the shard at a new primary process."""
+        ent = self.shards[sid]
+        ent["primary"] = url.rstrip("/")
+        ent["replica"] = replica.rstrip("/") if replica else None
+        self.version += 1
+        return ent
+
+    def to_dict(self) -> dict:
+        return {"version": self.version,
+                "virtual_nodes": self.ring.virtual_nodes,
+                "shards": {sid: dict(ent)
+                           for sid, ent in sorted(self.shards.items())}}
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "ShardMap":
+        return cls(doc["shards"], virtual_nodes=doc.get("virtual_nodes"),
+                   version=doc.get("version", 1))

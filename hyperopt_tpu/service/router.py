@@ -1,0 +1,589 @@
+"""Stateless consistent-hash router: one URL in front of N shards.
+
+The router owns no trial state — only the :class:`~.cluster.ShardMap`.
+Every verb POST is hashed by its ``(tenant, exp_key)`` onto the ring
+(:mod:`~.cluster`, pinned hash, virtual nodes) and forwarded **raw** to
+the owning shard's primary: the body bytes are untouched, so the PR 5
+idempotency key and the PR 6 trace context ride through verbatim, and
+the client's ``X-Netstore-Token`` header is passed along for the shard
+to authenticate — the router never terminates auth for forwarded verbs
+(give it a tenant table and it *additionally* rejects unknown tokens at
+the edge, which is also what makes per-tenant placement possible).
+
+**Failover** is the router's one write to the map: when a primary stops
+answering transport (``HYPEROPT_TPU_ROUTER_RETRIES`` attempts, backoff
+``HYPEROPT_TPU_ROUTER_BACKOFF``), the router promotes the shard's warm
+replica (``promote`` verb, fleet token), swaps the map entry, and
+re-forwards.  Exactly-once across the kill is the PR 5/7 machinery's
+job: the retried body carries the original idempotency key, and the
+replica either replays the shipped record's cached reply or executes
+the verb for the first time — never twice (DESIGN.md §7).
+
+**Rebalance** moves a shard to a new process with a bounded cutover:
+attach the target as an extra replica of the current primary
+(snapshot+tail catch-up, unbounded but non-blocking), then gate the
+shard's forwards, wait for two quiesced ``scrub`` agreements (seq AND
+state hash), promote the target, swap the map — all inside
+``HYPEROPT_TPU_CUTOVER_WINDOW_S``, or abort with the old primary still
+serving.
+
+Fleet-internal calls (promote/scrub/replica_attach, shard metrics
+pulls) authenticate with the router's own ``token``; in tenant-table
+fleets, point it at a dedicated ops tenant's token.
+
+``GET /metrics`` merges every shard's snapshot (plus the router's own
+``router.*`` series) into one document with a ``router`` section —
+what ``show live`` renders as the per-shard p50/p95/p99 panel.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.error import HTTPError, URLError
+from urllib.request import Request, urlopen
+
+from .. import faults as _faults
+from ..exceptions import InjectedFault, NetstoreUnavailable
+from ..obs import export as _obs_export
+from ..obs import flight as _flight
+from ..obs import metrics as _metrics
+from ..obs.events import EVENTS
+from .cluster import ShardMap
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Router", "main"]
+
+#: Verbs the router answers itself; everything else is forwarded to the
+#: shard owning the request's (tenant, exp_key).
+_ROUTER_VERBS = frozenset({"shard_map", "rebalance"})
+
+#: Millisecond-bucket convention shared with the service layer.
+_MS_BUCKETS = tuple(0.05 * (2.0 ** i) for i in range(20))
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+class Router:
+    """Thin HTTP front: consistent-hash placement + failover + map serving.
+
+    ``shards`` maps shard id -> ``{"primary": url, "replica": url|None}``
+    (a :class:`~.cluster.ShardMap` is built from it).  ``tenants`` (a
+    :class:`~.tenancy.TenantTable`) is optional: with it, placement uses
+    the authenticated tenant name and unknown tokens are rejected at the
+    edge; without it, placement hashes ``(None, exp_key)`` and shards
+    keep sole authority over auth.
+    """
+
+    def __init__(self, shards: dict, host: str = "127.0.0.1",
+                 port: int = 0, token: str | None = None, tenants=None,
+                 virtual_nodes: int | None = None,
+                 timeout: float = 30.0,
+                 retries: int | None = None,
+                 backoff: float | None = None,
+                 cutover_window_s: float | None = None):
+        from ..parallel.netstore import _resolve_token
+        self._map = ShardMap(shards, virtual_nodes=virtual_nodes)
+        self._lock = threading.Lock()
+        self._cutover: dict = {}        # shard id -> cutover gate Event
+        self._token = _resolve_token(token)
+        self._tenants = tenants
+        self.timeout = float(timeout)
+        self.retries = (retries if retries is not None
+                        else _env_int("HYPEROPT_TPU_ROUTER_RETRIES", 2))
+        self.backoff = (backoff if backoff is not None
+                        else _env_float("HYPEROPT_TPU_ROUTER_BACKOFF",
+                                        0.05))
+        self.cutover_window_s = (
+            cutover_window_s if cutover_window_s is not None
+            else _env_float("HYPEROPT_TPU_CUTOVER_WINDOW_S", 5.0))
+        self._started = False
+        self._closed = False
+        self._lifecycle_lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):      # quiet by default
+                logger.debug("router: " + fmt, *args)
+
+            def _send(self, code, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_json(self, code, body: bytes):
+                self._send(code, body, "application/json")
+
+            def _reject(self):
+                _metrics.registry().counter("router.auth.rejected").inc()
+                self.rfile.read(
+                    int(self.headers.get("Content-Length", "0")))
+                self._send_json(401, json.dumps(
+                    {"error": "AuthError: missing or bad "
+                     "X-Netstore-Token"}).encode())
+
+            def _resolve(self):
+                """Edge auth: with a tenant table every request must
+                resolve to a tenant (whose name drives placement); with
+                a bare/absent token the router's own verbs compare
+                constant-time and forwarded verbs defer to the shard."""
+                import hmac
+                self._tenant = None
+                tok = self.headers.get("X-Netstore-Token", "")
+                if server._tenants is not None:
+                    tenant = server._tenants.resolve(tok)
+                    if tenant is None:
+                        self._reject()
+                        return False
+                    self._tenant = tenant
+                    return True
+                if server._token is None:
+                    return True
+                if hmac.compare_digest(tok.encode(),
+                                       server._token.encode()):
+                    return True
+                self._reject()
+                return False
+
+            def do_POST(self):
+                if not self._resolve():
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                raw = self.rfile.read(n) or b"{}"
+                try:
+                    req = json.loads(raw)
+                    verb = req.get("verb")
+                    if verb == "shard_map":
+                        out = server._shard_map_verb(self._tenant)
+                    elif verb == "rebalance":
+                        out = server._rebalance_verb(req)
+                    else:
+                        tname = getattr(self._tenant, "name",
+                                        self._tenant)
+                        code, body = server.forward(
+                            raw, verb=verb, tenant=tname,
+                            exp_key=req.get("exp_key", "default"),
+                            token=self.headers.get("X-Netstore-Token"))
+                        self._send_json(code, body)
+                        return
+                    body = json.dumps(out).encode()
+                    code = 200
+                except NetstoreUnavailable as e:
+                    body = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                    code = 503
+                except Exception as e:
+                    body = json.dumps(
+                        {"error": f"{type(e).__name__}: {e}"}).encode()
+                    code = 500
+                self._send_json(code, body)
+
+            def do_GET(self):
+                if not self._resolve():
+                    return
+                if self.path.split("?", 1)[0] == "/metrics":
+                    payload = server.metrics_payload()
+                    if _obs_export.wants_openmetrics(
+                            self.headers.get("Accept", "")):
+                        body = _obs_export.render_openmetrics(
+                            payload).encode("utf-8")
+                        self._send(200, body, _obs_export.CONTENT_TYPE)
+                        return
+                    self._send_json(200, json.dumps(payload).encode())
+                    return
+                self._send_json(404, json.dumps(
+                    {"error": f"NotFound: {self.path}"}).encode())
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._httpd.server_address[:2]
+
+    # -- lifecycle (mirrors StoreServer's idempotent shutdown) ---------------
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        self._started = True
+        t = threading.Thread(target=self._httpd.serve_forever,
+                             daemon=True, name="service-router")
+        t.start()
+        return self.host, self.port
+
+    def serve_forever(self):
+        self._started = True
+        self._httpd.serve_forever()
+
+    def shutdown(self):
+        with self._lifecycle_lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._started:
+            self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- shard-internal RPC ---------------------------------------------------
+
+    def _fleet_rpc(self, url: str, retries: int = 1):
+        """RPC bound to a shard with the router's fleet credential."""
+        from ..parallel.netstore import _Rpc
+        return _Rpc(url, "__router__", timeout=self.timeout,
+                    token=self._token, retries=retries)
+
+    # -- forwarding + failover ------------------------------------------------
+
+    def shard_for(self, tenant, exp_key: str):
+        """Current owner ``(shard_id, entry)`` — a snapshot; the map can
+        move under failover/rebalance."""
+        with self._lock:
+            sid, ent = self._map.owner(tenant, exp_key)
+            return sid, dict(ent)
+
+    def forward(self, raw: bytes, verb, tenant, exp_key: str,
+                token: str | None):
+        """Forward one verb body to the owning primary; on transport
+        failure, promote the replica and retry there.  Returns
+        ``(status, body bytes)`` exactly as the shard answered (HTTP
+        application errors pass through un-retried, like ``_Rpc``)."""
+        reg = _metrics.registry()
+        err = None
+        for _generation in range(3):
+            with self._lock:
+                sid, ent = self._map.owner(tenant, exp_key)
+                version = self._map.version
+                gate = self._cutover.get(sid)
+            if gate is not None:
+                # Mid-rebalance: hold the verb for the bounded cutover
+                # window, then re-resolve the owner.
+                gate.wait(self.cutover_window_s + 1.0)
+                continue
+            try:
+                return self._post_shard(sid, ent["primary"], raw, verb,
+                                        token)
+            except NetstoreUnavailable as e:
+                err = e
+                with self._lock:
+                    moved = self._map.version != version
+                if moved:
+                    continue            # another thread already failed over
+                if not self._promote_replica(sid, version):
+                    break
+        reg.counter("router.errors").inc()
+        raise err if err is not None else NetstoreUnavailable(
+            f"router: no live shard for ({tenant!r}, {exp_key!r})")
+
+    def _post_shard(self, sid: str, url: str, raw: bytes, verb,
+                    token: str | None):
+        """One shard POST with the router's transport-retry budget.
+        Counts every attempt; observes per-shard forward latency."""
+        reg = _metrics.registry()
+        headers = {"Content-Type": "application/json"}
+        if token:
+            headers["X-Netstore-Token"] = token
+        attempts = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                _faults.maybe_fail("router.forward", verb=verb)
+                request = Request(url, data=raw, headers=headers)
+                try:
+                    with urlopen(request, timeout=self.timeout) as resp:
+                        code, body = resp.status, resp.read()
+                except HTTPError as e:
+                    # The shard answered (auth refusal, verb fault):
+                    # application-level — pass through, never retry.
+                    code, body = e.code, e.read()
+                dt = time.perf_counter() - t0
+                reg.counter("router.forwarded").inc()
+                reg.histogram("router.forward.s").observe(dt)
+                reg.histogram(f"router.shard.{sid}.s").observe(dt)
+                return code, body
+            except (URLError, OSError, InjectedFault) as e:
+                attempts += 1
+                reg.counter("router.retries").inc()
+                if attempts > self.retries:
+                    raise NetstoreUnavailable(
+                        f"shard {sid} primary {url} unreachable after "
+                        f"{attempts} attempt(s) ({verb}): {e}",
+                        attempts=attempts) from e
+                time.sleep(min(self.backoff * (2 ** (attempts - 1)), 2.0))
+
+    def _promote_replica(self, sid: str, seen_version: int) -> bool:
+        """Failover: promote the shard's warm replica and swap the map.
+        Single-flight via the version check; returns whether the shard
+        has a live primary afterwards."""
+        with self._lock:
+            if self._map.version != seen_version:
+                return True             # raced: someone else moved it
+            replica = self._map.shards[sid]["replica"]
+        if not replica:
+            logger.error("shard %s primary is down and no replica is "
+                         "attached — giving up", sid)
+            return False
+        try:
+            out = self._fleet_rpc(replica, retries=2)("promote")
+        except (NetstoreUnavailable, RuntimeError, OSError) as e:
+            logger.error("shard %s failover: replica %s also "
+                         "unreachable: %s", sid, replica, e)
+            return False
+        with self._lock:
+            if self._map.version == seen_version:
+                self._map.promote(sid)
+        _metrics.registry().counter("router.failovers").inc()
+        EVENTS.emit("router_failover", name=sid, url=replica,
+                    seq=out.get("seq"))
+        logger.warning("shard %s: primary down, PROMOTED replica %s "
+                       "(seq %s)", sid, replica, out.get("seq"))
+        return True
+
+    # -- router-local verbs ---------------------------------------------------
+
+    def _shard_map_verb(self, tenant) -> dict:
+        """The topology document + the caller's resolved tenant name —
+        everything a router-aware client needs to place itself."""
+        _metrics.registry().counter("router.map.fetches").inc()
+        with self._lock:
+            doc = self._map.to_dict()
+        return {"map": doc, "tenant": getattr(tenant, "name", tenant)}
+
+    def _rebalance_verb(self, req: dict) -> dict:
+        """Move shard ``req["shard"]`` to the process at ``req["url"]``:
+        snapshot+tail catch-up while the old primary keeps serving, then
+        a bounded cutover (gate forwards, require two quiesced scrub
+        agreements, promote, swap)."""
+        sid = str(req["shard"])
+        new_url = str(req["url"]).rstrip("/")
+        catchup_timeout = float(req.get("timeout", 30.0))
+        with self._lock:
+            if sid not in self._map.shards:
+                raise ValueError(f"unknown shard {sid!r}")
+            if sid in self._cutover:
+                raise RuntimeError(f"shard {sid!r} rebalance already "
+                                   "in progress")
+            ent = dict(self._map.shards[sid])
+        old_rpc = self._fleet_rpc(ent["primary"], retries=2)
+        new_rpc = self._fleet_rpc(new_url, retries=2)
+        old_rpc("replica_attach", url=new_url)
+        deadline = time.monotonic() + catchup_timeout
+        while True:
+            if new_rpc("scrub")["seq"] >= old_rpc("scrub")["seq"]:
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"rebalance {sid}: catch-up to {new_url} timed out")
+            time.sleep(0.05)
+        # Cutover: gate this shard's forwards, then require two
+        # consecutive quiesced agreements (seq stable AND hashes equal)
+        # so verbs already in flight to the old primary are provably
+        # shipped and applied before the swap.
+        gate = threading.Event()
+        with self._lock:
+            self._cutover[sid] = gate
+        t0 = time.perf_counter()
+        try:
+            wdeadline = time.monotonic() + self.cutover_window_s
+            prev_seq = None
+            while True:
+                old_s = old_rpc("scrub")
+                new_s = new_rpc("scrub")
+                if (new_s["seq"] == old_s["seq"]
+                        and new_s["hash"] == old_s["hash"]
+                        and prev_seq == old_s["seq"]):
+                    break
+                prev_seq = old_s["seq"]
+                if time.monotonic() > wdeadline:
+                    raise RuntimeError(
+                        f"rebalance {sid}: cutover window "
+                        f"({self.cutover_window_s}s) exceeded; aborted "
+                        "— the old primary keeps serving")
+                time.sleep(0.02)
+            new_rpc("promote")
+            with self._lock:
+                self._map.set_primary(sid, new_url,
+                                      replica=ent["replica"])
+                version = self._map.version
+        finally:
+            with self._lock:
+                self._cutover.pop(sid, None)
+            gate.set()
+        if ent["replica"]:
+            # Re-arm warm replication from the new primary (best
+            # effort: the old replica keeps its state either way).
+            try:
+                new_rpc("replica_attach", url=ent["replica"])
+            except (NetstoreUnavailable, RuntimeError, OSError):
+                logger.warning("rebalance %s: could not re-attach "
+                               "replica %s", sid, ent["replica"])
+        cutover_ms = (time.perf_counter() - t0) * 1e3
+        reg = _metrics.registry()
+        reg.counter("router.rebalances").inc()
+        reg.histogram("router.cutover_ms",
+                      buckets=_MS_BUCKETS).observe(cutover_ms)
+        EVENTS.emit("router_rebalance", name=sid, url=new_url)
+        logger.warning("shard %s REBALANCED to %s (cutover %.1f ms)",
+                       sid, new_url, cutover_ms)
+        return {"shard": sid, "primary": new_url, "version": version,
+                "cutover_ms": cutover_ms}
+
+    # -- fleet-merged metrics -------------------------------------------------
+
+    def _fetch_shard_metrics(self, url: str) -> dict:
+        request = Request(f"{url}/metrics",
+                          headers=({"X-Netstore-Token": self._token}
+                                   if self._token else {}))
+        with urlopen(request, timeout=min(self.timeout, 5.0)) as resp:
+            return json.loads(resp.read())
+
+    def metrics_payload(self) -> dict:
+        """``GET /metrics``: the router's own snapshot plus a ``router``
+        section (per-shard liveness + summary) and ``merged`` (every
+        live shard's snapshot exactly merged).  A shard that does not
+        answer renders as degraded instead of failing the whole pull."""
+        snap = _metrics.registry().snapshot(states=True)
+        with self._lock:
+            doc = self._map.to_dict()
+        shards, members, n_workers = {}, [], 0
+        for sid, ent in doc["shards"].items():
+            info = {"url": ent["primary"], "replica": ent["replica"]}
+            try:
+                m = self._fetch_shard_metrics(ent["primary"])
+                info["ok"] = True
+                fleet = m.get("fleet") or {}
+                info["n_workers"] = fleet.get("n_workers", 0)
+                n_workers += info["n_workers"]
+                info["verb_calls"] = sum(
+                    v for k, v in (m.get("counters") or {}).items()
+                    if k.startswith("netstore.verb.")
+                    and k.endswith(".calls"))
+                info["alerts_firing"] = sum(
+                    1 for a in m.get("alerts", []) if a.get("firing"))
+                members.append(m)
+            except Exception as e:
+                info["ok"] = False
+                info["error"] = f"{type(e).__name__}: {e}"
+            shards[sid] = info
+        snap["router"] = {"version": doc["version"],
+                          "virtual_nodes": doc["virtual_nodes"],
+                          "n_shards": len(shards), "shards": shards}
+        merged = _metrics.merge_snapshots(members) if members else {}
+        snap["merged"] = merged
+        snap["fleet"] = {"n_workers": n_workers, "workers": {},
+                         "merged": merged}
+        return snap
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _parse_shard_spec(spec: str):
+    """``SID=PRIMARY_URL[,REPLICA_URL]`` -> (sid, entry)."""
+    if "=" not in spec:
+        raise ValueError(f"--shard {spec!r}: want "
+                         "SID=PRIMARY_URL[,REPLICA_URL]")
+    sid, _, urls = spec.partition("=")
+    primary, _, replica = urls.partition(",")
+    if not sid or not primary:
+        raise ValueError(f"--shard {spec!r}: want "
+                         "SID=PRIMARY_URL[,REPLICA_URL]")
+    return sid, {"primary": primary, "replica": replica or None}
+
+
+def main(argv=None):
+    """``python -m hyperopt_tpu.service.router --serve --shard
+    s0=http://...:8418,http://...:8428 ...``: front a shard fleet."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="hyperopt_tpu fleet router (consistent-hash front "
+                    "over ShardServer processes)")
+    p.add_argument("--serve", action="store_true", required=True)
+    p.add_argument("--shard", action="append", required=True,
+                   metavar="SID=PRIMARY[,REPLICA]",
+                   help="one shard's id, primary URL and optional warm "
+                        "replica URL (repeat per shard)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8419)
+    p.add_argument("--token", default=None,
+                   help="fleet credential: gates the router's own "
+                        "verbs/metrics and authenticates promote/scrub/"
+                        "rebalance calls to shards (tenant fleets: use "
+                        "a dedicated ops tenant's token)")
+    p.add_argument("--tenants-file", default=None,
+                   help="JSON tenant table: rejects unknown tokens at "
+                        "the edge and keys placement by tenant name")
+    p.add_argument("--virtual-nodes", type=int, default=None,
+                   help="ring points per shard (default: "
+                        "HYPEROPT_TPU_RING_VNODES or 64)")
+    p.add_argument("--cutover-window", type=float, default=None,
+                   metavar="S",
+                   help="bounded rebalance cutover window (default: "
+                        "HYPEROPT_TPU_CUTOVER_WINDOW_S or 5 s)")
+    p.add_argument("--flight-dir", default=None,
+                   help="arm the flight recorder for router postmortems "
+                        "(default: the HYPEROPT_TPU_FLIGHT_DIR env var)")
+    args = p.parse_args(argv)
+
+    shards = dict(_parse_shard_spec(s) for s in args.shard)
+    tenants = None
+    if args.tenants_file:
+        from .tenancy import TenantTable
+        tenants = TenantTable.from_file(args.tenants_file)
+
+    server = Router(shards, host=args.host, port=args.port,
+                    token=args.token, tenants=tenants,
+                    virtual_nodes=args.virtual_nodes,
+                    cutover_window_s=args.cutover_window)
+    print(f"router: serving {len(shards)} shard(s) at {server.url}",
+          flush=True)
+
+    import signal
+
+    def _on_sigterm(signo, frame):
+        raise SystemExit(0)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:              # not the main thread (embedded use)
+        pass
+    # Arm AFTER the SIGTERM handler so the flight handler chains it.
+    flight_dir = _flight.install(args.flight_dir)
+    if flight_dir:
+        print(f"router: flight recorder armed -> {flight_dir}",
+              flush=True)
+    try:
+        server.serve_forever()
+    except (KeyboardInterrupt, SystemExit):
+        pass
+    finally:
+        server.shutdown()
+        print("router: shut down", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

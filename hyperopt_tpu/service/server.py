@@ -444,42 +444,61 @@ class ServiceServer(StoreServer):
                 and self._wal.seq - self._snap_seq >= self._snapshot_every):
             self.snapshot()
 
+    def _load_state_payload(self, payload: dict) -> None:
+        """Install a full state payload (stores + idem cache) — the
+        snapshot half of recovery, and the replica's
+        ``snapshot_install`` verb.  Caller holds the lock (or runs
+        pre-start recovery, before any thread can race it)."""
+        self._trials.clear()
+        for s in payload.get("stores", []):
+            ft = self._store(s["exp_key"], tenant=s.get("tenant"))
+            ft.load_state(s["state"])
+        with self._idem_lock:
+            self._idem.clear()
+            for k, reply in payload.get("idem", []):
+                self._idem[tuple(k)] = (time.monotonic(), reply)
+
+    def _apply_record(self, rec: dict) -> dict:
+        """Re-execute one WAL record via the deterministic replay path:
+        the record's logged clock, the tenant as its bare name (quota
+        hooks absent by design), and the idempotency cache repopulated
+        from the outcome.  Caller holds the lock and has set
+        ``_replaying`` — recovery and the replica's ``wal_ship`` apply
+        both funnel through here, which is what keeps a replayed store
+        and a replicated store byte-identical."""
+        tname = rec.get("tenant")
+        req = dict(rec["req"], exp_key=rec["exp_key"])
+        ft = self._store(rec["exp_key"], tenant=tname)
+        ft.now_override = rec["t"]
+        try:
+            out = self._dispatch_verb(rec["verb"], req, tenant=tname)
+        finally:
+            ft.now_override = None
+        if rec.get("idem"):
+            if rec.get("orig") == "suggest":
+                # Reconstruct the client-visible suggest reply from
+                # the physical insert record.
+                out = {"docs": rec["req"]["docs"],
+                       "tids": out["tids"], "inserted": True}
+            self._idem_put((tname, rec["exp_key"], rec["idem"]),
+                           json.dumps(out))
+        return out
+
     def _recover(self) -> None:
         snap, records, n_torn = read_wal(self.wal_root)
         if snap is None and not records:
             return
         reg = _metrics.registry()
         if snap is not None:
-            for s in snap.get("stores", []):
-                ft = self._store(s["exp_key"], tenant=s.get("tenant"))
-                ft.load_state(s["state"])
-            with self._idem_lock:
-                for k, payload in snap.get("idem", []):
-                    self._idem[tuple(k)] = (time.monotonic(), payload)
+            self._load_state_payload(snap)
             self._wal.seq = snap["seq"]
         self._replaying = True
         try:
             for rec in records:
                 _faults.maybe_fail("wal.replay", verb=rec["verb"])
-                tname = rec.get("tenant")
-                req = dict(rec["req"], exp_key=rec["exp_key"])
-                ft = self._store(rec["exp_key"], tenant=tname)
-                ft.now_override = rec["t"]
-                try:
-                    out = self._dispatch_verb(rec["verb"], req,
-                                              tenant=tname)
-                finally:
-                    ft.now_override = None
+                self._apply_record(rec)
                 self._wal.seq = rec["seq"]
                 reg.counter("wal.replayed").inc()
-                if rec.get("idem"):
-                    if rec.get("orig") == "suggest":
-                        # Reconstruct the client-visible suggest reply
-                        # from the physical insert record.
-                        out = {"docs": rec["req"]["docs"],
-                               "tids": out["tids"], "inserted": True}
-                    self._idem_put((tname, rec["exp_key"], rec["idem"]),
-                                   json.dumps(out))
         finally:
             self._replaying = False
         self._snap_seq = self._wal.seq if snap is None else snap["seq"]

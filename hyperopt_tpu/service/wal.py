@@ -46,6 +46,7 @@ import time
 
 from .. import faults as _faults
 from ..exceptions import InjectedFault
+from ..obs import flight as _flight
 from ..obs import metrics as _metrics
 
 __all__ = ["Wal", "read_wal", "inspect"]
@@ -77,21 +78,33 @@ class Wal:
         self._since_sync = 0
         self._last_fsync_mono = time.monotonic()
         self.seq = 0                    # last seq handed out; set by recovery
+        #: Optional append fan-out hook: called with each record (seq
+        #: stamped) after it is durably written — the replication
+        #: shipper's feed.  Must be O(1)/no-IO: it runs under the
+        #: dispatch lock.
+        self.listener = None
 
-    def append(self, rec: dict) -> int:
-        """Serialize ``rec`` (gets ``seq`` assigned here), write + flush
-        per policy, and return the seq.  Raises before any byte is
-        written when a ``wal.write`` fault fires."""
+    def append(self, rec: dict, seq: int | None = None) -> int:
+        """Serialize ``rec`` (gets ``seq`` assigned here, unless a
+        replica forces the primary's), write + flush per policy, and
+        return the seq.  Raises before any byte is written when a
+        ``wal.write`` fault fires."""
         try:
             _faults.maybe_fail("wal.write", verb=rec.get("verb"))
         except InjectedFault:
             if os.environ.get(_CRASH_ENV) == "kill":
                 # Die at the append boundary with zero teardown — the
-                # SIGKILL the chaos suite uses to prove replay.
+                # SIGKILL the chaos suite uses to prove replay.  A
+                # SIGKILL runs no handlers, so the postmortem bundle is
+                # frozen HERE, before the shot (no-op when the flight
+                # recorder is disarmed).
                 self._fh.flush()
+                _flight.dump("wal-crash", force=True,
+                             extra={"trigger": "wal_crash",
+                                    "verb": rec.get("verb")})
                 os.kill(os.getpid(), signal.SIGKILL)
             raise
-        self.seq += 1
+        self.seq = self.seq + 1 if seq is None else int(seq)
         rec = dict(rec, seq=self.seq)
         line = json.dumps(rec, separators=(",", ":")) + "\n"
         self._fh.write(line)
@@ -111,6 +124,8 @@ class Wal:
         # append is (0 under fsync=always) — the wal_fsync_lag SLO feed.
         reg.gauge("wal.fsync_lag_s").set(
             time.monotonic() - self._last_fsync_mono)
+        if self.listener is not None:
+            self.listener(rec)
         return self.seq
 
     def snapshot(self, payload: dict) -> None:

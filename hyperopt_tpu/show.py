@@ -269,7 +269,10 @@ def fetch_metrics(url: str, token=None, timeout: float = 5.0) -> dict:
     """GET ``<url>/metrics`` from a netstore server (token-gated)."""
     import urllib.request
 
-    req = urllib.request.Request(url.rstrip("/") + "/metrics")
+    base = url.rstrip("/")
+    if not base.endswith("/metrics"):
+        base += "/metrics"
+    req = urllib.request.Request(base)
     if token:
         req.add_header("X-Netstore-Token", token)
     with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -311,6 +314,43 @@ def render_live(snap: dict, out=None, prev=None) -> dict:
             rate = f"   {d_done / dt:6.2f} trials/s"
     print(f"fleet: {fleet.get('n_workers', 0)} worker(s)   "
           f"trials done {done}{rate}", file=out)
+
+    # SHARDS: the fleet router's per-shard panel (snap["router"], present
+    # only when the URL polled is a service/router.py front).  Latency
+    # tails come from the router's own router.shard.<sid>.s forward
+    # histograms; a shard that did not answer the metrics pull renders
+    # as DOWN with the error instead of failing the frame.
+    router = snap.get("router")
+    if router is not None:
+        shards = router.get("shards", {})
+        print(f"router: {router.get('n_shards', len(shards))} shard(s)   "
+              f"map v{router.get('version', '?')}   forwarded "
+              f"{int(counters.get('router.forwarded', 0))}   failovers "
+              f"{int(counters.get('router.failovers', 0))}   rebalances "
+              f"{int(counters.get('router.rebalances', 0))}", file=out)
+        if shards:
+            r_hists = snap.get("histograms", {})
+            pct = lambda h, q: (f"{1e3 * h[q]:8.2f}"  # noqa: E731
+                                if h and h.get(q) is not None
+                                else f"{'-':>8s}")
+            print(f"  {'shard':<12s} {'status':<6s} {'workers':>7s} "
+                  f"{'calls':>8s} {'fwd':>6s} {'p50ms':>8s} {'p95ms':>8s} "
+                  f"{'p99ms':>8s}", file=out)
+            for sid in sorted(shards):
+                info = shards[sid]
+                h = r_hists.get(f"router.shard.{sid}.s") or {}
+                fwd = int(h.get("count", 0))
+                if info.get("ok"):
+                    print(f"  {sid:<12s} {'ok':<6s} "
+                          f"{int(info.get('n_workers', 0)):>7d} "
+                          f"{int(info.get('verb_calls', 0)):>8d} "
+                          f"{fwd:>6d} {pct(h, 'p50')} {pct(h, 'p95')} "
+                          f"{pct(h, 'p99')}", file=out)
+                else:
+                    print(f"  {sid:<12s} {'DOWN':<6s} {'-':>7s} {'-':>8s} "
+                          f"{fwd:>6d} {pct(h, 'p50')} {pct(h, 'p95')} "
+                          f"{pct(h, 'p99')}  "
+                          f"{info.get('error', '?')}", file=out)
     occ = gauges.get("pipeline.occupancy", m_gauges.get("pipeline.occupancy"))
     backlog = gauges.get("pipeline.eval_backlog",
                          m_gauges.get("pipeline.eval_backlog"))

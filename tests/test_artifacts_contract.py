@@ -370,6 +370,57 @@ class TestBenchmarkArtifacts:
             assert doc["wal"]["appends"] > 0, name
             assert doc["wal"]["torn_tail"] == 0, name
 
+    def test_service_shard_load_artifact_schema(self):
+        """ISSUE 13 acceptance artifact: ≥10k open-loop simulated
+        workers over a ≥4-shard consistent-hash fleet surviving a
+        kill-and-promote schedule with exactly-once trial accounting —
+        written by benchmarks/service_shard_load.py."""
+        paths = sorted(glob.glob(os.path.join(
+            _BENCH_DIR, "service_shard_load_*.json")))
+        assert paths, \
+            "no benchmarks/service_shard_load_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "service_shard_load_openloop", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            # the worker cycle AND the replication plane must both have
+            # been exercised (shipping, promotion after the kills)
+            verbs = {r["verb"] for r in doc["rows"]}
+            assert {"reserve", "write_result", "wal_ship",
+                    "promote"} <= verbs, name
+            for r in doc["rows"]:
+                assert {"verb", "count", "p50_ms", "p95_ms",
+                        "p99_ms"} <= set(r), f"{name}: {r}"
+                assert r["count"] > 0, f"{name}: {r}"
+                assert 0 <= r["p50_ms"] <= r["p95_ms"] <= r["p99_ms"], \
+                    f"{name}: {r}"
+            # every store ended on the shard the ring owns, with its
+            # full contiguous tid range and zero duplicates
+            assert len(doc["shards"]) >= 4, name
+            for s in doc["shards"]:
+                assert s["placement_ok"] is True, f"{name}: {s}"
+            for k in doc["exp_keys"]:
+                assert k["dups"] == 0, f"{name}: {k}"
+                assert k["tid_range_ok"] is True, f"{name}: {k}"
+                assert k["stamp_leaks"] == 0, f"{name}: {k}"
+            ol = doc["open_loop"]
+            assert ol["cycles"] > 0, name
+            assert 0 <= ol["p50_ms"] <= ol["p95_ms"] <= ol["p99_ms"], name
+            head = doc["headline"]
+            assert head["workers"] >= 10_000, name
+            assert head["shards"] >= 4, name
+            assert head["kills"] >= 2, (
+                f"{name}: chaos too gentle — "
+                f"{head['kills']} < 2 primary kills")
+            assert head["promotions"] >= head["kills"], name
+            assert head["completed"] is True, name
+            assert head["zero_lost_dup"] is True, (
+                f"{name}: lost or duplicated trials across failover")
+            assert head["zero_leakage"] is True, name
+
     def test_algo_zoo_ab_artifact_schema(self):
         """ISSUE 10 acceptance artifact: per-head best-loss sweep over the
         5-domain zoo x 20 seeds through the backend registry, with

@@ -1,0 +1,736 @@
+"""Sharded, replicated service fleet: router, WAL shipping, failover.
+
+Covers the fleet subsystem end to end:
+
+* the consistent-hash ring: pinned (restart-stable) placement hash,
+  add/remove moving only ~K/N keys, cross-instance determinism, and the
+  :class:`ShardMap` promote/rebalance/version mechanics;
+* WAL shipping primary -> warm replica (snapshot install + contiguous
+  tail, dup drop, gap resync) proving **byte-identical** stores via the
+  scrub protocol (``state_bytes`` hash at equal seq);
+* replica fencing (client WAL verbs refused until promotion) and
+  idempotency-cache repopulation from shipped records — the
+  exactly-once half of failover;
+* the router: placement + raw-body forwarding (idempotency keys and
+  trace context ride through), cross-tenant isolation through the
+  fleet, failover promotion, live rebalance with bounded cutover;
+* ``show live`` per-shard panel rendering, including degraded (DOWN)
+  shards;
+* chaos: a real shard primary SIGKILLed at the WAL append boundary
+  (quick smoke, plus a seeded multi-kill schedule under ``-m slow``),
+  proving zero lost/duplicated tids and a spliceable flight bundle.
+"""
+
+import io
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from hyperopt_tpu import base, faults, show
+from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+from hyperopt_tpu.exceptions import NetstoreUnavailable
+from hyperopt_tpu.obs import bundle as obs_bundle
+from hyperopt_tpu.obs import context as obs_context
+from hyperopt_tpu.obs import flight as obs_flight
+from hyperopt_tpu.obs import metrics as _metrics
+from hyperopt_tpu.obs.bundle import state_hash
+from hyperopt_tpu.obs.events import EVENTS
+from hyperopt_tpu.parallel.netstore import (
+    NetTrials,
+    RouterTrials,
+    _Rpc,
+)
+from hyperopt_tpu.service import Tenant, TenantTable
+from hyperopt_tpu.service.cluster import HashRing, ShardMap, key_hash
+from hyperopt_tpu.service.replica import ShardServer, WalShipper
+from hyperopt_tpu.service.router import Router, _parse_shard_spec
+
+
+@pytest.fixture(autouse=True)
+def _clean_fleet_state():
+    faults.clear()
+    EVENTS.disable()
+    EVENTS.clear()
+    yield
+    faults.clear()
+    obs_flight.uninstall()
+    obs_context.disable()
+    EVENTS.disable()
+    EVENTS.clear()
+
+
+def _counter(name: str) -> float:
+    return _metrics.registry().snapshot().get("counters", {}).get(name, 0)
+
+
+def _mk_docs(tids, exp_key, xs):
+    docs = []
+    for tid, x in zip(tids, xs):
+        d = base.new_trial_doc(tid, exp_key, None)
+        d["misc"]["idxs"] = {"x": [tid]}
+        d["misc"]["vals"] = {"x": [float(x)]}
+        docs.append(d)
+    return docs
+
+
+def _complete(doc, loss):
+    doc["state"] = JOB_STATE_DONE
+    doc["result"] = {"status": STATUS_OK, "loss": float(loss)}
+    return doc
+
+
+def _flush_all(servers):
+    for s in servers:
+        for sh in getattr(s, "_shippers", []):
+            sh.flush()
+
+
+def _scrub_pair(primary, replica):
+    """(primary seq/hash, replica seq/hash) under each server's lock."""
+    with primary._lock:
+        p = (primary._wal.seq, state_hash(primary.state_bytes()))
+    with replica._lock:
+        r = (replica._wal.seq, state_hash(replica.state_bytes()))
+    return p, r
+
+
+class _Fleet:
+    """In-process fleet: N shards (primary + warm replica each) + router."""
+
+    def __init__(self, tmp, n_shards=2, replicas=True, tenants=None,
+                 token=None, **router_kw):
+        self.servers = []
+        shards = {}
+        kw = {"token": token} if token else {}
+        if tenants is not None:
+            kw["tenants"] = tenants
+        for i in range(n_shards):
+            prim = ShardServer(wal_dir=os.path.join(tmp, f"s{i}p"),
+                               role="primary", **kw)
+            prim.start()
+            entry = {"primary": prim.url, "replica": None}
+            self.servers.append(prim)
+            if replicas:
+                repl = ShardServer(wal_dir=os.path.join(tmp, f"s{i}r"),
+                                   role="replica", **kw)
+                repl.start()
+                prim.attach_replica(repl.url)
+                entry["replica"] = repl.url
+                self.servers.append(repl)
+            shards[f"s{i}"] = entry
+        self.router = Router(shards, retries=1, backoff=0.01,
+                             token=token, tenants=tenants, **router_kw)
+        self.router.start()
+
+    def primary(self, i):
+        return self.servers[2 * i]
+
+    def replica(self, i):
+        return self.servers[2 * i + 1]
+
+    def shutdown(self):
+        self.router.shutdown()
+        for s in self.servers:
+            s.shutdown()
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    f = _Fleet(str(tmp_path))
+    yield f
+    f.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash ring + shard map
+# ---------------------------------------------------------------------------
+
+
+class TestHashRing:
+    def test_placement_hash_is_pinned(self):
+        """The placement hash is a restart-stable SHA-1 prefix — these
+        literals were computed by a DIFFERENT process; any drift here
+        would reshuffle every deployed fleet's stores on upgrade."""
+        assert key_hash("acme", "exp-1") == 12520065837424943749
+        assert key_hash(None, "default") == 13597278764869630297
+        # None tenant hashes as the empty name (single-tenant fleets)
+        assert key_hash(None, "e") == key_hash("", "e")
+        # NUL separator: concatenation cannot collide across the split
+        assert key_hash("ab", "c") != key_hash("a", "bc")
+
+    def test_owner_deterministic_across_instances(self):
+        """Same shard set -> same owners, regardless of insertion order
+        or process (pinned literal from a separate run)."""
+        a = HashRing(["s0", "s1", "s2"])
+        b = HashRing([])
+        for sid in ["s2", "s0", "s1"]:
+            b.add(sid)
+        keys = [(f"t{i}", f"e{i % 7}") for i in range(200)]
+        assert [a.owner(*k) for k in keys] == [b.owner(*k) for k in keys]
+        assert [a.owner(f"t{i}", "e") for i in range(6)] == \
+            ["s2", "s0", "s0", "s0", "s1", "s2"]
+
+    def test_resize_moves_about_k_over_n_keys(self):
+        """Adding a 5th shard to 4 moves ~K/5 of K keys — never a full
+        reshuffle; removing it again restores the exact old placement."""
+        keys = [(f"tenant{i % 13}", f"exp{i}") for i in range(2000)]
+        ring = HashRing([f"s{i}" for i in range(4)])
+        before = [ring.owner(*k) for k in keys]
+        ring.add("s4")
+        after = [ring.owner(*k) for k in keys]
+        moved = sum(1 for b, a in zip(before, after) if b != a)
+        # expected 1/5 = 400; generous band still rules out reshuffles
+        assert 0.05 * len(keys) < moved < 0.35 * len(keys)
+        # every moved key moved TO the new shard, nowhere else
+        assert all(a == "s4" for b, a in zip(before, after) if b != a)
+        ring.remove("s4")
+        assert [ring.owner(*k) for k in keys] == before
+
+    def test_empty_ring_raises(self):
+        with pytest.raises(ValueError, match="empty hash ring"):
+            HashRing([]).owner("t", "e")
+        with pytest.raises(ValueError, match="at least one shard"):
+            ShardMap({})
+
+    def test_shard_map_promote_and_versions(self):
+        m = ShardMap({"s0": {"primary": "http://a/", "replica": "http://b"},
+                      "s1": {"primary": "http://c", "replica": None}})
+        assert m.version == 1
+        assert m.shards["s0"]["primary"] == "http://a"   # rstripped
+        ent = m.promote("s0")
+        assert ent == {"primary": "http://b", "replica": None}
+        assert m.version == 2
+        with pytest.raises(ValueError, match="no replica"):
+            m.promote("s1")
+        m.set_primary("s1", "http://d", replica="http://e")
+        assert m.version == 3
+        doc = m.to_dict()
+        m2 = ShardMap.from_dict(doc)
+        assert m2.to_dict() == doc
+        # owners survive the wire round-trip
+        assert m2.owner("t", "e") == m.owner("t", "e")
+
+
+# ---------------------------------------------------------------------------
+# replication: shipping, scrub byte-identity, fencing, idem repopulation
+# ---------------------------------------------------------------------------
+
+
+class TestReplication:
+    def test_tail_ship_scrub_byte_identity(self, tmp_path):
+        """Records shipped primary->replica replay through the same
+        deterministic path as crash recovery: stores byte-identical at
+        equal seq, continuously assertable by scrub."""
+        prim = ShardServer(wal_dir=str(tmp_path / "p"), role="primary")
+        repl = ShardServer(wal_dir=str(tmp_path / "r"), role="replica")
+        prim.start(), repl.start()
+        try:
+            prim.attach_replica(repl.url)
+            nt = NetTrials(prim.url, exp_key="e1")
+            tids = nt.new_trial_ids(3)
+            nt._insert_trial_docs(_mk_docs(tids, "e1", [0.1, 0.2, 0.3]))
+            doc = nt.reserve("w0")
+            assert nt.write_result(_complete(doc, 0.5), owner="w0")
+            _flush_all([prim])
+            p, r = _scrub_pair(prim, repl)
+            assert p == r
+            # the shipper's own scrub agrees and counts it
+            before = _counter("replica.scrub.ok")
+            prim._shippers[0]._scrub_once()
+            assert _counter("replica.scrub.ok") == before + 1
+        finally:
+            prim.shutdown(), repl.shutdown()
+
+    def test_late_attach_installs_snapshot_then_tail(self, tmp_path):
+        """A replica attached mid-life gets snapshot-install + tail, not
+        a from-zero replay — and still lands byte-identical."""
+        prim = ShardServer(wal_dir=str(tmp_path / "p"), role="primary")
+        prim.start()
+        repl = ShardServer(wal_dir=str(tmp_path / "r"), role="replica")
+        repl.start()
+        try:
+            nt = NetTrials(prim.url, exp_key="e1")
+            tids = nt.new_trial_ids(2)
+            nt._insert_trial_docs(_mk_docs(tids, "e1", [0.1, 0.2]))
+            prim.attach_replica(repl.url)           # snapshot path
+            _flush_all([prim])
+            assert _counter("replica.installs") >= 1
+            nt._insert_trial_docs(_mk_docs(nt.new_trial_ids(1), "e1",
+                                           [0.3]))  # tail path
+            _flush_all([prim])
+            p, r = _scrub_pair(prim, repl)
+            assert p == r
+        finally:
+            prim.shutdown(), repl.shutdown()
+
+    def test_replica_fences_client_wal_verbs(self, tmp_path):
+        """A warm replica refuses client mutations (they would fork it
+        from the primary); reads stay open; promotion lifts the fence."""
+        repl = ShardServer(wal_dir=str(tmp_path / "r"), role="replica")
+        repl.start()
+        try:
+            nt = NetTrials(repl.url, exp_key="e1", retries=0)
+            before = _counter("shard.fenced")
+            with pytest.raises(RuntimeError, match="replica"):
+                nt.new_trial_ids(1)
+            assert _counter("shard.fenced") == before + 1
+            nt.refresh()                            # reads pass
+            _Rpc(repl.url, "e1")("promote")
+            assert repl.role == "primary"
+            assert nt.new_trial_ids(1) == [0]       # fence lifted
+        finally:
+            repl.shutdown()
+
+    def test_shipped_records_repopulate_idem_cache(self, tmp_path):
+        """The idempotency key rides the shipped record, so a client
+        retry that lands on the PROMOTED replica dedupes instead of
+        double-executing — the exactly-once half of failover."""
+        prim = ShardServer(wal_dir=str(tmp_path / "p"), role="primary")
+        repl = ShardServer(wal_dir=str(tmp_path / "r"), role="replica")
+        prim.start(), repl.start()
+        try:
+            prim.attach_replica(repl.url)
+            rpc = _Rpc(prim.url, "e1")
+            docs = _mk_docs([7], "e1", [0.5])
+            out1 = rpc("insert_docs", docs=docs, idem="pinned-key-1")
+            _flush_all([prim])
+            _Rpc(repl.url, "e1")("promote")
+            out2 = _Rpc(repl.url, "e1")(
+                "insert_docs", docs=docs, idem="pinned-key-1")
+            assert out2 == out1                     # cached reply
+            repl_nt = NetTrials(repl.url, exp_key="e1")
+            repl_nt.refresh()
+            assert [d["tid"] for d in repl_nt.trials] == [7]  # no dupe
+        finally:
+            prim.shutdown(), repl.shutdown()
+
+    def test_gap_forces_resync(self, tmp_path):
+        """A non-contiguous shipped batch is refused with resync=True
+        (never applied out of order); the shipper then snapshots."""
+        repl = ShardServer(wal_dir=str(tmp_path / "r"), role="replica")
+        repl.start()
+        try:
+            rpc = _Rpc(repl.url, "__replica__")
+            rec = {"t": "2026-01-01T00:00:00Z", "verb": "new_trial_ids",
+                   "tenant": None, "exp_key": "e1", "req": {"n": 1},
+                   "idem": None, "seq": 5}
+            out = rpc("wal_ship", records=[rec], from_seq=5)
+            assert out["resync"] is True and out["applied"] == 0
+            assert _counter("replica.gaps") >= 1
+        finally:
+            repl.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# router: placement, isolation, forwarding, metrics
+# ---------------------------------------------------------------------------
+
+
+class TestRouterPlacement:
+    def test_stores_land_only_on_owning_shard(self, tmp_path):
+        """Every (tenant, exp_key) store materializes exactly on the
+        shard the ring assigns it — no verb ever reaches a non-owner."""
+        f = _Fleet(str(tmp_path), n_shards=3, replicas=False)
+        try:
+            exp_keys = [f"exp{i}" for i in range(12)]
+            for ek in exp_keys:
+                t = RouterTrials(f.router.url, exp_key=ek)
+                t._insert_trial_docs(_mk_docs(t.new_trial_ids(1), ek,
+                                              [0.1]))
+            ring = HashRing([f"s{i}" for i in range(3)])
+            for i in range(3):
+                srv = f.servers[i]
+                with srv._lock:
+                    stored = {ek for (_, ek) in srv._trials}
+                expect = {ek for ek in exp_keys
+                          if ring.owner(None, ek) == f"s{i}"}
+                assert stored == expect
+        finally:
+            f.shutdown()
+
+    def test_forwarding_through_router_data_path(self, tmp_path):
+        """A plain NetTrials pointed at the ROUTER works end to end:
+        bodies (idem keys included) forward verbatim to the owner."""
+        f = _Fleet(str(tmp_path), n_shards=2, replicas=False)
+        try:
+            nt = NetTrials(f.router.url, exp_key="e1")
+            tids = nt.new_trial_ids(2)
+            nt._insert_trial_docs(_mk_docs(tids, "e1", [0.1, 0.2]))
+            doc = nt.reserve("w0")
+            assert nt.write_result(_complete(doc, 1.0), owner="w0")
+            nt.refresh()
+            assert len(nt.trials) == 2
+            assert _counter("router.forwarded") >= 5
+        finally:
+            f.shutdown()
+
+    def test_cross_tenant_isolation_through_router(self, tmp_path):
+        """Two tenants, same exp_key: distinct ring keys, distinct
+        stores, zero cross-visibility through the fleet."""
+        table = TenantTable([Tenant("acme", "tok-a"),
+                             Tenant("zeta", "tok-z"),
+                             Tenant("ops", "tok-ops")])
+        f = _Fleet(str(tmp_path), n_shards=2, replicas=False,
+                   tenants=table, token="tok-ops")
+        try:
+            ta = RouterTrials(f.router.url, exp_key="e", token="tok-a")
+            tz = RouterTrials(f.router.url, exp_key="e", token="tok-z")
+            assert ta._rpc.tenant == "acme" and tz._rpc.tenant == "zeta"
+            ta._insert_trial_docs(_mk_docs(ta.new_trial_ids(2), "e",
+                                           [0.1, 0.2]))
+            tz._insert_trial_docs(_mk_docs(tz.new_trial_ids(1), "e",
+                                           [0.9]))
+            ta.refresh(), tz.refresh()
+            assert len(ta.trials) == 2 and len(tz.trials) == 1
+            vals = [d["misc"]["vals"]["x"][0] for d in tz.trials]
+            assert vals == [0.9]
+            # unknown token is rejected at the edge
+            with pytest.raises(RuntimeError, match="AuthError"):
+                _Rpc(f.router.url, "e", token="bogus")("shard_map")
+        finally:
+            f.shutdown()
+
+    def test_metrics_merged_and_degraded_shard(self, tmp_path):
+        """GET /metrics merges live shards and marks dead ones DOWN
+        (degraded, not an error); `show live` renders both."""
+        f = _Fleet(str(tmp_path), n_shards=2, replicas=False)
+        try:
+            nt = NetTrials(f.router.url, exp_key="e1")
+            nt.new_trial_ids(1)
+            f.servers[1]._httpd.shutdown()          # kill s1, keep s0
+            f.servers[1]._httpd.server_close()
+            snap = f.router.metrics_payload()
+            r = snap["router"]
+            assert r["n_shards"] == 2
+            oks = {sid: info["ok"] for sid, info in r["shards"].items()}
+            assert sorted(oks.values()) == [False, True]
+            down = [i for i in r["shards"].values() if not i["ok"]][0]
+            assert "error" in down
+            assert "merged" in snap and "counters" in snap["merged"]
+            buf = io.StringIO()
+            show.render_live(snap, out=buf)
+            text = buf.getvalue()
+            assert "router: 2 shard(s)" in text
+            assert "DOWN" in text and "ok" in text
+        finally:
+            f.shutdown()
+
+    def test_render_live_empty_and_routerless_snapshots(self):
+        """The dashboard degrades cleanly: no router section -> no shard
+        panel; a router section with zero reachable shards still
+        renders a frame."""
+        buf = io.StringIO()
+        show.render_live({}, out=buf)
+        assert "fleet: 0 worker(s)" in buf.getvalue()
+        assert "router:" not in buf.getvalue()
+        buf = io.StringIO()
+        show.render_live(
+            {"router": {"version": 4, "n_shards": 1, "shards": {
+                "s0": {"url": "http://x", "replica": None, "ok": False,
+                       "error": "URLError: refused"}}}}, out=buf)
+        text = buf.getvalue()
+        assert "router: 1 shard(s)" in text and "map v4" in text
+        assert "DOWN" in text and "URLError: refused" in text
+
+    def test_parse_shard_spec(self):
+        assert _parse_shard_spec("s0=http://a,http://b") == \
+            ("s0", {"primary": "http://a", "replica": "http://b"})
+        assert _parse_shard_spec("s1=http://c") == \
+            ("s1", {"primary": "http://c", "replica": None})
+        with pytest.raises(ValueError, match="--shard"):
+            _parse_shard_spec("nourl")
+
+
+# ---------------------------------------------------------------------------
+# failover + rebalance (in-process)
+# ---------------------------------------------------------------------------
+
+
+class TestFailover:
+    def test_kill_primary_promotes_replica_exactly_once(self, fleet,
+                                                        monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.01")
+        t = RouterTrials(fleet.router.url, exp_key="e1", retries=1)
+        sid = t.shard_id
+        i = int(sid[1:])
+        tids = t.new_trial_ids(2)
+        t._insert_trial_docs(_mk_docs(tids, "e1", [0.1, 0.2]))
+        _flush_all(fleet.servers)
+        # hard-kill the owning primary's sockets (no clean teardown)
+        prim, repl = fleet.primary(i), fleet.replica(i)
+        prim._httpd.shutdown()
+        prim._httpd.server_close()
+        # client's next mutation reroutes through the router -> promote
+        doc = t.reserve("w0")
+        assert t.write_result(_complete(doc, 1.0), owner="w0")
+        assert repl.role == "primary"
+        assert _counter("router.failovers") >= 1
+        assert _counter("netstore.client.reroutes") >= 1
+        t.refresh()
+        seen = [d["tid"] for d in t.trials]
+        assert sorted(seen) == sorted(tids)          # zero lost
+        assert len(seen) == len(set(seen))           # zero duplicated
+        # client re-placed itself onto the promoted replica
+        assert t._rpc.url == repl.url
+
+    def test_failover_without_replica_surfaces_unavailable(self,
+                                                           tmp_path):
+        f = _Fleet(str(tmp_path), n_shards=1, replicas=False)
+        try:
+            nt = NetTrials(f.router.url, exp_key="e1", retries=1)
+            f.servers[0]._httpd.shutdown()
+            f.servers[0]._httpd.server_close()
+            with pytest.raises((NetstoreUnavailable, RuntimeError)):
+                nt.new_trial_ids(1)
+        finally:
+            f.shutdown()
+
+    def test_failback_rejoin_is_byte_identical(self, fleet):
+        """After a promotion, the OLD primary's recovered WAL dir can
+        rejoin as the NEW primary's replica (replica_attach) and scrub
+        back to byte-identity — the post-failover identity proof."""
+        t = RouterTrials(fleet.router.url, exp_key="e1")
+        i = int(t.shard_id[1:])
+        t._insert_trial_docs(_mk_docs(t.new_trial_ids(2), "e1",
+                                      [0.1, 0.2]))
+        _flush_all(fleet.servers)
+        prim, repl = fleet.primary(i), fleet.replica(i)
+        prim._httpd.shutdown()
+        prim._httpd.server_close()
+        t.reserve("w0")                              # forces promotion
+        assert repl.role == "primary"
+        # more writes after the promotion, then failback
+        t._insert_trial_docs(_mk_docs(t.new_trial_ids(1), "e1", [0.3]))
+        rejoin = ShardServer(wal_dir=prim.wal_root + "-rejoin",
+                             role="replica")
+        rejoin.start()
+        try:
+            _Rpc(repl.url, "e1")("replica_attach", url=rejoin.url)
+            _flush_all([repl])
+            p, r = _scrub_pair(repl, rejoin)
+            assert p == r
+        finally:
+            rejoin.shutdown()
+
+
+class TestRebalance:
+    def test_rebalance_moves_shard_byte_identically(self, tmp_path):
+        f = _Fleet(str(tmp_path), n_shards=1, replicas=False)
+        new = ShardServer(wal_dir=str(tmp_path / "new"), role="replica")
+        new.start()
+        try:
+            t = RouterTrials(f.router.url, exp_key="e1",
+                             map_refresh_s=0.0)
+            t._insert_trial_docs(_mk_docs(t.new_trial_ids(3), "e1",
+                                          [0.1, 0.2, 0.3]))
+            out = _Rpc(f.router.url, "e1")("rebalance", shard="s0",
+                                           url=new.url)
+            assert out["primary"] == new.url
+            assert out["cutover_ms"] < 5000.0        # bounded window
+            assert new.role == "primary"
+            p, r = _scrub_pair(f.servers[0], new)
+            assert p == r                            # byte-identical move
+            assert _counter("router.rebalances") >= 1
+            # client re-places onto the new process and keeps working
+            doc = t.reserve("w0")
+            assert t._rpc.url == new.url
+            assert t.write_result(_complete(doc, 1.0), owner="w0")
+        finally:
+            new.shutdown()
+            f.shutdown()
+
+    def test_rebalance_unknown_shard_is_an_error(self, tmp_path):
+        f = _Fleet(str(tmp_path), n_shards=1, replicas=False)
+        try:
+            with pytest.raises(RuntimeError, match="unknown shard"):
+                _Rpc(f.router.url, "e1")("rebalance", shard="nope",
+                                         url="http://x")
+        finally:
+            f.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: subprocess SIGKILL mid-verb -> promote -> exactly-once + bundle
+# ---------------------------------------------------------------------------
+
+
+def _launch_shard(args, env=None):
+    """Start ``python -m hyperopt_tpu.service.replica`` and parse its URL."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hyperopt_tpu.service.replica",
+         "--serve"] + args,
+        env=dict(os.environ, JAX_PLATFORMS="cpu", **(env or {})),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    url = None
+    deadline = time.time() + 45
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        m = re.search(r"shard: serving .* at (http://\S+)", line)
+        if m:
+            url = m.group(1)
+            break
+        if proc.poll() is not None:
+            pytest.fail(f"shard died on startup: {proc.stdout.read()}")
+    assert url, "shard never printed its URL"
+    return proc, url
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait(timeout=10)
+    proc.stdout.close()
+
+
+@pytest.mark.chaos
+class TestChaosFleetKill:
+    def test_sigkill_primary_failover_exactly_once_and_bundle(
+            self, tmp_path, monkeypatch):
+        """Quick smoke (seconds, not minutes): a real shard primary is
+        SIGKILLed AT the WAL append boundary of a forwarded verb.  The
+        router promotes the warm replica; the client's pinned idem key
+        + shipped records make the retried verb exactly-once (zero
+        lost/duplicated tids); the killed process's flight bundle is
+        spliceable into the merged trace by the client's trace id."""
+        monkeypatch.setenv("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.01")
+        flight_dir = str(tmp_path / "flight")
+        rp, rurl = _launch_shard(
+            ["--wal-dir", str(tmp_path / "r"), "--role", "replica"])
+        # appends: 1 new_trial_ids + 1 insert_docs + (reserve, write)
+        # pairs -> @4 fires at the 5th append, a mid-run write_result.
+        pp, purl = _launch_shard(
+            ["--wal-dir", str(tmp_path / "p"), "--role", "primary",
+             "--replicate-to", rurl, "--flight-dir", flight_dir],
+            env={"HYPEROPT_TPU_WAL_CRASH": "kill",
+                 "HYPEROPT_TPU_FAULTS": "wal.write=1.0:1@4"})
+        router = Router({"s0": {"primary": purl, "replica": rurl}},
+                        retries=1, backoff=0.01)
+        router.start()
+        try:
+            obs_context.enable()
+            trace_id = obs_context.new_trace_id()
+            with obs_context.bind(trace_id=trace_id):
+                t = RouterTrials(router.url, exp_key="e1", retries=1)
+                tids = t.new_trial_ids(4)
+                t._insert_trial_docs(_mk_docs(tids, "e1",
+                                              [0.1, 0.2, 0.3, 0.4]))
+                for _ in range(4):
+                    doc = t.reserve("w0")
+                    assert t.write_result(_complete(doc, 1.0),
+                                          owner="w0")
+            assert pp.wait(timeout=20) == -signal.SIGKILL
+            assert _counter("router.failovers") >= 1
+
+            # exactly-once across the kill: all four trials done, none
+            # lost, none duplicated
+            t.refresh()
+            seen = [d["tid"] for d in t.trials]
+            assert sorted(seen) == [0, 1, 2, 3]
+            assert len(seen) == len(set(seen))
+            assert all(d["state"] == JOB_STATE_DONE for d in t.trials)
+
+            # the SIGKILLed process froze a bundle before the shot...
+            bundles = [p for p in os.listdir(flight_dir)
+                       if p.startswith("bundle-")]
+            assert len(bundles) == 1
+            bdir = os.path.join(flight_dir, bundles[0])
+            payload = obs_bundle.read_bundle(bdir)
+            assert payload["manifest"]["reason"] == "wal-crash"
+            assert payload["manifest"]["extra"]["trigger"] == "wal_crash"
+            # ...whose events carry the CLIENT's trace id (the context
+            # forwarded through the router, adopted by the shard)
+            traced = {e.get("trace_id") for e in payload["events"]}
+            assert trace_id in traced
+            # ...and it splices into a merged trace as a lane
+            buf = io.StringIO()
+            doc = show.merge_traces([bdir], out=buf)
+            assert doc["otherData"]["n_lanes"] == 1
+            assert "missing" not in buf.getvalue()
+            assert trace_id in json.dumps(doc)
+        finally:
+            router.shutdown()
+            _stop(pp), _stop(rp)
+
+    @pytest.mark.slow
+    def test_seeded_kill_schedule_long(self, tmp_path, monkeypatch):
+        """Seeded long schedule: three successive primary generations
+        on one shard (kill -> promote -> fresh standby rejoins -> kill
+        again), driving a deterministic verb stream throughout.
+        Invariant after every round: zero lost/duplicated tids; final
+        state proven byte-identical by scrubbing a fresh rejoiner."""
+        monkeypatch.setenv("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.01")
+        by_url = {}
+        rp, rurl = _launch_shard(
+            ["--wal-dir", str(tmp_path / "r0"), "--role", "replica"])
+        pp, purl = _launch_shard(
+            ["--wal-dir", str(tmp_path / "p0"), "--role", "primary",
+             "--replicate-to", rurl])
+        by_url[rurl], by_url[purl] = rp, pp
+        router = Router({"s0": {"primary": purl, "replica": rurl}},
+                        retries=1, backoff=0.01)
+        router.start()
+
+        def _catch_up(src_url, dst_url, require_hash=False):
+            a, b = _Rpc(src_url, "e1"), _Rpc(dst_url, "e1")
+            deadline = time.time() + 30
+            while True:
+                sa, sb = a("scrub"), b("scrub")
+                if sa["seq"] == sb["seq"] and (
+                        not require_hash or sa["hash"] == sb["hash"]):
+                    return sa, sb
+                assert time.time() < deadline, "standby never caught up"
+                time.sleep(0.05)
+
+        try:
+            t = RouterTrials(router.url, exp_key="e1", retries=1,
+                             map_refresh_s=0.0)
+            expected = []
+            n_rounds = 3
+            for round_no in range(n_rounds):
+                for _ in range(6):
+                    tid = t.new_trial_ids(1)[0]
+                    t._insert_trial_docs(_mk_docs([tid], "e1",
+                                                  [0.1 * (tid + 1)]))
+                    expected.append(tid)
+                t.refresh()
+                seen = [d["tid"] for d in t.trials]
+                assert sorted(seen) == sorted(expected)   # zero lost
+                assert len(seen) == len(set(seen))        # zero dupes
+                if round_no == n_rounds - 1:
+                    break
+                # fresh standby joins whatever is primary now, catches
+                # up, then the primary is SIGKILLed at a deterministic
+                # stream position -> next round starts with a failover
+                np_, nurl = _launch_shard(
+                    ["--wal-dir", str(tmp_path / f"j{round_no}"),
+                     "--role", "replica"])
+                by_url[nurl] = np_
+                cur = router.shard_for(None, "e1")[1]["primary"]
+                _Rpc(cur, "e1")("replica_attach", url=nurl)
+                with router._lock:
+                    router._map.shards["s0"]["replica"] = nurl
+                _catch_up(cur, nurl)
+                os.kill(by_url[cur].pid, signal.SIGKILL)
+                assert by_url[cur].wait(timeout=10) == -signal.SIGKILL
+
+            # byte-identity of the surviving generation: a brand-new
+            # rejoiner scrubs to the same (seq, hash)
+            cur = router.shard_for(None, "e1")[1]["primary"]
+            fp, furl = _launch_shard(
+                ["--wal-dir", str(tmp_path / "final"),
+                 "--role", "replica"])
+            by_url[furl] = fp
+            _Rpc(cur, "e1")("replica_attach", url=furl)
+            sa, sb = _catch_up(cur, furl, require_hash=True)
+            assert sa["hash"] == sb["hash"]
+            assert _counter("router.failovers") >= 2
+        finally:
+            router.shutdown()
+            for p in by_url.values():
+                _stop(p)

@@ -1,12 +1,16 @@
 """Invariant analyzer suite — static checks gating tier-1.
 
-Four AST-based checkers over the package (see each module's docstring
+Eight AST-based checkers over the package (see each module's docstring
 for the rule catalog):
 
-* :mod:`.jit_purity`      JP001–JP005 — trace-time purity of jit/vmap paths
-* :mod:`.lock_order`      LK001–LK003 — lock discipline in threaded layers
-* :mod:`.registry_drift`  RD001–RD010 — env/fault/verb/metric/SLO catalogs
-* :mod:`.artifacts`       AH001       — benchmark artifact schema guards
+* :mod:`.jit_purity`          JP001–JP005 — trace-time purity of jit/vmap paths
+* :mod:`.lock_order`          LK001–LK003 — lock discipline in threaded layers
+* :mod:`.registry_drift`      RD001–RD010 — env/fault/verb/metric/SLO catalogs
+* :mod:`.artifacts`           AH001       — benchmark artifact schema guards
+* :mod:`.wire_protocol`       WP001–WP006 — client/dispatcher/WAL coherence
+* :mod:`.replay_determinism`  RT001–RT004 — no nondeterminism on WAL replay
+* :mod:`.exception_safety`    ES001–ES003 — release/surface/start discipline
+* :mod:`.fault_coverage`      FP001       — every wire/WAL edge has a hook
 
 Run as ``python -m hyperopt_tpu.analysis [--json] [--baseline FILE]``;
 the tier-1 gate (``tests/test_analysis_gate.py``) runs the same
@@ -20,8 +24,11 @@ JAX and is immune to import-time side effects.
 from __future__ import annotations
 
 import os
+import time
 
-from . import artifacts, jit_purity, lock_order, registry_drift
+from . import (artifacts, exception_safety, fault_coverage, jit_purity,
+               lock_order, registry_drift, replay_determinism,
+               wire_protocol)
 from .core import Baseline, Finding, Project
 
 __all__ = ["CHECKERS", "Baseline", "Finding", "Project",
@@ -33,6 +40,10 @@ CHECKERS = {
     "lock-order": (lock_order, lock_order.RULES),
     "registry-drift": (registry_drift, registry_drift.RULES),
     "artifact-honesty": (artifacts, artifacts.RULES),
+    "wire-protocol": (wire_protocol, wire_protocol.RULES),
+    "replay-determinism": (replay_determinism, replay_determinism.RULES),
+    "exception-safety": (exception_safety, exception_safety.RULES),
+    "fault-coverage": (fault_coverage, fault_coverage.RULES),
 }
 
 
@@ -40,17 +51,27 @@ def default_baseline_path(root: str) -> str:
     return os.path.join(root, "hyperopt_tpu", "analysis", "baseline.json")
 
 
-def run_project(project, checkers=None) -> list:
-    """Run the named checkers (default: all) over a built project."""
+def run_project(project, checkers=None, timings=None) -> list:
+    """Run the named checkers (default: all) over a built project.
+
+    ``timings``, if given, is a dict filled with per-checker wall time
+    in seconds (the ``--json`` report surfaces it so the tier-1 budget
+    has per-checker attribution when it creeps).
+    """
     findings = []
     for name, (mod, _rules) in CHECKERS.items():
         if checkers and name not in checkers:
             continue
+        t0 = time.perf_counter()
         findings.extend(mod.check(project))
+        if timings is not None:
+            timings[name] = round(
+                timings.get(name, 0.0) + time.perf_counter() - t0, 4)
     findings.sort(key=lambda f: (f.file, f.line, f.rule, f.symbol))
     return findings
 
 
-def run_repo(root: str, checkers=None) -> list:
+def run_repo(root: str, checkers=None, timings=None) -> list:
     """Parse the repo at ``root`` and run the checkers over it."""
-    return run_project(Project.from_dir(root), checkers=checkers)
+    return run_project(Project.from_dir(root), checkers=checkers,
+                       timings=timings)

@@ -30,6 +30,7 @@ live in the ``obs`` bench phase and DESIGN.md §6.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -356,7 +357,8 @@ class TimeSeriesStore:
                 try:
                     self.scrape()
                 except Exception:       # pragma: no cover - keep scraping
-                    pass
+                    logging.getLogger(__name__).exception(
+                        "timeseries scrape pass failed; continuing")
 
         self._thread = threading.Thread(target=loop, daemon=True,
                                         name="obs-timeseries-scraper")

@@ -623,6 +623,9 @@ class StoreServer:
                 try:
                     domain = self._domain_for(ft)
                 except Exception:
+                    logger.debug("health: domain introspection failed "
+                                 "for %s; assessing without it",
+                                 ek, exc_info=True)
                     domain = None
             rep = _obs_health.assess(
                 docs, domain=domain, trials=ft, suggest_fn=suggest_fn,
@@ -908,6 +911,17 @@ class StoreServer:
 #: key, reused verbatim across retries so the server executes it once.
 _MUTATING_VERBS = frozenset(
     {"new_trial_ids", "insert_docs", "reserve", "write_result", "suggest"})
+
+#: Mutating verbs that are retry-convergent without a key: re-executing
+#: the request converges on the same durable state (heartbeat refreshes a
+#: timestamp to the same pinned clock, requeue_stale is a fixpoint scan,
+#: delete_all/put_domain/att_set/att_del overwrite or clear absolutely),
+#: so retries need no idempotency cache entry.  Every mutating verb must
+#: be in exactly one of these two catalogs (the WP004/WP006 analyzers
+#: reconcile both directions against the dispatcher arms).
+_IDEMPOTENT_VERBS = frozenset(
+    {"heartbeat", "requeue_stale", "delete_all", "put_domain",
+     "att_set", "att_del"})
 
 _BACKOFF_CAP_S = 2.0
 

@@ -35,6 +35,7 @@ from the new primary (``replica_attach``).
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -117,7 +118,15 @@ class WalShipper:
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"wal-shipper-{self.url.rsplit(':', 1)[-1]}")
+        # Started via start() once the server has published this shipper
+        # into its fan-out list: starting from __init__ would let the
+        # first snapshot ship race the attach critical section, and a
+        # record appended between that snapshot and publication would be
+        # neither snapshotted nor enqueued.
+
+    def start(self) -> "WalShipper":
         self._thread.start()
+        return self
 
     # -- producer side (dispatch thread) -------------------------------------
 
@@ -146,7 +155,8 @@ class WalShipper:
         with self._cv:
             self._stop = True
             self._cv.notify_all()
-        self._thread.join(timeout=5.0)
+        if self._thread.ident is not None:
+            self._thread.join(timeout=5.0)
 
     # -- shipping thread -----------------------------------------------------
 
@@ -283,6 +293,7 @@ class ShardServer(ServiceServer):
         # (recovery replay never appends, so the hook sees live traffic
         # only — the initial sync ships as one snapshot instead).
         self._wal.listener = self._on_wal_append
+        self._wal.crash_hook = self._drain_shippers_before_crash
         _metrics.registry().gauge("shard.role").set(
             1.0 if role == "primary" else 0.0)
         if replicate_to:
@@ -292,7 +303,27 @@ class ShardServer(ServiceServer):
     def role(self) -> str:
         return self._role
 
+    def _drain_shippers_before_crash(self) -> None:
+        """Bounded best-effort drain before a simulated WAL-crash
+        SIGKILL: every record acked *before* the fatal append gets a
+        chance to ship, so the chaos suite exercises failover
+        exactly-once rather than async shipping lag.  A shipper blocked
+        on the dispatch lock (held by the crashing thread) just times
+        out — the kill proceeds regardless."""
+        for sh in list(self._shippers):
+            sh.flush(timeout=2.0)
+
     def _on_wal_append(self, rec: dict) -> None:
+        if not self._shippers:
+            return
+        # Freeze the record here — under the dispatch lock, before the
+        # verb executes.  ``rec["req"]`` holds live references to dicts
+        # the store is about to mutate (insert_docs stores the request's
+        # doc objects verbatim; reserve then sets state/owner on them),
+        # while the shipper serializes its batch later on its own
+        # thread.  Shipping the live dict would replicate post-execution
+        # state under a pre-execution seq, diverging the replica.
+        rec = json.loads(json.dumps(rec))
         for sh in list(self._shippers):
             sh.enqueue(rec)
 
@@ -305,9 +336,20 @@ class ShardServer(ServiceServer):
             for sh in self._shippers:
                 if sh.url == url:
                     return sh
-            sh = WalShipper(self, url, token=self._ship_token,
-                            scrub_interval=self._scrub_interval)
+        # Construct outside the lock (the ctor builds an RPC client and
+        # a thread object), publish under it with a re-check, and only
+        # then start the thread: every record appended after publication
+        # is enqueued, and the first snapshot — taken by the thread
+        # under the server lock — covers everything before it, so no
+        # record can fall between snapshot and tail.
+        sh = WalShipper(self, url, token=self._ship_token,
+                        scrub_interval=self._scrub_interval)
+        with self._lock:
+            for existing in self._shippers:
+                if existing.url == url:
+                    return existing   # lost the race; sh never started
             self._shippers.append(sh)
+        sh.start()
         logger.info("shard: shipping WAL to replica %s", url)
         return sh
 

@@ -451,6 +451,7 @@ class Router:
     # -- fleet-merged metrics -------------------------------------------------
 
     def _fetch_shard_metrics(self, url: str) -> dict:
+        _faults.maybe_fail("rpc.send", verb="metrics", url=url)
         request = Request(f"{url}/metrics",
                           headers=({"X-Netstore-Token": self._token}
                                    if self._token else {}))

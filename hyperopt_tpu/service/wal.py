@@ -83,6 +83,13 @@ class Wal:
         #: shipper's feed.  Must be O(1)/no-IO: it runs under the
         #: dispatch lock.
         self.listener = None
+        #: Optional pre-crash hook for the simulated WAL-crash path:
+        #: called (bounded, best-effort) right before the SIGKILL so a
+        #: host server can drain in-flight replication.  The kill
+        #: models dying at the append boundary with replication caught
+        #: up — the chaos suite proves failover/replay exactly-once,
+        #: not async shipping lag.
+        self.crash_hook = None
 
     def append(self, rec: dict, seq: int | None = None) -> int:
         """Serialize ``rec`` (gets ``seq`` assigned here, unless a
@@ -99,6 +106,11 @@ class Wal:
                 # frozen HERE, before the shot (no-op when the flight
                 # recorder is disarmed).
                 self._fh.flush()
+                if self.crash_hook is not None:
+                    try:
+                        self.crash_hook()
+                    except Exception:  # noqa: BLE001 - dying anyway
+                        pass
                 _flight.dump("wal-crash", force=True,
                              extra={"trigger": "wal_crash",
                                     "verb": rec.get("verb")})

@@ -1000,6 +1000,8 @@ def _prewarm_async(kern: _TpeKernel, n: int = 1) -> None:
             if jax.default_backend() == "cpu":
                 return
         except Exception:
+            logging.getLogger(__name__).debug(
+                "backend probe failed; skipping prewarm", exc_info=True)
             return
 
     def _go():

@@ -468,7 +468,7 @@ def test_checked_in_baseline_is_valid_and_annotated():
 
 def test_analysis_package_imports_stdlib_only():
     allowed = {"__future__", "ast", "json", "os", "re", "argparse", "sys",
-               "dataclasses"}
+               "dataclasses", "time", "subprocess"}
     for path in sorted(PKG_DIR.glob("*.py")):
         tree = ast.parse(path.read_text(), filename=str(path))
         for node in ast.walk(tree):
@@ -507,3 +507,475 @@ print(len(mod.run_repo({str(ROOT)!r})))
     n_subproc = int(proc.stdout.strip())
     analysis = load_analysis()
     assert n_subproc == len(analysis.run_repo(str(ROOT)))
+
+
+# ---------------------------------------------------------------------------
+# WP — wire-protocol coherence
+# ---------------------------------------------------------------------------
+
+
+_WP_SRV_PREAMBLE = (
+    "_WAL_VERBS = frozenset({\"zap\"})\n"
+    "class MemT:\n"
+    "    def state_dict(self):\n"
+    "        return {\"docs\": self._docs}\n"
+    "    def zap(self):\n"
+    "        self._docs.append(1)\n"
+)
+
+_WP_IDEM_PROOF = (
+    "_MUTATING_VERBS = frozenset({\"other\"})\n"
+    "class Rpc:\n"
+    "    def __call__(self, verb, **kw):\n"
+    "        if verb in _MUTATING_VERBS:\n"
+    "            kw[\"idem\"] = \"k\"\n"
+    "        return kw\n"
+)
+
+
+def _wp(srv, cli):
+    return {"hyperopt_tpu/srv.py": srv, "hyperopt_tpu/cli.py": cli}
+
+
+def test_wp001_unknown_verb_fires_and_known_silent():
+    srv = ("def _dispatch_verb(verb, req):\n"
+           "    if verb == \"known\":\n"
+           "        return {}\n")
+    bad = _wp(srv, "class C:\n"
+                   "    def go(self):\n"
+                   "        return self._rpc(\"ghost\")\n")
+    ok = _wp(srv, "class C:\n"
+                  "    def go(self):\n"
+                  "        return self._rpc(\"known\")\n")
+    assert rules_fired(run_checker("wire-protocol", bad), "WP001")
+    assert not rules_fired(run_checker("wire-protocol", ok), "WP001")
+
+
+def test_wp002_orphan_arm_fires_and_catalog_membership_exempts():
+    srv = ("def _dispatch_verb(verb, req):\n"
+           "    if verb == \"known\":\n"
+           "        return {}\n"
+           "    if verb == \"orphan\":\n"
+           "        return {}\n")
+    cli = ("class C:\n"
+           "    def go(self):\n"
+           "        return self._rpc(\"known\")\n")
+    bad = _wp(srv, cli)
+    ok = _wp(srv + "_REPLICATION_VERBS = frozenset({\"orphan\"})\n", cli)
+    fired = rules_fired(run_checker("wire-protocol", bad), "WP002")
+    assert fired and "orphan" in fired[0].message
+    assert not rules_fired(run_checker("wire-protocol", ok), "WP002")
+
+
+def test_wp003_required_field_drift_fires_and_supplied_silent():
+    srv = ("def _dispatch_verb(verb, req):\n"
+           "    if verb == \"mk\":\n"
+           "        return {\"v\": req[\"n\"]}\n")
+    bad = _wp(srv, "class C:\n"
+                   "    def go(self):\n"
+                   "        return self._rpc(\"mk\")\n")
+    ok = _wp(srv, "class C:\n"
+                  "    def go(self):\n"
+                  "        return self._rpc(\"mk\", n=3)\n")
+    star = _wp(srv, "class C:\n"
+                    "    def go(self, **kw):\n"
+                    "        return self._rpc(\"mk\", **kw)\n")
+    fired = rules_fired(run_checker("wire-protocol", bad), "WP003")
+    assert fired and "'mk'" in fired[0].message
+    assert not rules_fired(run_checker("wire-protocol", ok), "WP003")
+    assert not rules_fired(run_checker("wire-protocol", star), "WP003")
+
+
+def test_wp004_unkeyed_mutating_verb_fires_and_declaration_exempts():
+    srv = (_WP_SRV_PREAMBLE +
+           "def _dispatch_verb(verb, req, ft):\n"
+           "    if verb == \"zap\":\n"
+           "        ft.zap()\n"
+           "        return {}\n")
+    bad = _wp(srv, _WP_IDEM_PROOF)
+    ok = _wp(srv, _WP_IDEM_PROOF
+             + "_IDEMPOTENT_VERBS = frozenset({\"zap\"})\n")
+    fired = rules_fired(run_checker("wire-protocol", bad), "WP004")
+    assert fired and "zap" in fired[0].symbol
+    assert not rules_fired(run_checker("wire-protocol", ok), "WP004")
+
+
+def test_wp004_unproven_client_attach_fires():
+    # The catalog exists but nothing in client code tests membership and
+    # stores kw["idem"]: the auto-attach convention is asserted, never
+    # implemented.
+    srv = (_WP_SRV_PREAMBLE +
+           "def _dispatch_verb(verb, req, ft):\n"
+           "    if verb == \"zap\":\n"
+           "        ft.zap()\n"
+           "        return {}\n")
+    bad = _wp(srv, "_MUTATING_VERBS = frozenset({\"zap\"})\n")
+    fired = rules_fired(run_checker("wire-protocol", bad), "WP004")
+    assert any("unproven" in f.message for f in fired)
+
+
+def test_wp005_wal_read_and_unlogged_mutation_both_fire():
+    read_logged = _wp(
+        _WP_SRV_PREAMBLE +
+        "def _dispatch_verb(verb, req, ft):\n"
+        "    if verb == \"zap\":\n"
+        "        return {\"n\": len(ft._docs)}\n",   # read, yet WAL-logged
+        _WP_IDEM_PROOF)
+    unlogged_mut = _wp(
+        "_WAL_VERBS = frozenset({\"other\"})\n"
+        "class MemT:\n"
+        "    def state_dict(self):\n"
+        "        return {\"docs\": self._docs}\n"
+        "def _dispatch_verb(verb, req, ft):\n"
+        "    if verb == \"zap\":\n"
+        "        ft._docs.append(req[\"doc\"])\n"
+        "        return {}\n",
+        _WP_IDEM_PROOF)
+    ok = _wp(
+        _WP_SRV_PREAMBLE +
+        "def _dispatch_verb(verb, req, ft):\n"
+        "    if verb == \"zap\":\n"
+        "        ft.zap()\n"
+        "        return {}\n",
+        _WP_IDEM_PROOF)
+    fired = rules_fired(run_checker("wire-protocol", read_logged), "WP005")
+    assert fired and "re-executes a read" in fired[0].message
+    fired = rules_fired(run_checker("wire-protocol", unlogged_mut), "WP005")
+    assert fired and "survives no crash" in fired[0].message
+    assert not rules_fired(run_checker("wire-protocol", ok), "WP005")
+
+
+def test_wp006_contradiction_and_stale_declaration_fire():
+    srv = (_WP_SRV_PREAMBLE +
+           "def _dispatch_verb(verb, req, ft):\n"
+           "    if verb == \"zap\":\n"
+           "        ft.zap()\n"
+           "        return {}\n")
+    contradiction = _wp(srv, _WP_IDEM_PROOF.replace(
+        "frozenset({\"other\"})", "frozenset({\"zap\"})")
+        + "_IDEMPOTENT_VERBS = frozenset({\"zap\"})\n")
+    stale = _wp(srv, _WP_IDEM_PROOF
+                + "_IDEMPOTENT_VERBS = frozenset({\"ghost\"})\n")
+    ok = _wp(srv, _WP_IDEM_PROOF
+             + "_IDEMPOTENT_VERBS = frozenset({\"zap\"})\n")
+    fired = rules_fired(run_checker("wire-protocol", contradiction),
+                        "WP006")
+    assert fired and "pick one" in fired[0].message
+    fired = rules_fired(run_checker("wire-protocol", stale), "WP006")
+    assert fired and "stale declaration" in fired[0].message
+    assert not rules_fired(run_checker("wire-protocol", ok), "WP006")
+
+
+# ---------------------------------------------------------------------------
+# RT — replay determinism
+# ---------------------------------------------------------------------------
+
+
+def _rt(body):
+    return {"hyperopt_tpu/service/s.py": body}
+
+
+def test_rt001_wall_clock_fires_and_pinned_clock_exempt():
+    bad = _rt("import time\n"
+              "class S:\n"
+              "    def _apply_record(self, rec):\n"
+              "        return {\"t\": time.time()}\n")
+    ok = _rt("class S:\n"
+             "    def _apply_record(self, rec):\n"
+             "        self.now_override = rec[\"t\"]\n"
+             "        return {\"t\": self.now_override}\n")
+    assert rules_fired(run_checker("replay-determinism", bad), "RT001")
+    assert not rules_fired(run_checker("replay-determinism", ok), "RT001")
+
+
+def test_rt002_entropy_fires_and_clean_silent():
+    bad = _rt("import uuid\n"
+              "class S:\n"
+              "    def _apply_record(self, rec):\n"
+              "        return {\"id\": uuid.uuid4().hex}\n")
+    ok = _rt("class S:\n"
+             "    def _apply_record(self, rec):\n"
+             "        return {\"id\": rec[\"idem\"]}\n")
+    assert rules_fired(run_checker("replay-determinism", bad), "RT002")
+    assert not rules_fired(run_checker("replay-determinism", ok), "RT002")
+
+
+def test_rt003_env_read_fires_and_live_only_guard_prunes():
+    bad = _rt("import os\n"
+              "class S:\n"
+              "    def _apply_record(self, rec):\n"
+              "        return {\"e\": os.environ.get(\"X\")}\n")
+    # A leading positive-_replaying guard routes replay into its own
+    # branch; the env read below it is live-only.
+    ok = _rt("import os\n"
+             "class S:\n"
+             "    def _apply_record(self, rec):\n"
+             "        if self._replaying:\n"
+             "            return {}\n"
+             "        return {\"e\": os.environ.get(\"X\")}\n")
+    assert rules_fired(run_checker("replay-determinism", bad), "RT003")
+    assert not rules_fired(run_checker("replay-determinism", ok), "RT003")
+
+
+def test_rt004_set_iteration_fires_and_sorted_silent():
+    bad = _rt("class S:\n"
+              "    def __init__(self):\n"
+              "        self._keys = set()\n"
+              "    def state_dict(self):\n"
+              "        out = []\n"
+              "        for k in self._keys:\n"
+              "            out.append(k)\n"
+              "        return out\n")
+    ok = _rt("class S:\n"
+             "    def __init__(self):\n"
+             "        self._keys = set()\n"
+             "    def state_dict(self):\n"
+             "        out = []\n"
+             "        for k in sorted(self._keys):\n"
+             "            out.append(k)\n"
+             "        return out\n")
+    assert rules_fired(run_checker("replay-determinism", bad), "RT004")
+    assert not rules_fired(run_checker("replay-determinism", ok), "RT004")
+
+
+def test_rt_reachability_crosses_self_calls():
+    # Taint must follow the call graph, not just root bodies.
+    bad = _rt("import time\n"
+              "class S:\n"
+              "    def _apply_record(self, rec):\n"
+              "        return self._stamp(rec)\n"
+              "    def _stamp(self, rec):\n"
+              "        return {\"t\": time.time()}\n")
+    unreachable = _rt("import time\n"
+                      "class S:\n"
+                      "    def _apply_record(self, rec):\n"
+                      "        return {}\n"
+                      "    def _stamp(self, rec):\n"
+                      "        return {\"t\": time.time()}\n")
+    assert rules_fired(run_checker("replay-determinism", bad), "RT001")
+    assert not rules_fired(run_checker("replay-determinism", unreachable),
+                           "RT001")
+
+
+# ---------------------------------------------------------------------------
+# ES — exception safety in the threaded layers
+# ---------------------------------------------------------------------------
+
+
+def _es(body):
+    return {"hyperopt_tpu/svc.py": body}
+
+
+def test_es001_bare_acquire_fires_and_try_finally_silent():
+    bad = _es("import threading\n"
+              "lock = threading.Lock()\n"
+              "def f():\n"
+              "    lock.acquire()\n"
+              "    g()\n"
+              "    lock.release()\n")
+    ok = _es("import threading\n"
+             "lock = threading.Lock()\n"
+             "def f():\n"
+             "    lock.acquire()\n"
+             "    try:\n"
+             "        g()\n"
+             "    finally:\n"
+             "        lock.release()\n")
+    assert rules_fired(run_checker("exception-safety", bad), "ES001")
+    assert not rules_fired(run_checker("exception-safety", ok), "ES001")
+
+
+def test_es002_silent_swallow_fires_and_surfacing_variants_silent():
+    def thread_entry(handler):
+        return _es("import threading\n"
+                   "def loop():\n"
+                   "    try:\n"
+                   "        work()\n"
+                   + handler +
+                   "def start():\n"
+                   "    t = threading.Thread(target=loop)\n"
+                   "    t.start()\n")
+    bad = thread_entry("    except Exception:\n"
+                       "        pass\n")
+    logged = thread_entry("    except Exception:\n"
+                          "        log.exception(\"scrape failed\")\n")
+    marshalled = thread_entry("    except Exception as e:\n"
+                              "        outq.put(e)\n")
+    assert rules_fired(run_checker("exception-safety", bad), "ES002")
+    assert not rules_fired(run_checker("exception-safety", logged), "ES002")
+    assert not rules_fired(run_checker("exception-safety", marshalled),
+                           "ES002")
+
+
+def test_es002_ignores_swallow_outside_thread_paths():
+    # The same swallow in a function no thread enters is not this rule's
+    # business (other layers may legitimately degrade).
+    ok = _es("def f():\n"
+             "    try:\n"
+             "        work()\n"
+             "    except Exception:\n"
+             "        pass\n")
+    assert not rules_fired(run_checker("exception-safety", ok), "ES002")
+
+
+def test_es003_thread_start_under_lock_fires_and_outside_silent():
+    bad = _es("import threading\n"
+              "class B:\n"
+              "    def __init__(self):\n"
+              "        self._lock = threading.Lock()\n"
+              "    def go(self):\n"
+              "        with self._lock:\n"
+              "            threading.Thread(target=f).start()\n")
+    ok = _es("import threading\n"
+             "class B:\n"
+             "    def __init__(self):\n"
+             "        self._lock = threading.Lock()\n"
+             "    def go(self):\n"
+             "        with self._lock:\n"
+             "            pass\n"
+             "        threading.Thread(target=f).start()\n")
+    assert rules_fired(run_checker("exception-safety", bad), "ES003")
+    assert not rules_fired(run_checker("exception-safety", ok), "ES003")
+
+
+def test_es003_thread_starting_ctor_under_lock_fires():
+    bad = _es("import threading\n"
+              "class Shipper:\n"
+              "    def __init__(self):\n"
+              "        self._thread = threading.Thread(target=run)\n"
+              "        self._thread.start()\n"
+              "class Srv:\n"
+              "    def __init__(self):\n"
+              "        self._lock = threading.Lock()\n"
+              "    def attach(self):\n"
+              "        with self._lock:\n"
+              "            self._sh = Shipper()\n")
+    ok = _es("import threading\n"
+             "class Shipper:\n"
+             "    def __init__(self):\n"
+             "        self._thread = threading.Thread(target=run)\n"
+             "class Srv:\n"
+             "    def __init__(self):\n"
+             "        self._lock = threading.Lock()\n"
+             "    def attach(self):\n"
+             "        with self._lock:\n"
+             "            self._sh = Shipper()\n")
+    assert rules_fired(run_checker("exception-safety", bad), "ES003")
+    assert not rules_fired(run_checker("exception-safety", ok), "ES003")
+
+
+# ---------------------------------------------------------------------------
+# FP — fault-point coverage
+# ---------------------------------------------------------------------------
+
+
+def test_fp001_bare_urlopen_fires_and_hooked_silent():
+    bad = {"hyperopt_tpu/net.py": (
+        "from urllib.request import urlopen\n"
+        "def fetch(url):\n"
+        "    with urlopen(url) as r:\n"
+        "        return r.read()\n")}
+    ok = {"hyperopt_tpu/net.py": (
+        "from urllib.request import urlopen\n"
+        "def fetch(url):\n"
+        "    maybe_fail(\"rpc.send\", url=url)\n"
+        "    with urlopen(url) as r:\n"
+        "        return r.read()\n")}
+    assert rules_fired(run_checker("fault-coverage", bad), "FP001")
+    assert not rules_fired(run_checker("fault-coverage", ok), "FP001")
+
+
+def test_fp001_wal_append_without_hook_fires_and_hooked_silent():
+    bad = {"hyperopt_tpu/w.py": (
+        "_WAL_FILE = \"wal.jsonl\"\n"
+        "class Wal:\n"
+        "    def append(self, rec):\n"
+        "        self._fh.write(rec)\n")}
+    ok = {"hyperopt_tpu/w.py": (
+        "_WAL_FILE = \"wal.jsonl\"\n"
+        "class Wal:\n"
+        "    def append(self, rec):\n"
+        "        maybe_fail(\"wal.write\")\n"
+        "        self._fh.write(rec)\n")}
+    assert rules_fired(run_checker("fault-coverage", bad), "FP001")
+    assert not rules_fired(run_checker("fault-coverage", ok), "FP001")
+
+
+# ---------------------------------------------------------------------------
+# CLI report plumbing: --diff scoping, per-checker timings, SARIF
+# ---------------------------------------------------------------------------
+
+
+def load_cli():
+    load_analysis()
+    return importlib.import_module(_STANDALONE + ".__main__")
+
+
+def test_diff_report_scopes_findings_and_baseline():
+    cli = load_cli()
+    analysis = load_analysis()
+    baseline = analysis.default_baseline_path(str(ROOT))
+    full = cli.build_report(str(ROOT), baseline,
+                            checkers=["replay-determinism"])
+    target = "hyperopt_tpu/service/wal.py"
+    diff = cli.build_report(str(ROOT), baseline,
+                           checkers=["replay-determinism"],
+                           diff_files={target})
+    assert diff["diff_files"] == [target]
+    assert not diff["new"] and not diff["stale"]
+    assert diff["baselined"], "wal.py has baselined RT findings"
+    assert all(f["file"] == target for f in diff["baselined"])
+    # Full-run semantics: the diff-scoped report is exactly the full
+    # report restricted to the changed file, not a re-analysis.
+    assert diff["baselined"] == [f for f in full["baselined"]
+                                 if f["file"] == target]
+    empty = cli.build_report(str(ROOT), baseline,
+                             checkers=["replay-determinism"],
+                             diff_files=set())
+    assert empty["counts"] == {} and not empty["baselined"]
+
+
+def test_diff_with_bad_git_ref_exits_2(capsys):
+    cli = load_cli()
+    rc = cli.main(["--root", str(ROOT), "--diff", "no-such-ref-xyz"])
+    assert rc == 2
+    assert "git diff failed" in capsys.readouterr().err
+
+
+def test_json_report_includes_per_checker_timings():
+    cli = load_cli()
+    analysis = load_analysis()
+    report = cli.build_report(str(ROOT),
+                              analysis.default_baseline_path(str(ROOT)),
+                              checkers=["fault-coverage"],
+                              with_timings=True)
+    timings = report["timings_s"]
+    assert set(timings) == {"fault-coverage"}
+    assert isinstance(timings["fault-coverage"], float)
+    assert timings["fault-coverage"] >= 0.0
+
+
+_SARIF_REPORT = {
+    "new": [{"rule": "WP001", "file": "hyperopt_tpu/a.py", "line": 3,
+             "symbol": "C.go", "message": "client emits unknown verb"}],
+    "baselined": [{"rule": "RT001", "file": "hyperopt_tpu/b.py", "line": 0,
+                   "symbol": "S.snap",
+                   "message": "wall clock on a replay path"}],
+}
+
+
+def test_sarif_output_matches_golden():
+    cli = load_cli()
+    got = json.dumps(cli.sarif_from_report(_SARIF_REPORT), indent=2,
+                     sort_keys=True) + "\n"
+    golden = (ROOT / "tests" / "data"
+              / "analysis_sarif_golden.json").read_text()
+    assert got == golden
+    doc = json.loads(got)
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "hyperopt-tpu-analysis"
+    levels = {r["ruleId"]: r["level"] for r in run["results"]}
+    assert levels == {"WP001": "error", "RT001": "note"}
+    # line 0 (module-level finding) must clamp to SARIF's 1-based minimum
+    assert all(r["locations"][0]["physicalLocation"]["region"]["startLine"]
+               >= 1 for r in run["results"])

@@ -509,6 +509,65 @@ class TestBenchmarkArtifacts:
         assert payload["manifest"]["schema"] == 1
         assert payload["events"][0]["type"] == "meta"
 
+    def test_atpe_profile_artifact_schema(self):
+        """PR 14 baseline burndown: the ATPE arm-profile artifact (per
+        config: wall time, best loss, suggest-cache stats, compiled shape
+        count) — written by benchmarks/atpe_profile.py.  Replaces the
+        AH001 grandfather entry."""
+        paths = sorted(glob.glob(os.path.join(_BENCH_DIR,
+                                              "atpe_profile_*.json")))
+        assert paths, "no benchmarks/atpe_profile_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "atpe_arm_profile", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert _DATE_STAMP.search(name), \
+                f"{name}: profile artifacts carry their date in the filename"
+            assert doc["n_trials"] > 0, name
+            assert {"tpe", "atpe_tiered", "atpe_untiered"} \
+                <= set(doc["configs"]), name
+            for cname, cfg in doc["configs"].items():
+                assert cfg["wall_s"] > 0, f"{name}: {cname}"
+                assert "best" in cfg, f"{name}: {cname}"
+                assert isinstance(cfg["cache"], dict), f"{name}: {cname}"
+                assert cfg["compiled_shapes"] >= 0, f"{name}: {cname}"
+            # the headline ratio really is the quotient of the two walls
+            ratio = (doc["configs"]["atpe_tiered"]["wall_s"]
+                     / doc["configs"]["tpe"]["wall_s"])
+            assert abs(doc["atpe_over_tpe"] - ratio) < 0.05 * ratio, name
+
+    def test_history_ab_artifact_schema(self):
+        """PR 14 baseline burndown: the resident-vs-legacy history feed
+        A/B (throughput + feed-bytes accounting per mode, parity bit) —
+        written by benchmarks/history_ab.py.  Replaces the AH001
+        grandfather entry."""
+        paths = sorted(glob.glob(os.path.join(_BENCH_DIR,
+                                              "history_ab_*.json")))
+        assert paths, "no benchmarks/history_ab_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "history_ab_resident_vs_legacy", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            assert doc["n_evals"] >= doc["n_suggested"] > 0, name
+            assert doc["space_params"] > 0, name
+            assert doc["parity_bit_identical"] is True, (
+                f"{name}: resident-history suggestions diverged from the "
+                "legacy doc-feed path")
+            modes = [r["mode"] for r in doc["rows"]]
+            assert len(modes) == 2 and len(set(modes)) == 2, name
+            for r in doc["rows"]:
+                assert r["trials_per_sec"] > 0, f"{name}: {r}"
+                assert r["feed_bytes_total"] >= 0, f"{name}: {r}"
+                assert r["feed_bytes_per_trial"] >= 0, f"{name}: {r}"
+                assert "feed_bytes_source" in r, f"{name}: {r}"
+                for col in ("upload_ms", "dispatch_ms", "fetch_sync_ms"):
+                    assert r[col] >= 0, f"{name}: {r}"
+
     def test_device_ab_artifact_matches_its_bench(self):
         # the r6 device A/B (5 domains x 20 seeds, one conditional space)
         path = os.path.join(_BENCH_DIR, "quality_ab_fmin_vs_fmin_device.json")

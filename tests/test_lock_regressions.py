@@ -112,3 +112,184 @@ def test_tpe_batch_fn_cache_builds_once_under_race(monkeypatch):
     assert len(builds) == 1                  # no double-build
     assert got[0] is got[1]
     assert set(kernel._batch_fns) == {("seeded", 4)}
+
+
+# ---------------------------------------------------------------------------
+# Regressions for true positives the PR-14 checker families surfaced
+# (ES003 attach_replica, FP001 router metrics, WP004 idempotency catalog).
+# ---------------------------------------------------------------------------
+
+
+def test_attach_replica_starts_shipper_outside_lock_after_publish(
+        monkeypatch, tmp_path):
+    """ES003 fix: the shipper thread must start only after the shipper is
+    published into ``_shippers`` and only outside the dispatch lock —
+    starting under the lock (the old ctor auto-start) could deadlock on
+    the first snapshot, and starting before publication loses any record
+    appended between the snapshot and the publish."""
+    from hyperopt_tpu.service import replica
+
+    server = replica.ShardServer(str(tmp_path))
+    try:
+        started = []
+
+        def recording_start(self):
+            started.append((server._lock._is_owned()
+                            if hasattr(server._lock, "_is_owned")
+                            else server._lock.locked(),
+                            self in server._shippers))
+            return self          # never start the real network thread
+
+        monkeypatch.setattr(replica.WalShipper, "start", recording_start)
+
+        barrier = threading.Barrier(2)
+        got = []
+
+        def attach():
+            barrier.wait()
+            got.append(server.attach_replica("http://127.0.0.1:1/r"))
+
+        threads = [threading.Thread(target=attach) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5.0)
+
+        assert got[0] is got[1]              # one shipper per URL
+        assert len(server._shippers) == 1
+        # started exactly once: lock released, shipper already published
+        assert started == [(False, True)]
+        # the losing ctor's thread object must never have run
+        assert all(sh._thread.ident is not None or sh in server._shippers
+                   for sh in got)
+    finally:
+        server.shutdown()
+
+
+def test_router_metrics_fetch_passes_rpc_fault_point():
+    """FP001 fix: ``_fetch_shard_metrics`` must cross the ``rpc.send``
+    fault point before any network IO, so chaos drills exercise the
+    degraded-shard rendering in ``metrics_payload``."""
+    from hyperopt_tpu import faults
+    from hyperopt_tpu.exceptions import InjectedFault
+    from hyperopt_tpu.service.router import Router
+
+    router = object.__new__(Router)
+    router._token = None
+    router.timeout = 1.0
+    faults.configure({"rpc.send": 1.0})
+    try:
+        try:
+            router._fetch_shard_metrics("http://127.0.0.1:9")
+            raise AssertionError("fault point not on the metrics path")
+        except InjectedFault:
+            pass
+    finally:
+        faults.configure({})
+
+
+def test_idempotent_verbs_converge_under_retry():
+    """WP004 catalog proof: every verb in ``_IDEMPOTENT_VERBS`` is
+    retry-convergent — applying it twice under a pinned clock leaves the
+    durable state byte-identical to one application, which is why these
+    verbs need no idempotency key."""
+    import json
+
+    from hyperopt_tpu import base
+    from hyperopt_tpu.parallel import netstore
+    from hyperopt_tpu.service.store import MemTrials
+
+    assert netstore._IDEMPOTENT_VERBS == {
+        "heartbeat", "requeue_stale", "delete_all", "put_domain",
+        "att_set", "att_del"}
+
+    def fresh(seed_claim=False):
+        ft = MemTrials(exp_key="e")
+        ft.now_override = 1000.0
+        if seed_claim:
+            ft._insert_trial_docs([base.new_trial_doc(0, "e", None)])
+            ft.reserve("w0")
+        return ft
+
+    def assert_converges(ft, op):
+        op(ft)
+        first = json.dumps(ft.state_dict(), sort_keys=True)
+        op(ft)
+        assert json.dumps(ft.state_dict(), sort_keys=True) == first
+
+    def att_del(ft):
+        # Mirrors the dispatch arm: a missing key answers ok=False
+        # instead of raising, so the retry converges.
+        try:
+            del ft.attachments["k"]
+        except KeyError:
+            pass
+
+    doc_holder = fresh(seed_claim=True)
+    doc = doc_holder.export_docs()[0]
+    assert_converges(doc_holder,
+                     lambda ft: ft.heartbeat(dict(doc), owner="w0"))
+    assert_converges(fresh(seed_claim=True),
+                     lambda ft: ft.requeue_stale(-1.0))
+    assert_converges(fresh(seed_claim=True), lambda ft: ft.delete_all())
+    assert_converges(fresh(), lambda ft: ft.put_domain_blob(b"dom"))
+    assert_converges(fresh(),
+                     lambda ft: ft.attachments.__setitem__("k", b"v"))
+    ft = fresh()
+    ft.attachments["k"] = b"v"
+    assert_converges(ft, att_del)
+
+
+def test_wal_fanout_freezes_record_before_verb_executes(tmp_path):
+    """The shipper serializes its batch on its own thread, while
+    ``insert_docs`` records hold live references to the doc dicts the
+    store keeps (and ``reserve`` then mutates in place).  Fanning out
+    the live record let a later verb poison an earlier record before it
+    shipped — the replica would replay post-execution state under a
+    pre-execution seq and diverge.  ``_on_wal_append`` must freeze the
+    record under the dispatch lock, before ``_execute`` runs."""
+    from hyperopt_tpu import base
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+    from hyperopt_tpu.obs.bundle import state_hash
+    from hyperopt_tpu.parallel.netstore import NetTrials
+    from hyperopt_tpu.service.replica import ShardServer, WalShipper
+
+    orig_batch = WalShipper._ship_batch
+
+    def delayed_batch(self, batch):
+        # Widen the enqueue->serialize window so a racing reserve/write
+        # lands while the insert_docs record is still queued.
+        time.sleep(0.25)
+        return orig_batch(self, batch)
+
+    WalShipper._ship_batch = delayed_batch
+    prim = ShardServer(wal_dir=str(tmp_path / "p"), role="primary")
+    repl = ShardServer(wal_dir=str(tmp_path / "r"), role="replica")
+    try:
+        prim.start()
+        repl.start()
+        prim.attach_replica(repl.url)
+        time.sleep(0.2)  # let the initial snapshot land
+        nt = NetTrials(prim.url, exp_key="e1")
+        docs = []
+        for tid in nt.new_trial_ids(3):
+            d = base.new_trial_doc(tid, "e1", None)
+            d["misc"]["idxs"] = {"x": [tid]}
+            d["misc"]["vals"] = {"x": [float(tid)]}
+            docs.append(d)
+        nt._insert_trial_docs(docs)
+        doc = nt.reserve("w0")
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"status": STATUS_OK, "loss": 0.5}
+        nt.write_result(doc, owner="w0")
+        for sh in prim._shippers:
+            assert sh.flush()
+        with prim._lock:
+            p = (prim._wal.seq, state_hash(prim.state_bytes()))
+        with repl._lock:
+            r = (repl._wal.seq, state_hash(repl.state_bytes()))
+        assert p == r
+    finally:
+        WalShipper._ship_batch = orig_batch
+        prim.shutdown()
+        repl.shutdown()

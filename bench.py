@@ -69,6 +69,7 @@ PHASE_DEADLINES = {
     "device_fmin": 600.0,
     "cpu_ref": 300.0,
     "obs": 300.0,
+    "multichip": 600.0,
     "result": 60.0,
 }
 
@@ -631,6 +632,23 @@ def child():
         _say("partial", partial)
     except Exception as e:
         partial["obs_error"] = f"{type(e).__name__}: {e}"
+        _say("partial", partial)
+
+    # Multichip scaling (PR 15): the dispatch substrate's sharded suggest
+    # at fixed total work over 1/2/4/8-device CPU meshes, one subprocess
+    # per device count (XLA pins the host device count at backend init).
+    # Host-CPU stand-in — doesn't touch the TPU claim; each grandchild
+    # asserts zero steady-state kernel-cache misses, re-asserted here.
+    _say("phase", {"name": "multichip"})
+    try:
+        from benchmarks.multichip import collect as _mc_collect
+
+        mc = _mc_collect(fast=fast)
+        assert all(r["kernel_compiles_steady"] == 0 for r in mc["rows"])
+        partial["multichip"] = mc
+        _say("partial", partial)
+    except Exception as e:
+        partial["multichip_error"] = f"{type(e).__name__}: {e}"
         _say("partial", partial)
 
     _say("phase", {"name": "result"})

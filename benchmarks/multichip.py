@@ -1,0 +1,173 @@
+"""MULTICHIP scaling: sharded-substrate suggest latency vs device count.
+
+PR 15's acceptance measurement for the dispatch substrate: the SAME
+fixed-work suggest step (one TPE proposal over ``n_cand`` total EI
+candidates) executed with the candidate axis sharded over meshes of
+1, 2, 4 and 8 devices.  Ideal scaling halves the step time per doubling;
+``efficiency = t1 / (n * tn)`` reads 1.0 at perfect scaling.
+
+Each device count runs in its OWN subprocess: XLA fixes the host
+platform's device count at backend init, so an 8-way and a 2-way mesh
+cannot coexist in one process.  The grandchild forces the CPU platform
+(``--xla_force_host_platform_device_count=n`` — the same virtual-device
+stand-in the test suite uses), routes suggests through the substrate
+with ``HYPEROPT_TPU_DISPATCH=sharded``, and enforces the compile-count
+bar in-process: after the warm call, the timed steady-state loop must
+record ZERO kernel-cache misses (one compile per (head, tier,
+mesh-shape), ever).
+
+On this 1-core host the virtual devices timeshare one core, so measured
+efficiency is an honest LOWER bound — the harness certifies the program
+shape (one SPMD program, collective top-k, no per-device dispatch
+overhead growth); the real win needs real chips.  ``bench.py``'s
+``multichip`` phase embeds these rows in the driver artifact, and
+``__graft_entry__.dryrun_multichip`` prints the same efficiency readout
+into ``MULTICHIP_r*.json``.
+
+Run::
+
+    python benchmarks/multichip.py
+
+Writes ``benchmarks/multichip_<backend>_<stamp>.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Total candidates per suggest — FIXED work, divisible by every mesh
+# width measured, so per-device share shrinks as the mesh grows.
+N_CAND = 512
+HISTORY = 30
+
+_GRANDCHILD = r"""
+import json, os, time
+import numpy as np
+
+n = {n}
+rounds = {rounds}
+
+# The env's sitecustomize may pre-select an accelerator plugin and even
+# initialize the backend at import; _force_cpu_platform handles the full
+# teardown/rebuild dance onto n virtual CPU devices.
+from __graft_entry__ import _force_cpu_platform
+jax = _force_cpu_platform(n)
+assert len(jax.devices()) >= n, jax.devices()
+
+from hyperopt_tpu import Trials, hp, rand, tpe
+from hyperopt_tpu import dispatch
+from hyperopt_tpu.base import Domain
+from hyperopt_tpu.obs import kernel_cache_stats
+
+space = {{
+    "u0": hp.uniform("u0", -5, 5),
+    "lg": hp.loguniform("lg", -6, 0),
+    "c0": hp.choice("c0", [{{"a": hp.normal("a", 0, 1)}}, {{"k": 2}}]),
+}}
+dom = Domain(lambda d: d["u0"] ** 2, space)
+t = Trials()
+rng = np.random.default_rng(0)
+for i in range({hist}):
+    t.insert_trial_docs(rand.suggest([i], dom, t, int(rng.integers(2**31))))
+    t.refresh()
+    d = t._dynamic_trials[-1]
+    d["state"] = 2
+    d["result"] = {{"status": "ok", "loss": float(rng.normal())}}
+t.refresh()
+
+mesh = dispatch.default_mesh(devices=np.asarray(jax.devices()[:n]))
+assert mesh.shape[dispatch.CAND_AXIS] == n, dict(mesh.shape)
+dispatch.set_default_mesh(mesh)
+
+def step(seed):
+    return tpe.suggest_batch([{hist}], dom, t, seed,
+                             n_EI_candidates={n_cand})
+
+kernel_cache_stats(reset=True)
+step(0)                                   # warm: compiles land here
+warm = kernel_cache_stats(reset=True)
+times = []
+for r in range(1, rounds + 1):
+    t0 = time.perf_counter()
+    step(r)
+    times.append((time.perf_counter() - t0) * 1e3)
+steady = kernel_cache_stats()
+# The compile-count bar: one compile per (head, tier, mesh-shape) means
+# the warmed steady-state loop never misses the kernel cache.
+assert steady["misses"] == 0, steady
+from hyperopt_tpu.obs.metrics import registry
+shard_calls = registry().snapshot()["counters"].get("dispatch.sharded", 0.0)
+assert shard_calls >= rounds + 1, shard_calls   # really took the mesh path
+print("@row " + json.dumps({{
+    "n_devices": n,
+    "mesh": dict(mesh.shape),
+    "n_cand": {n_cand},
+    "rounds": rounds,
+    "suggest_ms": round(float(np.mean(times)), 2),
+    "p50_ms": round(float(np.median(times)), 2),
+    "compiles_warm": warm["misses"],
+    "kernel_compiles_steady": steady["misses"],
+}}), flush=True)
+"""
+
+
+def _run_one(n: int, rounds: int, timeout: float = 420.0) -> dict:
+    """Measure one device count in a fresh subprocess; returns its row."""
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=f"--xla_force_host_platform_device_count={n}",
+               HYPEROPT_TPU_DISPATCH="sharded",
+               HYPEROPT_TPU_CACHE_DIR=os.environ.get(
+                   "HYPEROPT_TPU_CACHE_DIR", "/tmp/hyperopt_tpu_multichip"))
+    src = _GRANDCHILD.format(n=n, rounds=rounds, hist=HISTORY, n_cand=N_CAND)
+    out = subprocess.run([sys.executable, "-c", src], cwd=_REPO,
+                         capture_output=True, text=True, timeout=timeout)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"multichip grandchild n={n} rc={out.returncode}: "
+            f"{(out.stderr or out.stdout)[-500:]}")
+    for line in out.stdout.splitlines():
+        if line.startswith("@row "):
+            return json.loads(line[5:])
+    raise RuntimeError(f"multichip grandchild n={n}: no @row in output")
+
+
+def collect(fast: bool = False, device_counts=None, rounds=None) -> dict:
+    """The bench-phase entry: rows + scaling efficiencies vs 1 device."""
+    counts = tuple(device_counts or ((1, 4) if fast else (1, 2, 4, 8)))
+    rounds = rounds or (3 if fast else 6)
+    rows = [_run_one(n, rounds) for n in counts]
+    t1 = rows[0]["suggest_ms"]
+    for row in rows:
+        n, tn = row["n_devices"], row["suggest_ms"]
+        row["speedup_vs_1dev"] = round(t1 / tn, 3) if tn else None
+        row["efficiency"] = round(t1 / (n * tn), 3) if tn else None
+    return {"n_cand_total": N_CAND, "history_rows": HISTORY,
+            "rounds": rounds, "rows": rows,
+            "headline_efficiency_max_mesh": rows[-1]["efficiency"]}
+
+
+def main():
+    data = collect(fast=os.environ.get("HYPEROPT_TPU_BENCH_FAST") == "1")
+    for row in data["rows"]:
+        print(json.dumps(row), flush=True)
+    stamp = time.strftime("%Y%m%d_%H%M")
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        f"multichip_cpu_{stamp}.json")
+    with open(path, "w") as f:
+        json.dump({"metric": "sharded_suggest_scaling",
+                   "backend": "cpu",
+                   "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime()),
+                   **data}, f, indent=1)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

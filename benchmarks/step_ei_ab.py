@@ -95,6 +95,56 @@ def toggle_ab(res, backend):
     res["toggles"] = out
 
 
+def sharded_toggle_ab(res, backend):
+    """PR 15 re-run of the toggle canary on the dispatch substrate's
+    SHARDED kernel shapes: the bf16 / top-M toggles may only flip
+    sharded defaults under the same rule as local — bit-identical
+    proposals (``proposals_identical``) plus a speed win.  Shapes pick
+    candidate counts divisible by the full candidate mesh axis."""
+    from hyperopt_tpu import dispatch
+
+    mesh = dispatch.default_mesh()
+    n_shards = mesh.shape[dispatch.CAND_AXIS]
+    if backend == "tpu":
+        shapes = {"10k_50": (50, 10_240, 32), "96k_100": (100, 98_304, 8)}
+    else:
+        shapes = {"1k_10": (10, 1_024, 8), "4k_20": (20, 4_096, 3)}
+    configs = {
+        "baseline": {},
+        "bf16": {"HYPEROPT_TPU_EI_PRECISION": "bf16"},
+        "topm16": {"HYPEROPT_TPU_EI_TOPM": "16"},
+    }
+    out = {"mesh": dict(mesh.shape),
+           "note": ("substrate sharded kernel, same canary rule as the "
+                    "local toggles: bit-identical or no default flip")}
+    for name, (n_dims, n_cand, k_steady) in shapes.items():
+        assert n_cand % n_shards == 0, (name, n_cand, n_shards)
+        cs, n_cap, hist = _step_fixture(name, n_dims, n_cand)
+        rec, rows = {}, {}
+        for cfg, env in configs.items():
+            for k, v in env.items():
+                os.environ[k] = v
+            try:
+                kern = dispatch.get_kernel(cs, n_cap, n_cand, 25,
+                                           mesh=mesh, strict=True)
+                with mesh:
+                    rows[cfg], rec[f"{cfg}_ms"] = _timed_steps(
+                        kern, hist, k_steady)
+            except Exception as e:
+                rec[f"{cfg}_error"] = f"{type(e).__name__}: {e}"
+            for k in env:
+                os.environ.pop(k, None)
+        for cfg in ("bf16", "topm16"):
+            if cfg in rows and "baseline" in rows:
+                rec[f"{cfg}_proposals_identical"] = bool(
+                    (rows[cfg] == rows["baseline"]).all())
+                rec[f"{cfg}_proposal_max_absdiff"] = float(
+                    np.max(np.abs(rows[cfg] - rows["baseline"])))
+        out[name] = rec
+        print(json.dumps({f"sharded/{name}": rec}), flush=True)
+    res["sharded_toggles"] = out
+
+
 def main():
     from hyperopt_tpu.tpe import get_kernel
 
@@ -124,6 +174,7 @@ def main():
         print(json.dumps({name: rec}), flush=True)
 
     toggle_ab(res, backend)
+    sharded_toggle_ab(res, backend)
 
     stamp = time.strftime("%Y%m%d_%H%M", time.gmtime())
     out_path = os.path.join(_ROOT, "benchmarks",

@@ -1,0 +1,488 @@
+"""One dispatch substrate: sharding × fleet lanes × pipeline depth × head.
+
+ROADMAP item 1 ("the unlock"): the repo grew four partially overlapping
+suggest paths — local (``tpe.suggest_dispatch``), mesh-sharded
+(``parallel/sharded.py``), multi-start, and fleet cohorts — that could not
+compose.  This module is the single substrate they all route through now:
+
+* **sharding** — the EI candidate axis split over a ``jax.sharding.Mesh``
+  (``ShardedTpeKernel``: collective top-k/argmax over ICI), the
+  data-parallel accelerator-runtime framing of Tran et al.
+  (PAPERS.md, arXiv:1811.02091);
+* **fleet lanes** — the vmap axis over experiments
+  (``fleet.CohortScheduler`` acquires its kernels here, so a cohort's
+  lane stack runs against the mesh-sharded kernel when one is active —
+  the population-as-array idiom of evosax, arXiv:2212.04180);
+* **pipeline depth** — the substrate returns ordinary ``tpe`` dispatch
+  handles (``("pending", cs, new_ids, arrs, exp_key)``), so the four
+  async halves (dispatch / materialize / start_transfer / handle_ready)
+  and ``fmin``'s depth-D executor compose without knowing a mesh exists;
+* **head** — ``tpe`` / ``tpe_quantile`` both enter through
+  ``tpe.suggest_dispatch``, which consults :func:`active_mesh` and
+  delegates here, so every head registered in ``backends/contract.py``
+  that routes through the canonical dispatch inherits sharding.
+
+Mode selection (``HYPEROPT_TPU_DISPATCH``):
+
+* ``auto`` (default) — sharded when a mesh was registered
+  (:func:`set_default_mesh`, done by ``parallel.multihost.initialize``)
+  or passed explicitly; local otherwise.  Nothing changes for
+  single-process CPU runs even though tests fake 8 devices.
+* ``sharded`` — build a mesh over all visible devices and shard every
+  suggest (the opt-in the CPU parity tests use).
+* ``local`` — never shard, even with a registered mesh (kill switch).
+
+The sharded kernel is numerics-preserving (a ``with_sharding_constraint``
+on the candidate axis, nothing else), so substrate output is bit-identical
+to the local path at the same (seed, n_cand, history) — pinned by
+``tests/test_dispatch.py``.
+
+Cache discipline: sharded kernels live in ``cs._dispatch_kernels`` keyed by
+the FULL local kernel key (all 15 env-toggle components of
+``tpe.get_kernel``) plus the mesh layout — the legacy
+``_sharded_tpe_kernels`` cache omitted ``prng_impl``/``HYPEROPT_TPU_EI_*``
+toggles and could hand back a stale kernel after an env flip.  Hits/misses
+feed the same ``kernel_cache_stats`` counters as the local cache: one
+compile per (head, tier, mesh-shape), asserted by the MULTICHIP bench.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import base
+from . import history as _rhist
+from . import tpe as _tpe
+from .obs import kernel_cache_event
+from .obs import costs as _costs
+from .obs.metrics import registry as _metrics_registry
+from .space import CompiledSpace, prng_impl, prng_key
+
+CAND_AXIS = "sp"    # candidate (sequence-like long) axis
+START_AXIS = "dp"   # independent-posterior (data-parallel) axis
+
+
+# ---------------------------------------------------------------------------
+# mode + mesh registry
+# ---------------------------------------------------------------------------
+
+
+def mode() -> str:
+    """Dispatch-substrate routing mode (``HYPEROPT_TPU_DISPATCH``).
+
+    ``auto`` (default) — sharded iff a mesh is registered or passed;
+    ``sharded`` — force a mesh over all visible devices; ``local`` —
+    never shard.  Unrecognized spellings fall back to ``auto`` (the
+    conservative mode: behavior only changes when a mesh was
+    deliberately provided)."""
+    env = os.environ.get("HYPEROPT_TPU_DISPATCH", "auto").strip().lower()
+    return env if env in ("local", "sharded") else "auto"
+
+
+_MESH_LOCK = threading.Lock()
+_DEFAULT_MESH = None   # registered by multihost.initialize() / tests
+_ENV_MESH = None       # lazily built for mode()=="sharded"
+
+
+def set_default_mesh(mesh):
+    """Register the process-wide default mesh (``auto`` mode shards once
+    one is registered).  Pass ``None`` to unregister."""
+    global _DEFAULT_MESH
+    with _MESH_LOCK:
+        _DEFAULT_MESH = mesh
+    return mesh
+
+
+def clear_default_mesh():
+    """Drop both the registered and the env-built mesh (test hygiene)."""
+    global _DEFAULT_MESH, _ENV_MESH
+    with _MESH_LOCK:
+        _DEFAULT_MESH = None
+        _ENV_MESH = None
+
+
+def active_mesh(mesh=None):
+    """Resolve the mesh the substrate should shard over, or ``None`` for
+    the local path.  Explicit ``mesh`` wins; ``local`` mode vetoes
+    everything; ``sharded`` mode lazily builds (and memoizes) a mesh over
+    all visible devices; ``auto`` uses only a registered default."""
+    m = mode()
+    if m == "local":
+        return None
+    if mesh is not None:
+        return mesh
+    with _MESH_LOCK:
+        if _DEFAULT_MESH is not None:
+            return _DEFAULT_MESH
+    if m == "sharded":
+        global _ENV_MESH
+        with _MESH_LOCK:
+            if _ENV_MESH is None:
+                _ENV_MESH = default_mesh()
+            return _ENV_MESH
+    return None
+
+
+# ---------------------------------------------------------------------------
+# mesh helpers (canonical home; parallel.sharded re-exports for compat)
+# ---------------------------------------------------------------------------
+
+
+def _shard_map(f, mesh, in_specs, out_specs):
+    """``jax.shard_map`` with a jax-0.4.x fallback.
+
+    ``shard_map`` graduated from ``jax.experimental`` only in jax 0.5;
+    on 0.4.x the top-level symbol is absent and the replication-check
+    kwarg is still spelled ``check_rep``.  Feature-detect rather than
+    version-parse so pre-release builds resolve correctly."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm
+
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)
+
+
+def default_mesh(devices=None, n_starts=1):
+    """Build a ``(dp=n_starts, sp=rest)`` mesh over the available devices."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    if n % n_starts:
+        raise ValueError(f"{n} devices not divisible by n_starts={n_starts}")
+    return Mesh(devices.reshape(n_starts, n // n_starts),
+                (START_AXIS, CAND_AXIS))
+
+
+def _mesh_key(mesh):
+    """Stable cache key for a mesh — device ids + layout, not ``id(mesh)``
+    (a garbage-collected mesh's id can be recycled by a new mesh, handing
+    back a kernel bound to the dead mesh's sharding)."""
+    return (mesh.axis_names, mesh.devices.shape,
+            tuple(d.id for d in mesh.devices.flat))
+
+
+class ShardedTpeKernel(_tpe._TpeKernel):
+    """TPE suggest step with the candidate axis sharded over a mesh.
+
+    Same math as :class:`~hyperopt_tpu.tpe._TpeKernel`; the only difference
+    is a ``with_sharding_constraint`` on every candidate-axis array, which
+    makes XLA partition the EI sweep across ``mesh[CAND_AXIS]`` and reduce
+    the argmax over ICI.
+    """
+
+    def __init__(self, cs: CompiledSpace, n_cap, n_cand, lf, mesh,
+                 split="sqrt", multivariate=False, cat_prior=None):
+        self.mesh = mesh
+        n_shards = mesh.shape[CAND_AXIS]
+        if n_cand % n_shards:
+            raise ValueError(
+                f"n_EI_candidates={n_cand} not divisible by the "
+                f"{n_shards}-way candidate mesh axis")
+        # Chunked scoring would fight the sharding constraint; per-device
+        # candidate counts are modest, so score in one block.
+        self.score_chunk = n_cand + 1
+        super().__init__(cs, n_cap, n_cand, lf, split,
+                         multivariate=multivariate, cat_prior=cat_prior)
+
+    def _constrain_cand(self, x, axis=-1):
+        spec = [None] * x.ndim
+        spec[axis if axis >= 0 else x.ndim + axis] = CAND_AXIS
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P(*spec)))
+
+
+# ---------------------------------------------------------------------------
+# unified kernel acquisition
+# ---------------------------------------------------------------------------
+
+
+def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
+               split: str = "sqrt", multivariate: bool = False,
+               cat_prior=None, mesh=None, strict: bool = False):
+    """The one kernel-acquisition point for every suggest path.
+
+    ``mesh=None`` → exactly ``tpe.get_kernel`` (the local path keeps its
+    cache, key, and bit-for-bit numerics).  With a mesh, a
+    :class:`ShardedTpeKernel` from ``cs._dispatch_kernels``, keyed by the
+    full local toggle key + mesh layout + routing mode, instrumented
+    through the same ``kernel_cache_stats`` / cost-ledger hooks.
+
+    Indivisible ``n_cand`` (candidate axis does not split over the mesh):
+    ``strict=True`` (the legacy ``parallel.sharded`` surface) raises the
+    pinned ValueError; ``strict=False`` (ambient routing) falls back to
+    the local kernel and counts ``dispatch.local`` — an env-selected mesh
+    must never turn a working config into a crash."""
+    if mesh is None:
+        return _tpe.get_kernel(cs, n_cap, n_cand, lf, split,
+                               multivariate, cat_prior)
+    n_shards = mesh.shape[CAND_AXIS]
+    if n_cand % n_shards:
+        if strict:
+            raise ValueError(
+                f"n_EI_candidates={n_cand} not divisible by the "
+                f"{n_shards}-way candidate mesh axis")
+        _metrics_registry().counter("dispatch.fallback_indivisible").inc()
+        return _tpe.get_kernel(cs, n_cap, n_cand, lf, split,
+                               multivariate, cat_prior)
+    from .ops.gmm import _comp_sampler
+
+    with _tpe._KERNELS_LOCK:
+        cache = getattr(cs, "_dispatch_kernels", None)
+        if cache is None:
+            cache = cs._dispatch_kernels = {}
+    cat_prior = cat_prior or _tpe._cat_prior_default()
+    # Full local key discipline (every toggle baked into the traced
+    # program) + the mesh layout: the legacy sharded cache omitted the
+    # prng/EI toggles and could serve a stale kernel after an env flip.
+    k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
+         _tpe._pallas_mode(), _comp_sampler(), _tpe._pallas_tile(),
+         _tpe._split_impl(), prng_impl(), _tpe._pallas_ei_impl(),
+         _tpe._ei_precision(), _tpe._ei_topm(), _rhist.enabled(),
+         ("mesh",) + _mesh_key(mesh))
+    with _tpe._KERNELS_LOCK:
+        hit = k in cache
+        if not hit:
+            cache[k] = ShardedTpeKernel(cs, n_cap, n_cand, lf, mesh, split,
+                                        multivariate=multivariate,
+                                        cat_prior=cat_prior)
+    kernel_cache_event(k, hit)
+    kern = cache[k]
+    kern._cost_key = k
+    if not hit:
+        def _lower(kern=kern):
+            import jax.numpy as jnp
+
+            f32 = jnp.float32
+            sd = jax.ShapeDtypeStruct
+            nc, p = kern.n_cap, kern.cs.n_params
+            return kern._fn_seeded.lower(
+                sd((), jnp.uint32),
+                sd((nc, p), f32), sd((nc, p), jnp.bool_),
+                sd((nc,), f32), sd((nc,), jnp.bool_),
+                sd((), f32), sd((), f32)).compile()
+        _costs.record_compile("tpe_sharded", k, _lower, n_cap=n_cap,
+                              P=cs.n_params, m=1)
+    return kern
+
+
+# ---------------------------------------------------------------------------
+# the substrate dispatch (sharded twin of tpe.suggest_dispatch)
+# ---------------------------------------------------------------------------
+
+
+def suggest_dispatch(new_ids, domain, trials, seed, mesh=None, strict=False,
+                     prior_weight=_tpe._default_prior_weight,
+                     n_startup_jobs=_tpe._default_n_startup_jobs,
+                     n_EI_candidates=_tpe._default_n_EI_candidates,
+                     gamma=_tpe._default_gamma,
+                     linear_forgetting=_tpe._default_linear_forgetting,
+                     split="sqrt", multivariate=False, startup=None,
+                     cat_prior=None, verbose=True):
+    """Mesh-sharded twin of :func:`tpe.suggest_dispatch`.
+
+    Identical control flow and numerics (same bucket math, same resident
+    feed, same seeded entries, same handle protocol) with the kernel
+    acquired through :func:`get_kernel` — so the handle is materialized /
+    start-transferred / pipelined by the unchanged ``tpe`` halves, and
+    the output is bit-identical to the local path on a fixed seed.
+
+    The resident ring is fed with a mesh-replicated placement
+    (``NamedSharding(mesh, P())``) keyed by the mesh layout, so sharded
+    suggest inherits the O(P) delta-append upload path; cohort coalescing
+    composes in ``fleet.CohortScheduler``, which acquires its batched
+    kernel from the same :func:`get_kernel`."""
+    mesh = active_mesh(mesh)
+    if mesh is None:
+        return _tpe.suggest_dispatch(
+            new_ids, domain, trials, seed, prior_weight=prior_weight,
+            n_startup_jobs=n_startup_jobs, n_EI_candidates=n_EI_candidates,
+            gamma=gamma, linear_forgetting=linear_forgetting, split=split,
+            multivariate=multivariate, startup=startup, cat_prior=cat_prior,
+            verbose=verbose)
+    cs = domain.cs
+    n = len(new_ids)
+    exp_key = getattr(trials, "exp_key", None)
+    if n == 0 or cs.n_params == 0:
+        return ("ready", cs, list(new_ids),
+                (np.zeros((n, cs.n_params), np.float32),
+                 np.ones((n, cs.n_params), bool)), exp_key)
+    h = trials.history(cs)
+    if int(h["ok"].sum()) < n_startup_jobs:
+        v, a = _tpe._startup_batch(startup, new_ids, domain, trials, seed)
+        if not isinstance(a, np.ndarray):
+            v = np.asarray(v)
+            a = cs.active_mask_host(v)
+        return ("ready", cs, list(new_ids),
+                (np.asarray(v), np.asarray(a)), exp_key)
+    resident = _rhist.enabled()
+    fant = None
+    if resident:
+        fant = _tpe._inflight_fantasy_rows(h, trials, cs)
+        n_rows = h["vals"].shape[0] + (fant[0].shape[0] if fant else 0)
+    else:
+        h = _tpe._with_inflight_fantasies(h, trials, cs)
+        n_rows = h["vals"].shape[0]
+    m = _tpe._batch_size_for(n)
+    kern = get_kernel(cs, _tpe._bucket(n_rows + (m if n > 1 else 0)),
+                      int(n_EI_candidates), int(linear_forgetting), split,
+                      multivariate, cat_prior, mesh=mesh, strict=strict)
+    sharded = getattr(kern, "mesh", None) is not None
+    reg = _metrics_registry()
+    if sharded:
+        reg.counter("dispatch.sharded").inc()
+    else:
+        reg.counter("dispatch.local").inc()
+    if n_rows >= 0.75 * kern.n_cap:
+        _tpe._prewarm_async(
+            get_kernel(cs, kern.n_cap * 2, int(n_EI_candidates),
+                       int(linear_forgetting), split, multivariate,
+                       cat_prior, mesh=mesh, strict=strict), n=m)
+        if resident:
+            _rhist.pregrow(trials, cs, kern.n_cap * 2)
+    from time import perf_counter
+
+    t_feed = perf_counter()
+    if resident:
+        # Resident history replicated over the mesh (P() = no sharded
+        # dims); placement keys the store so a plain-jit path on the same
+        # trials keeps its own canonical buffers.
+        kw = (dict(sharding=NamedSharding(mesh, P()),
+                   shard_key=_mesh_key(mesh)) if sharded else {})
+        hv, ha, hl, hok = _rhist.device_history(
+            trials, cs, h, kern.n_cap, fantasies=fant, **kw)
+    else:
+        hv, ha, hl, hok = _tpe._padded_history(h, kern.n_cap)
+    _tpe._obs_ms(reg, "suggest.upload_ms", (perf_counter() - t_feed) * 1e3)
+    t_disp = perf_counter()
+    seed32 = int(seed) % (2 ** 32)
+    from contextlib import nullcontext
+
+    with (mesh if sharded else nullcontext()):
+        if n == 1:
+            arrs = kern.suggest_seeded(seed32, hv, ha, hl, hok,
+                                       gamma, prior_weight)
+        else:
+            arrs = kern.suggest_many_seeded(seed32, m, n_rows, hv, ha,
+                                            hl, hok, gamma, prior_weight)
+            _tpe._prewarm_async(kern, n=1)
+    dms = (perf_counter() - t_disp) * 1e3
+    _tpe._obs_ms(reg, "suggest.dispatch_ms", dms)
+    _costs.observe_dispatch(getattr(kern, "_cost_key", None), dms)
+    return ("pending", cs, list(new_ids), arrs, exp_key)
+
+
+# ---------------------------------------------------------------------------
+# multi-start: K independent posteriors across the mesh (canonical home)
+# ---------------------------------------------------------------------------
+
+
+def _multi_start_fn(kern, mesh):
+    """Build the shard_mapped K-start suggest step (cached per kernel;
+    shape-polymorphic in the number of starts via jit retracing).
+
+    Each start gets its OWN γ (``gammas`` is sharded like ``keys``): K
+    EI-argmax draws against one posterior at a single γ collapse onto the
+    same EI peak (the batch-collapse defect tpe._liar_scan fixes
+    sequentially), but the sequential liar would serialize the mesh.  A
+    per-start γ spread diversifies in parallel instead — different
+    below/above splits give genuinely different posteriors, so the K
+    argmax winners spread while every start still exploits the history."""
+
+    def one_host(keys, gammas, vals, active, loss, ok, prior_weight):
+        # keys/gammas: [local] — this device's share of the K starts.
+        return jax.vmap(
+            lambda k, g: kern._suggest_one(k, vals, active, loss, ok,
+                                           g, prior_weight))(keys, gammas)
+
+    return jax.jit(_shard_map(
+        one_host, mesh=mesh,
+        in_specs=(P(START_AXIS), P(START_AXIS), P(), P(), P(), P(), P()),
+        out_specs=P(START_AXIS)))
+
+
+def _gamma_spread(gamma, n_starts):
+    """Per-start γ ladder: ``γ·2**linspace(-1, 1, K)`` clipped to a sane
+    split range; K=1 degenerates to the base γ."""
+    if n_starts == 1:
+        return np.asarray([gamma], np.float32)
+    return np.clip(gamma * np.exp2(np.linspace(-1.0, 1.0, n_starts)),
+                   0.05, 0.75).astype(np.float32)
+
+
+def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
+                        prior_weight=_tpe._default_prior_weight,
+                        n_startup_jobs=_tpe._default_n_startup_jobs,
+                        n_EI_candidates=_tpe._default_n_EI_candidates,
+                        gamma=_tpe._default_gamma,
+                        linear_forgetting=_tpe._default_linear_forgetting,
+                        split="sqrt", multivariate=False, startup=None,
+                        cat_prior=None):
+    """``algo=`` callable proposing ``len(new_ids)`` configs in ONE device
+    program: each new trial gets its own RNG stream AND its own γ from a
+    ``2**linspace(-1,1,K)`` ladder (see ``_gamma_spread``) — the
+    mesh-parallel answer to batch collapse, laid out one-per-mesh-slot
+    along the ``dp`` axis.
+
+    Use with ``fmin(..., max_queue_len=K)`` (or an async Trials backend) to
+    evaluate K proposals in parallel — BASELINE.md config 4.
+    """
+    from . import rand
+
+    cs = domain.cs
+    if mesh is None:
+        mesh = Mesh(np.asarray(jax.devices()), (START_AXIS,))
+    h = trials.history(cs)
+    if cs.n_params == 0:
+        return rand.suggest(new_ids, domain, trials, seed)
+    if int(h["ok"].sum()) < n_startup_jobs:
+        v, a = _tpe._startup_batch(startup, new_ids, domain, trials, seed)
+        if not isinstance(a, np.ndarray):
+            v = np.asarray(v)
+            a = cs.active_mask_host(v)
+        return base.docs_from_samples(cs, new_ids, np.asarray(v),
+                                      np.asarray(a),
+                                      exp_key=getattr(trials, "exp_key",
+                                                      None))
+    n = len(new_ids)
+    resident = _rhist.enabled()
+    fant = None
+    if resident:
+        fant = _tpe._inflight_fantasy_rows(h, trials, cs)
+        n_rows = h["vals"].shape[0] + (fant[0].shape[0] if fant else 0)
+    else:
+        h = _tpe._with_inflight_fantasies(h, trials, cs)
+        n_rows = h["vals"].shape[0]
+    n_dev = mesh.shape[START_AXIS]
+    n_starts = -(-n // n_dev) * n_dev  # round up to fill the mesh axis
+    kern = _tpe.get_kernel(cs, _tpe._bucket(n_rows), int(n_EI_candidates),
+                           int(linear_forgetting), split,
+                           multivariate=multivariate, cat_prior=cat_prior)
+    cache = getattr(cs, "_multi_start_fns", None)
+    if cache is None:
+        cache = cs._multi_start_fns = {}
+    ck = (id(kern), _mesh_key(mesh))
+    if ck not in cache:
+        cache[ck] = _multi_start_fn(kern, mesh)
+    fn = cache[ck]
+
+    if resident:
+        hv, ha, hl, hok = _rhist.device_history(
+            trials, cs, h, kern.n_cap, fantasies=fant,
+            sharding=NamedSharding(mesh, P()), shard_key=_mesh_key(mesh))
+    else:
+        hv, ha, hl, hok = _tpe._padded_history(h, kern.n_cap)
+    keys = jax.random.split(prng_key(int(seed) % (2 ** 32)), n_starts)
+    with mesh:
+        rows, _ = fn(keys, _gamma_spread(gamma, n_starts), hv, ha, hl, hok,
+                     np.float32(prior_weight))
+    rows = np.asarray(rows)[:n]
+    return base.docs_from_samples(cs, new_ids, rows,
+                                  cs.active_mask_host(rows),
+                                  exp_key=getattr(trials, "exp_key", None))

@@ -291,9 +291,19 @@ class CohortScheduler:
         if state is None:
             state = self._states[key] = _CohortState()
         rep = self._rep(sig, members[0].cs)
-        kern = tpe.get_kernel(rep, n_cap, self.n_EI_candidates,
-                              self.linear_forgetting, self.split,
-                              self.multivariate, self.cat_prior)
+        # Kernel via the dispatch substrate: with an active mesh the
+        # cohort's vmapped lane stack runs against the candidate-sharded
+        # kernel (fleet lanes × sharding compose); without one this is
+        # exactly tpe.get_kernel.  Non-strict — an indivisible candidate
+        # count falls back to the local kernel rather than failing the
+        # whole cohort.
+        from . import dispatch as _dispatch
+
+        mesh = _dispatch.active_mesh()
+        kern = _dispatch.get_kernel(rep, n_cap, self.n_EI_candidates,
+                                    self.linear_forgetting, self.split,
+                                    self.multivariate, self.cat_prior,
+                                    mesh=mesh)
 
         # Stable lane assignment: returning experiments keep their lane
         # (tids-prefix delta-append stays hot), dead lanes free up,
@@ -371,9 +381,13 @@ class CohortScheduler:
             _rhist.pregrow_batched(state.store, n_cap * 2)
 
         t_disp = perf_counter()
-        rows_b, _acts_b = kern.suggest_fleet_seeded(
-            seeds, m, n_rows, *bufs,
-            [self.gamma] * b, [self.prior_weight] * b)
+        from contextlib import nullcontext
+
+        kern_mesh = getattr(kern, "mesh", None)
+        with (kern_mesh if kern_mesh is not None else nullcontext()):
+            rows_b, _acts_b = kern.suggest_fleet_seeded(
+                seeds, m, n_rows, *bufs,
+                [self.gamma] * b, [self.prior_weight] * b)
         tpe._obs_ms(reg, "suggest.dispatch_ms",
                     (perf_counter() - t_disp) * 1e3)
 

@@ -1,30 +1,43 @@
 """Multi-host composition: jax.distributed + shared trial store.
 
+.. deprecated:: PR 15
+    Thin compat shim.  :func:`initialize` now registers the global mesh
+    with :mod:`hyperopt_tpu.dispatch` (``set_default_mesh``), so after
+    initialization plain ``tpe.suggest`` / ``fmin`` IS the mesh-sharded
+    path — the explicit ``sharded_suggest`` wiring below remains only for
+    callers pinning this module's legacy surface.  Cross-host trial
+    exchange is rerouted from the filestore mount to the hardened
+    suggestion-service netstore whenever ``store_root`` is a service URL
+    (``http(s)://…``): pinned idempotency keys and WAL durability replace
+    rename-based mount atomicity.
+
 The reference scales across machines with MongoDB + worker daemons
 (SURVEY.md §3.4); the TPU-native equivalent is two tiers (SURVEY.md §5.8):
 
-* **intra-slice (ICI)** — handled by ``parallel.sharded`` (the mesh spans
-  all hosts' devices once ``jax.distributed`` is initialized; ``shard_map``
+* **intra-slice (ICI)** — handled by the dispatch substrate (the mesh
+  spans all hosts' devices once ``jax.distributed`` is initialized;
   collectives ride ICI).
-* **cross-host (DCN / shared storage)** — the elastic
-  :class:`~hyperopt_tpu.parallel.filestore.FileTrials` store on a mount all
-  hosts see (GCS-fuse / NFS), playing MongoDB's role.
+* **cross-host (DCN)** — a shared trial store all hosts reach: the
+  PR 13 service netstore (:class:`~.netstore.NetTrials`, preferred) or
+  the legacy :class:`~.filestore.FileTrials` mount (GCS-fuse / NFS),
+  playing MongoDB's role.
 
-This module is the thin glue: initialize the distributed runtime, build the
-global mesh, and run either the driver role (suggest + enqueue) or the
-worker role (evaluate).  On a single host it degrades to the local mesh —
-which is how it is exercised in CI (no multi-host hardware here; the
-single-controller code path is identical by jax.distributed's design).
+This module is the thin glue: initialize the distributed runtime, build
+and register the global mesh, and run either the driver role (suggest +
+enqueue) or the worker role (evaluate).  On a single host it degrades to
+the local mesh — which is how it is exercised in CI (no multi-host
+hardware here; the single-controller code path is identical by
+jax.distributed's design).
 
 Typical pod usage (same program on every host)::
 
     from hyperopt_tpu.parallel import multihost
     mesh = multihost.initialize()          # no-op args on single host
     if multihost.is_coordinator():
-        multihost.run_driver(fn, space, store_root="/gcs/exp",
+        multihost.run_driver(fn, space, store_root="http://store:8080",
                              max_evals=1000, mesh=mesh)
     else:
-        multihost.run_worker(store_root="/gcs/exp")
+        multihost.run_worker(store_root="http://store:8080")
 """
 
 from __future__ import annotations
@@ -37,11 +50,18 @@ import jax
 logger = logging.getLogger(__name__)
 
 
+def _is_service_url(store_root: str) -> bool:
+    return store_root.startswith(("http://", "https://"))
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
                process_id: Optional[int] = None):
-    """Initialize jax.distributed (no-op when no coordinator is given) and
-    return the global ``(dp, sp)`` mesh over ALL hosts' devices.
+    """Initialize jax.distributed (no-op when no coordinator is given),
+    build the global ``(dp, sp)`` mesh over ALL hosts' devices, and
+    register it as the dispatch substrate's default — from here on every
+    ``tpe.suggest`` in this process is mesh-sharded
+    (``HYPEROPT_TPU_DISPATCH=local`` is the kill switch).
 
     The distributed runtime comes up whenever the caller supplies any
     multi-process signal: ``num_processes > 1`` (coordinator auto-detected by
@@ -54,9 +74,10 @@ def initialize(coordinator_address: Optional[str] = None,
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
-    from .sharded import default_mesh
+    from .. import dispatch
 
-    return default_mesh(devices=jax.devices(), n_starts=1)
+    return dispatch.set_default_mesh(
+        dispatch.default_mesh(devices=jax.devices(), n_starts=1))
 
 
 def is_coordinator() -> bool:
@@ -65,24 +86,33 @@ def is_coordinator() -> bool:
 
 def run_driver(fn, space, store_root: str, max_evals: int, mesh=None,
                exp_key: str = "default", n_EI_candidates: int = 4096,
-               stale_timeout: float = 600.0, **fmin_kwargs):
+               stale_timeout: float = 600.0, token: Optional[str] = None,
+               **fmin_kwargs):
     """Coordinator role: mesh-sharded TPE suggest + durable enqueue.
 
-    Workers (``run_worker`` on other hosts, or ``hyperopt-tpu-worker``
-    processes anywhere with the mount) evaluate; stale jobs from dead
-    workers are requeued automatically each loop.
+    ``store_root`` selects the exchange transport: a service URL routes
+    through the netstore (WAL-durable, idempotent verbs); a path keeps
+    the legacy shared-mount filestore.  Workers (``run_worker`` on other
+    hosts, or ``hyperopt-tpu-worker`` processes anywhere that reach the
+    store) evaluate; stale jobs from dead workers are requeued
+    automatically each loop.
     """
     from functools import partial
 
     from .. import fmin
-    from .filestore import FileTrials
+    from ..base import Domain
     from .sharded import sharded_suggest
 
-    trials = FileTrials(store_root, exp_key=exp_key)
-    # Ship the Domain to workers explicitly (fmin is entered with
-    # allow_trials_fmin=False below, so FileTrials.fmin's save doesn't run).
-    from ..base import Domain
+    if _is_service_url(store_root):
+        from .netstore import NetTrials
 
+        trials = NetTrials(store_root, exp_key=exp_key, token=token)
+    else:
+        from .filestore import FileTrials
+
+        trials = FileTrials(store_root, exp_key=exp_key)
+    # Ship the Domain to workers explicitly (fmin is entered with
+    # allow_trials_fmin=False below, so the store's fmin-save doesn't run).
     trials.save_domain(Domain(fn, space))
     algo = partial(sharded_suggest, mesh=mesh,
                    n_EI_candidates=n_EI_candidates)
@@ -101,10 +131,19 @@ def run_driver(fn, space, store_root: str, max_evals: int, mesh=None,
 
 
 def run_worker(store_root: str, exp_key: str = "default", **worker_kwargs):
-    """Worker role: evaluate trials from the shared store until idle."""
-    from .filestore import FileWorker
+    """Worker role: evaluate trials from the shared store until idle.
 
-    worker = FileWorker(store_root, exp_key=exp_key, **worker_kwargs)
+    Like :func:`run_driver`, a service-URL ``store_root`` selects the
+    netstore transport (every claim/write an idempotent, WAL-durable
+    verb); a path keeps the legacy mount."""
+    if _is_service_url(store_root):
+        from .netstore import NetWorker
+
+        worker = NetWorker(store_root, exp_key=exp_key, **worker_kwargs)
+    else:
+        from .filestore import FileWorker
+
+        worker = FileWorker(store_root, exp_key=exp_key, **worker_kwargs)
     n = worker.run()
     logger.info("multihost worker done: %d trials", n)
     return n

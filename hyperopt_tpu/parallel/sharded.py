@@ -1,22 +1,17 @@
-"""Device-mesh execution of the TPE suggest step.
+"""Compat shim over :mod:`hyperopt_tpu.dispatch` (deprecated import path).
 
-Two axes of scale (SURVEY.md §5.7-5.8 — the "long axis" of this framework is
-the EI candidate batch, and the data-parallel axis is independent posteriors):
-
-* ``ShardedTpeKernel`` — shards the **candidate axis** of the EI sweep over a
-  ``jax.sharding.Mesh``: candidates are drawn, scored ([n_cand, K] logsumexp
-  blocks) and arg-maxed with the candidate axis split across devices; XLA
-  inserts the ICI collectives for the final argmax reduce.  This is how a
-  100k-candidate × 50-dim sweep (BASELINE.md config 5) fits in per-chip HBM
-  and scales across a slice.
-
-* ``multi_start_suggest`` — runs **K independent TPE posteriors** (distinct
-  RNG streams over the same history) one per mesh slot via ``shard_map``,
-  yielding K diverse proposals in one device program: the TPU-native
-  equivalent of the reference's parallel-trial backends for batched
-  ``fmin(max_queue_len=K)`` (BASELINE.md config 4; reference analog:
-  ``SparkTrials`` thread-per-trial, SURVEY.md §3.5 — but here the *suggest*
-  itself is parallel, which the reference never does).
+.. deprecated:: PR 15
+    The mesh machinery that lived here — ``ShardedTpeKernel``, the
+    ``(dp, sp)`` ``default_mesh``, the shard_mapped multi-start step —
+    moved into :mod:`hyperopt_tpu.dispatch`, the one substrate where
+    sharding × fleet lanes × pipeline depth × backend head compose.
+    Mesh-sharded suggest is no longer an opt-in side path: with a mesh
+    registered (``dispatch.set_default_mesh`` /
+    ``HYPEROPT_TPU_DISPATCH=sharded``) plain ``tpe.suggest`` IS the
+    sharded path.  This module keeps the historical names importable and
+    the legacy ``algo=`` callables (``sharded_suggest``,
+    ``multi_start_suggest``) working unchanged; new code should pass a
+    mesh to the substrate instead of calling these directly.
 
 Works identically on a real TPU slice and on the virtual 8-device CPU mesh
 used by tests (``--xla_force_host_platform_device_count``).
@@ -24,124 +19,41 @@ used by tests (``--xla_force_host_platform_device_count``).
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from .. import base
-from .. import history as _rhist
-from ..space import CompiledSpace, prng_key
-from ..tpe import (
-    _TpeKernel,
+from .. import rand
+from .. import tpe as _tpe
+from ..dispatch import (          # noqa: F401  (compat re-exports)
+    CAND_AXIS,
+    START_AXIS,
+    ShardedTpeKernel,
+    _gamma_spread,
+    _mesh_key,
+    _multi_start_fn,
+    _shard_map,
+    default_mesh,
+    multi_start_suggest,
+)
+from ..tpe import (               # noqa: F401  (compat re-exports)
     _batch_size_for,
     _bucket,
-    _inflight_fantasy_rows,
-    _with_inflight_fantasies,
     _default_gamma,
     _default_linear_forgetting,
     _default_n_EI_candidates,
     _default_n_startup_jobs,
     _default_prior_weight,
-    _padded_history,
 )
-from .. import rand
-
-CAND_AXIS = "sp"    # candidate (sequence-like long) axis
-START_AXIS = "dp"   # independent-posterior (data-parallel) axis
-
-
-def _shard_map(f, mesh, in_specs, out_specs):
-    """``jax.shard_map`` with a jax-0.4.x fallback.
-
-    ``shard_map`` graduated from ``jax.experimental`` only in jax 0.5;
-    on 0.4.x the top-level symbol is absent and the replication-check
-    kwarg is still spelled ``check_rep``.  Feature-detect rather than
-    version-parse so pre-release builds resolve correctly."""
-    sm = getattr(jax, "shard_map", None)
-    if sm is not None:
-        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                  check_vma=False)
-    from jax.experimental.shard_map import shard_map as sm
-
-    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-              check_rep=False)
-
-
-def default_mesh(devices=None, n_starts=1):
-    """Build a ``(dp=n_starts, sp=rest)`` mesh over the available devices."""
-    devices = np.asarray(devices if devices is not None else jax.devices())
-    n = devices.size
-    if n % n_starts:
-        raise ValueError(f"{n} devices not divisible by n_starts={n_starts}")
-    return Mesh(devices.reshape(n_starts, n // n_starts),
-                (START_AXIS, CAND_AXIS))
-
-
-class ShardedTpeKernel(_TpeKernel):
-    """TPE suggest step with the candidate axis sharded over a mesh.
-
-    Same math as :class:`~hyperopt_tpu.tpe._TpeKernel`; the only difference
-    is a ``with_sharding_constraint`` on every candidate-axis array, which
-    makes XLA partition the EI sweep across ``mesh[CAND_AXIS]`` and reduce
-    the argmax over ICI.
-    """
-
-    def __init__(self, cs: CompiledSpace, n_cap, n_cand, lf, mesh,
-                 split="sqrt", multivariate=False, cat_prior=None):
-        self.mesh = mesh
-        n_shards = mesh.shape[CAND_AXIS]
-        if n_cand % n_shards:
-            raise ValueError(
-                f"n_EI_candidates={n_cand} not divisible by the "
-                f"{n_shards}-way candidate mesh axis")
-        # Chunked scoring would fight the sharding constraint; per-device
-        # candidate counts are modest, so score in one block.
-        self.score_chunk = n_cand + 1
-        super().__init__(cs, n_cap, n_cand, lf, split,
-                         multivariate=multivariate, cat_prior=cat_prior)
-
-    def _constrain_cand(self, x, axis=-1):
-        spec = [None] * x.ndim
-        spec[axis if axis >= 0 else x.ndim + axis] = CAND_AXIS
-        return jax.lax.with_sharding_constraint(
-            x, NamedSharding(self.mesh, P(*spec)))
-
-
-def _mesh_key(mesh):
-    """Stable cache key for a mesh — device ids + layout, not ``id(mesh)``
-    (a garbage-collected mesh's id can be recycled by a new mesh, handing
-    back a kernel bound to the dead mesh's sharding)."""
-    return (mesh.axis_names, mesh.devices.shape,
-            tuple(d.id for d in mesh.devices.flat))
 
 
 def _get_sharded_kernel(cs, n_cap, n_cand, lf, mesh, split,
                         multivariate=False, cat_prior=None):
-    from ..ops.gmm import _comp_sampler
-    from ..tpe import (
-        _cat_prior_default,
-        _pallas_mode,
-        _pallas_tile,
-        _split_impl,
-    )
+    """Legacy kernel accessor — now a view into the unified substrate
+    cache (``cs._dispatch_kernels``), which keys ALL env toggles the
+    local cache does (the old ``_sharded_tpe_kernels`` cache omitted the
+    prng/EI toggles and could hand back a stale kernel)."""
+    from .. import dispatch as _dispatch
 
-    cache = getattr(cs, "_sharded_tpe_kernels", None)
-    if cache is None:
-        cache = cs._sharded_tpe_kernels = {}
-    cat_prior = cat_prior or _cat_prior_default()
-    # Same key discipline as tpe.get_kernel: cat_prior, pallas mode, and
-    # the component-sampler lowering are baked into the compiled program,
-    # so they MUST key the cache — otherwise an env toggle mid-process
-    # hands back a stale kernel.
-    k = (n_cap, n_cand, lf, _mesh_key(mesh), split, multivariate,
-         cat_prior, _pallas_mode(), _comp_sampler(), _pallas_tile(),
-         _split_impl(), _rhist.enabled())
-    if k not in cache:
-        cache[k] = ShardedTpeKernel(cs, n_cap, n_cand, lf, mesh, split,
-                                    multivariate=multivariate,
-                                    cat_prior=cat_prior)
-    return cache[k]
+    return _dispatch.get_kernel(cs, n_cap, n_cand, lf, split,
+                                multivariate=multivariate,
+                                cat_prior=cat_prior, mesh=mesh, strict=True)
 
 
 def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
@@ -154,181 +66,30 @@ def sharded_suggest(new_ids, domain, trials, seed, mesh=None,
                     cat_prior=None):
     """Drop-in ``algo=`` callable: TPE with mesh-sharded EI scoring.
 
-    Defaults to a 4096-candidate sweep (vs the reference's 24 — the headroom
-    SURVEY.md §5.7 identifies): on TPU the wider sweep is nearly free and
-    sharded over the mesh's candidate axis.  Accepts the same tuning
-    kwargs as ``tpe.suggest`` (``multivariate``, ``startup``,
+    .. deprecated:: PR 15 — a thin wrapper over
+        ``dispatch.suggest_dispatch`` + ``tpe.suggest_materialize``; the
+        substrate shards plain ``tpe.suggest`` whenever a mesh is active,
+        so this wrapper only remains for callers pinning the explicit
+        ``mesh=`` / 4096-candidate legacy defaults.
+
+    Defaults to a 4096-candidate sweep (vs the reference's 24 — the
+    headroom SURVEY.md §5.7 identifies): on TPU the wider sweep is nearly
+    free and sharded over the mesh's candidate axis.  Accepts the same
+    tuning kwargs as ``tpe.suggest`` (``multivariate``, ``startup``,
     ``cat_prior`` — round-3 verdict ask #4), so a quality-tuned config
     ports to the mesh unchanged.
     """
-    from ..tpe import _startup_batch
+    from .. import dispatch as _dispatch
 
     cs = domain.cs
-    if mesh is None:
-        mesh = default_mesh()
-    h = trials.history(cs)
     if cs.n_params == 0:
         return rand.suggest(new_ids, domain, trials, seed)
-    if int(h["ok"].sum()) < n_startup_jobs:
-        v, a = _startup_batch(startup, new_ids, domain, trials, seed)
-        if not isinstance(a, np.ndarray):
-            v = np.asarray(v)
-            a = cs.active_mask_host(v)
-        return base.docs_from_samples(cs, new_ids, np.asarray(v),
-                                      np.asarray(a),
-                                      exp_key=getattr(trials, "exp_key",
-                                                      None))
-    n = len(new_ids)
-    resident = _rhist.enabled()
-    fant = None
-    if resident:
-        fant = _inflight_fantasy_rows(h, trials, cs)
-        n_rows = h["vals"].shape[0] + (fant[0].shape[0] if fant else 0)
-    else:
-        h = _with_inflight_fantasies(h, trials, cs)
-        n_rows = h["vals"].shape[0]
-    # Batched proposals run the inherited constant-liar scan (the sharding
-    # constraints live inside _suggest_one, so each scan step's EI sweep
-    # is still mesh-sharded): one dispatch + one fetch for all n, with
-    # m = pow2(n) rows of bucket slack for the fantasy cursor.
-    m = _batch_size_for(n)
-    kern = _get_sharded_kernel(cs, _bucket(n_rows + (m if n > 1 else 0)),
-                               int(n_EI_candidates), int(linear_forgetting),
-                               mesh, split, multivariate=multivariate,
-                               cat_prior=cat_prior)
-    if resident:
-        # Resident history replicated over the mesh (P() = no sharded
-        # dims); placement keys the store so a plain-jit path on the same
-        # trials keeps its own canonical buffers.
-        hv, ha, hl, hok = _rhist.device_history(
-            trials, cs, h, kern.n_cap, fantasies=fant,
-            sharding=NamedSharding(mesh, P()), shard_key=_mesh_key(mesh))
-    else:
-        hv, ha, hl, hok = _padded_history(h, kern.n_cap)
-    seed32 = int(seed) % (2 ** 32)
-    with mesh:
-        if n == 1:
-            # Seeded entry: key construction is compiled into the sharded
-            # program (one jit dispatch, no un-jitted random_seed/fold_in
-            # primitives on the host).
-            r, _ = kern.suggest_seeded(seed32, hv, ha, hl, hok,
-                                       gamma, prior_weight)
-            rows = np.asarray(r)[None, :]
-        else:
-            r, _ = kern.suggest_many_seeded(seed32, m, n_rows, hv, ha,
-                                            hl, hok, gamma, prior_weight)
-            rows = np.asarray(r)[:n]
-    # Values only (one fetch); masks rebuilt on host.
-    return base.docs_from_samples(cs, new_ids, rows,
-                                  cs.active_mask_host(rows),
-                                  exp_key=getattr(trials, "exp_key", None))
-
-
-# ---------------------------------------------------------------------------
-# multi-start: K independent posteriors across the mesh
-# ---------------------------------------------------------------------------
-
-
-def _multi_start_fn(kern, mesh):
-    """Build the shard_mapped K-start suggest step (cached per kernel;
-    shape-polymorphic in the number of starts via jit retracing).
-
-    Each start gets its OWN γ (``gammas`` is sharded like ``keys``): K
-    EI-argmax draws against one posterior at a single γ collapse onto the
-    same EI peak (the batch-collapse defect tpe._liar_scan fixes
-    sequentially), but the sequential liar would serialize the mesh.  A
-    per-start γ spread diversifies in parallel instead — different
-    below/above splits give genuinely different posteriors, so the K
-    argmax winners spread while every start still exploits the history."""
-
-    def one_host(keys, gammas, vals, active, loss, ok, prior_weight):
-        # keys/gammas: [local] — this device's share of the K starts.
-        return jax.vmap(
-            lambda k, g: kern._suggest_one(k, vals, active, loss, ok,
-                                           g, prior_weight))(keys, gammas)
-
-    return jax.jit(_shard_map(
-        one_host, mesh=mesh,
-        in_specs=(P(START_AXIS), P(START_AXIS), P(), P(), P(), P(), P()),
-        out_specs=P(START_AXIS)))
-
-
-def _gamma_spread(gamma, n_starts):
-    """Per-start γ ladder: ``γ·2**linspace(-1, 1, K)`` clipped to a sane
-    split range; K=1 degenerates to the base γ."""
-    if n_starts == 1:
-        return np.asarray([gamma], np.float32)
-    return np.clip(gamma * np.exp2(np.linspace(-1.0, 1.0, n_starts)),
-                   0.05, 0.75).astype(np.float32)
-
-
-def multi_start_suggest(new_ids, domain, trials, seed, mesh=None,
-                        prior_weight=_default_prior_weight,
-                        n_startup_jobs=_default_n_startup_jobs,
-                        n_EI_candidates=_default_n_EI_candidates,
-                        gamma=_default_gamma,
-                        linear_forgetting=_default_linear_forgetting,
-                        split="sqrt", multivariate=False, startup=None,
-                        cat_prior=None):
-    """``algo=`` callable proposing ``len(new_ids)`` configs in ONE device
-    program: each new trial gets its own RNG stream AND its own γ from a
-    ``2**linspace(-1,1,K)`` ladder (see ``_gamma_spread``) — the
-    mesh-parallel answer to batch collapse, laid out one-per-mesh-slot
-    along the ``dp`` axis.
-
-    Use with ``fmin(..., max_queue_len=K)`` (or an async Trials backend) to
-    evaluate K proposals in parallel — BASELINE.md config 4.
-    """
-    from ..tpe import _startup_batch, get_kernel
-
-    cs = domain.cs
     if mesh is None:
-        mesh = Mesh(np.asarray(jax.devices()), (START_AXIS,))
-    h = trials.history(cs)
-    if cs.n_params == 0:
-        return rand.suggest(new_ids, domain, trials, seed)
-    if int(h["ok"].sum()) < n_startup_jobs:
-        v, a = _startup_batch(startup, new_ids, domain, trials, seed)
-        if not isinstance(a, np.ndarray):
-            v = np.asarray(v)
-            a = cs.active_mask_host(v)
-        return base.docs_from_samples(cs, new_ids, np.asarray(v),
-                                      np.asarray(a),
-                                      exp_key=getattr(trials, "exp_key",
-                                                      None))
-    n = len(new_ids)
-    resident = _rhist.enabled()
-    fant = None
-    if resident:
-        fant = _inflight_fantasy_rows(h, trials, cs)
-        n_rows = h["vals"].shape[0] + (fant[0].shape[0] if fant else 0)
-    else:
-        h = _with_inflight_fantasies(h, trials, cs)
-        n_rows = h["vals"].shape[0]
-    n_dev = mesh.shape[START_AXIS]
-    n_starts = -(-n // n_dev) * n_dev  # round up to fill the mesh axis
-    kern = get_kernel(cs, _bucket(n_rows), int(n_EI_candidates),
-                      int(linear_forgetting), split,
-                      multivariate=multivariate, cat_prior=cat_prior)
-    cache = getattr(cs, "_multi_start_fns", None)
-    if cache is None:
-        cache = cs._multi_start_fns = {}
-    ck = (id(kern), _mesh_key(mesh))
-    if ck not in cache:
-        cache[ck] = _multi_start_fn(kern, mesh)
-    fn = cache[ck]
-
-    if resident:
-        hv, ha, hl, hok = _rhist.device_history(
-            trials, cs, h, kern.n_cap, fantasies=fant,
-            sharding=NamedSharding(mesh, P()), shard_key=_mesh_key(mesh))
-    else:
-        hv, ha, hl, hok = _padded_history(h, kern.n_cap)
-    keys = jax.random.split(prng_key(int(seed) % (2 ** 32)), n_starts)
-    with mesh:
-        rows, _ = fn(keys, _gamma_spread(gamma, n_starts), hv, ha, hl, hok,
-                     np.float32(prior_weight))
-    rows = np.asarray(rows)[:n]
-    return base.docs_from_samples(cs, new_ids, rows,
-                                  cs.active_mask_host(rows),
-                                  exp_key=getattr(trials, "exp_key", None))
+        mesh = _dispatch.active_mesh() or default_mesh()
+    handle = _dispatch.suggest_dispatch(
+        new_ids, domain, trials, seed, mesh=mesh, strict=True,
+        prior_weight=prior_weight, n_startup_jobs=n_startup_jobs,
+        n_EI_candidates=n_EI_candidates, gamma=gamma,
+        linear_forgetting=linear_forgetting, split=split,
+        multivariate=multivariate, startup=startup, cat_prior=cat_prior)
+    return _tpe.suggest_materialize(handle)

@@ -288,7 +288,14 @@ class ShardServer(ServiceServer):
         self._ship_token = (ship_token if ship_token is not None
                             else kw.get("token"))
         self._scrub_interval = scrub_interval
+        # A fenced replica refuses client suggests, so its cohort gate
+        # stays disarmed until promotion: hold the configured window back
+        # from the base constructor and arm in _promote_verb.
+        _window = (kw.pop("cohort_window_ms", None)
+                   if role == "replica" else None)
         super().__init__(wal_dir, **kw)
+        if _window:
+            self._cohort_window_ms = _window
         # Every durable append from here on fans out to the shippers
         # (recovery replay never appends, so the hook sees live traffic
         # only — the initial sync ships as one snapshot instead).
@@ -445,6 +452,17 @@ class ShardServer(ServiceServer):
             reg.counter("shard.promotions").inc()
             EVENTS.emit("shard_promote", seq=seq)
             logger.warning("shard PROMOTED to primary at seq %d", seq)
+        if (self._cohort_gate is None
+                and getattr(self, "_cohort_window_ms", None)):
+            # The replica fenced client suggests pre-promotion, so its
+            # gate was never armed; arm it NOW (outside the dispatch
+            # lock — the gate takes the lock itself per window) so a
+            # promoted shard resumes cohort batching instead of serving
+            # solo suggests forever.
+            from .server import _CohortGate
+
+            self._cohort_gate = _CohortGate(self, self._cohort_window_ms)
+            reg.counter("shard.cohort_gate_armed").inc()
         return {"role": "primary", "was": was, "seq": seq}
 
     def shutdown(self):
@@ -490,6 +508,10 @@ def main(argv=None):
     p.add_argument("--requeue-stale-every", type=float, default=None,
                    metavar="S")
     p.add_argument("--stale-timeout", type=float, default=60.0)
+    p.add_argument("--cohort-window-ms", type=float, default=None,
+                   metavar="MS",
+                   help="fleet-mode suggest coalescing window; a replica "
+                        "holds it disarmed and arms the gate at promotion")
     p.add_argument("--scrub-interval", type=float, default=None,
                    metavar="S",
                    help="background byte-identity scrub period (default: "
@@ -512,7 +534,8 @@ def main(argv=None):
                          tenants=tenants, fsync=args.fsync,
                          snapshot_every=args.snapshot_every,
                          requeue_stale_every=args.requeue_stale_every,
-                         stale_timeout=args.stale_timeout)
+                         stale_timeout=args.stale_timeout,
+                         cohort_window_ms=args.cohort_window_ms)
     print(f"shard: serving {args.wal_dir} ({args.role}) at {server.url}",
           flush=True)
 

@@ -257,6 +257,9 @@ class ServiceServer(StoreServer):
         self._snap_seq = 0
         # Fleet mode: hold concurrent tenants' suggests up to this many
         # milliseconds and serve the window from ONE vmapped dispatch.
+        # The window is kept so a fenced replica can arm its gate at
+        # promotion time (replica.ShardServer._promote_verb).
+        self._cohort_window_ms = cohort_window_ms
         self._cohort_gate = (_CohortGate(self, cohort_window_ms)
                              if cohort_window_ms else None)
         super().__init__(self.wal_root, host=host, port=port, token=token,

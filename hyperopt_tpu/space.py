@@ -721,8 +721,9 @@ class CompiledSpace:
     # Register every externally-attached kernel cache here (tpe.get_kernel,
     # anneal, parallel.sharded).
     _VOLATILE_ATTRS = ("_sampler_cache", "_tpe_kernels", "_anneal_kernel",
-                       "_sharded_tpe_kernels", "_multi_start_fns",
-                       "_device_fmin_cache", "_gp_kernels", "_es_kernels")
+                       "_sharded_tpe_kernels", "_dispatch_kernels",
+                       "_multi_start_fns", "_device_fmin_cache",
+                       "_gp_kernels", "_es_kernels")
 
     def __getstate__(self):
         state = self.__dict__.copy()

@@ -1260,7 +1260,23 @@ def suggest_dispatch(new_ids, domain, trials, seed,
     ordinary paths cannot drift apart.  Handle layout:
     ``(tag, cs, new_ids, (rows, acts), exp_key)`` with rows/acts either
     host arrays ("ready": empty-space or random-startup draws) or unforced
-    device arrays ("pending")."""
+    device arrays ("pending").
+
+    When a mesh is active (``HYPEROPT_TPU_DISPATCH`` / a registered
+    default mesh — see :mod:`hyperopt_tpu.dispatch`), the mesh-sharded
+    substrate IS the suggest path: same handle protocol, bit-identical
+    proposals, candidate axis split over the mesh."""
+    from . import dispatch as _dispatch
+
+    _mesh = _dispatch.active_mesh()
+    if _mesh is not None:
+        return _dispatch.suggest_dispatch(
+            new_ids, domain, trials, seed, mesh=_mesh,
+            prior_weight=prior_weight, n_startup_jobs=n_startup_jobs,
+            n_EI_candidates=n_EI_candidates, gamma=gamma,
+            linear_forgetting=linear_forgetting, split=split,
+            multivariate=multivariate, startup=startup,
+            cat_prior=cat_prior, verbose=verbose)
     cs = domain.cs
     n = len(new_ids)
     exp_key = getattr(trials, "exp_key", None)

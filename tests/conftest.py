@@ -49,6 +49,20 @@ def rng():
     return np.random.default_rng(0)
 
 
+@pytest.fixture(autouse=True)
+def _reset_dispatch_mesh():
+    """A test that registers a dispatch-substrate mesh (or flips
+    HYPEROPT_TPU_DISPATCH=sharded, which memoizes one) must not leak it —
+    a stale default mesh would silently shard every later test's
+    suggests."""
+    yield
+    import sys
+
+    mod = sys.modules.get("hyperopt_tpu.dispatch")
+    if mod is not None:
+        mod.clear_default_mesh()
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",

@@ -87,3 +87,28 @@ def test_show_lint_cli_runs_from_repo_root(capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert "0 new" in out
+
+
+def test_diff_scoped_gate_clean_vs_head():
+    """Tier-1 wiring for ``python -m hyperopt_tpu.analysis --diff BASE``:
+    run the diff-scoped report against HEAD — the exact invocation CI
+    uses to annotate a change — inside the gate itself.  The scoped run
+    must agree with the full gate (no new findings, no stale entries
+    among the changed files) and must record its scope in the report."""
+    import pytest
+
+    from hyperopt_tpu.analysis.__main__ import build_report, changed_files
+
+    try:
+        files = changed_files(ROOT, "HEAD")
+    except Exception as e:   # no git / not a checkout: wiring untestable
+        pytest.skip(f"git diff unavailable: {e}")
+    report = build_report(ROOT, analysis.default_baseline_path(ROOT),
+                          diff_files=files)
+    assert report["diff_files"] == sorted(files)
+    assert {f["file"] for f in report["new"]} <= set(files)
+    assert not report["new"], (
+        "diff-scoped analyzer findings in changed files:\n"
+        + "\n".join(f"{f['rule']} {f['file']}:{f['line']}"
+                    for f in report["new"]))
+    assert not report["stale"], report["stale"]

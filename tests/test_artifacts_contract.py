@@ -179,6 +179,45 @@ class TestBenchmarkArtifacts:
             assert head["parity_all_rows"] is True, name
             assert head["steady_compiles_all_zero"] is True, name
 
+    def test_multichip_artifact_schema(self):
+        """PR 15 acceptance artifact: the dispatch substrate's sharded
+        suggest at fixed total work over 1/2/4/8-device meshes — per-row
+        scaling efficiency vs one device and the zero-steady-compile
+        bar (one compile per (head, tier, mesh-shape)) — written by
+        benchmarks/multichip.py."""
+        paths = sorted(glob.glob(os.path.join(_BENCH_DIR,
+                                              "multichip_*.json")))
+        assert paths, "no benchmarks/multichip_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "sharded_suggest_scaling", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            assert doc["rows"], f"{name}: empty rows"
+            counts = [r["n_devices"] for r in doc["rows"]]
+            assert counts == sorted(set(counts)), (
+                f"{name}: device counts must be distinct ascending")
+            assert counts[0] == 1, f"{name}: missing the 1-device baseline"
+            for r in doc["rows"]:
+                assert {"n_devices", "mesh", "n_cand", "suggest_ms",
+                        "compiles_warm", "kernel_compiles_steady",
+                        "speedup_vs_1dev", "efficiency"} <= set(r), \
+                    f"{name}: {r}"
+                assert r["n_cand"] == doc["n_cand_total"], name
+                assert r["n_cand"] % r["n_devices"] == 0, (
+                    f"{name}: candidate axis must divide the mesh")
+                assert r["mesh"]["sp"] == r["n_devices"], name
+                assert r["suggest_ms"] > 0, name
+                assert 0.0 < r["efficiency"] <= 1.5, f"{name}: {r}"
+                assert r["kernel_compiles_steady"] == 0, (
+                    f"{name}: steady-state sharded suggest recompiled at "
+                    f"n={r['n_devices']} — one compile per (head, tier, "
+                    "mesh-shape) is broken")
+            assert doc["rows"][0]["efficiency"] == 1.0, name
+            assert "headline_efficiency_max_mesh" in doc, name
+
     def test_faults_overhead_artifact_schema(self):
         """ISSUE 5 acceptance artifact: the fault-injection hooks' paired
         A/B (disabled vs armed-at-zero-prob) with the maybe_fail

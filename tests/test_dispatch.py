@@ -1,0 +1,283 @@
+"""The dispatch substrate (PR 15): sharding × lanes × depth × head.
+
+Pins the tentpole's acceptance bars:
+
+* **bit-parity** — the mesh-sharded substrate path produces proposals
+  bit-identical to every legacy path it replaced (local
+  ``tpe.suggest``, ``parallel.sharded_suggest``, ``multi_start_suggest``
+  via the shard_map≡vmap pin, and fleet cohort lanes), on the virtual
+  8-device CPU mesh;
+* **composition** — depth-2 pipeline handles × fleet lanes × sharding
+  compose without special-casing (the four async halves consume
+  substrate handles opaquely);
+* **compile discipline** — one kernel-cache miss per (head, tier,
+  mesh-shape); repeats are hits;
+* **routing** — ``HYPEROPT_TPU_DISPATCH`` / ``set_default_mesh``
+  select the path, indivisible candidate counts fall back to the local
+  kernel (non-strict) or raise the pinned error (legacy strict surface).
+"""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from test_fleet import _domain, _run_exp
+
+from hyperopt_tpu import base, dispatch, fleet, tpe
+from hyperopt_tpu.obs import kernel_cache_stats
+from hyperopt_tpu.obs.metrics import registry
+from hyperopt_tpu.parallel.sharded import multi_start_suggest, sharded_suggest
+from hyperopt_tpu.space import prng_key
+
+
+def _counter(name):
+    return registry().snapshot()["counters"].get(name, 0.0)
+
+
+def _hist_trials(n=24, seed0=50, exp_key="e0"):
+    dom = _domain()
+    t = base.Trials(exp_key=exp_key)
+    _run_exp(dom, n, seed0, trials=t)
+    return dom, t
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+
+class TestRouting:
+    def test_mode_parsing(self, monkeypatch):
+        monkeypatch.delenv("HYPEROPT_TPU_DISPATCH", raising=False)
+        assert dispatch.mode() == "auto"
+        for raw, want in (("local", "local"), ("sharded", "sharded"),
+                          ("SHARDED ", "sharded"), ("bogus", "auto"),
+                          ("", "auto")):
+            monkeypatch.setenv("HYPEROPT_TPU_DISPATCH", raw)
+            assert dispatch.mode() == want
+
+    def test_auto_without_mesh_stays_local(self, monkeypatch):
+        monkeypatch.delenv("HYPEROPT_TPU_DISPATCH", raising=False)
+        dispatch.clear_default_mesh()
+        assert dispatch.active_mesh() is None
+
+    def test_registered_mesh_routes_auto(self, monkeypatch):
+        monkeypatch.delenv("HYPEROPT_TPU_DISPATCH", raising=False)
+        mesh = dispatch.default_mesh()
+        dispatch.set_default_mesh(mesh)
+        try:
+            assert dispatch.active_mesh() is mesh
+            # local mode is the kill switch even with a registered mesh
+            monkeypatch.setenv("HYPEROPT_TPU_DISPATCH", "local")
+            assert dispatch.active_mesh() is None
+            assert dispatch.active_mesh(mesh) is None
+        finally:
+            dispatch.clear_default_mesh()
+
+    def test_sharded_mode_builds_and_memoizes(self, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_DISPATCH", "sharded")
+        m1 = dispatch.active_mesh()
+        assert m1 is not None
+        assert m1 is dispatch.active_mesh()
+        assert m1.shape[dispatch.CAND_AXIS] == len(jax.devices())
+
+    def test_indivisible_candidates(self):
+        dom, t = _hist_trials()
+        mesh = dispatch.default_mesh()   # sp = 8
+        # strict (the legacy parallel.sharded surface) raises the pinned
+        # error; non-strict (ambient routing) falls back to the local
+        # kernel and counts the fallback
+        with pytest.raises(ValueError, match="divisible"):
+            dispatch.get_kernel(dom.cs, 32, 100, 25, "sqrt",
+                                mesh=mesh, strict=True)
+        c0 = _counter("dispatch.fallback_indivisible")
+        kern = dispatch.get_kernel(dom.cs, 32, 100, 25, "sqrt", mesh=mesh)
+        assert getattr(kern, "mesh", None) is None
+        assert _counter("dispatch.fallback_indivisible") == c0 + 1
+
+
+# ---------------------------------------------------------------------------
+# bit-parity: every legacy path vs its substrate replacement
+# ---------------------------------------------------------------------------
+
+
+class TestBitParity:
+    def test_local_vs_substrate_single_and_batch(self, monkeypatch):
+        dom, t = _hist_trials()
+        monkeypatch.delenv("HYPEROPT_TPU_DISPATCH", raising=False)
+        ref1 = tpe.suggest_batch([24], dom, t, 777)
+        ref4 = tpe.suggest_batch([25, 26, 27, 28], dom, t, 778)
+        monkeypatch.setenv("HYPEROPT_TPU_DISPATCH", "sharded")
+        c0 = _counter("dispatch.sharded")
+        got1 = tpe.suggest_batch([24], dom, t, 777)
+        got4 = tpe.suggest_batch([25, 26, 27, 28], dom, t, 778)
+        assert _counter("dispatch.sharded") >= c0 + 2   # really sharded
+        np.testing.assert_array_equal(ref1[0], got1[0])
+        np.testing.assert_array_equal(ref1[1], got1[1])
+        np.testing.assert_array_equal(ref4[0], got4[0])
+        np.testing.assert_array_equal(ref4[1], got4[1])
+
+    def test_sharded_shim_matches_local_and_substrate(self, monkeypatch):
+        dom, t = _hist_trials()
+        monkeypatch.delenv("HYPEROPT_TPU_DISPATCH", raising=False)
+        ref = json.loads(json.dumps(
+            tpe.suggest([30], dom, t, 4242, n_EI_candidates=64)))
+        shim = json.loads(json.dumps(sharded_suggest(
+            [30], dom, t, 4242, mesh=dispatch.default_mesh(),
+            n_EI_candidates=64)))
+        assert shim == ref
+        monkeypatch.setenv("HYPEROPT_TPU_DISPATCH", "sharded")
+        sub = json.loads(json.dumps(
+            tpe.suggest([30], dom, t, 4242, n_EI_candidates=64)))
+        assert sub == ref
+
+    def test_multi_start_matches_legacy_program(self):
+        # Replicate the legacy parallel.sharded multi-start math by hand
+        # — one key split, the γ ladder, the shard_mapped per-start
+        # program over the dp mesh — and pin the moved path bit-for-bit
+        # against it (seed handling, start rounding, history feed).
+        dom, t = _hist_trials()
+        cs = dom.cs
+        new_ids = [40, 41, 42]
+        seed = 909
+        got = json.loads(json.dumps(
+            multi_start_suggest(new_ids, dom, t, seed)))
+
+        h = t.history(cs)
+        n_rows = h["vals"].shape[0]
+        devs = np.asarray(jax.devices())
+        mesh = jax.sharding.Mesh(devs, (dispatch.START_AXIS,))
+        n_starts = -(-len(new_ids) // len(devs)) * len(devs)
+        kern = tpe.get_kernel(cs, tpe._bucket(n_rows), 24, 25, "sqrt")
+        hv, ha, hl, hok = tpe._padded_history(h, kern.n_cap)
+        keys = jax.random.split(prng_key(seed % (2 ** 32)), n_starts)
+        gammas = dispatch._gamma_spread(0.25, n_starts)
+        fn = dispatch._multi_start_fn(kern, mesh)
+        with mesh:
+            rows, _ = fn(keys, gammas, hv, ha, hl, hok, np.float32(1.0))
+        rows = np.asarray(rows)[:len(new_ids)]
+        ref = json.loads(json.dumps(base.docs_from_samples(
+            cs, new_ids, rows, cs.active_mask_host(rows),
+            exp_key=t.exp_key)))
+        assert got == ref
+
+        # shard_map and a plain global vmap are the same math but
+        # different XLA programs — semantically equal (tight allclose),
+        # not bit-pinned.
+        vrows, _ = jax.vmap(
+            lambda k, g: kern._suggest_one(k, hv, ha, hl, hok, g,
+                                           np.float32(1.0)))(keys, gammas)
+        np.testing.assert_allclose(np.asarray(vrows)[:len(new_ids)], rows,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fleet_cohort_under_mesh_matches_solo_local(self, monkeypatch):
+        # Three tenants coalesced into one vmapped dispatch with the
+        # candidate axis sharded must stay bit-identical to solo local
+        # tpe.suggest per tenant.
+        doms, trials, seeds = [], [], []
+        for e in range(3):
+            dom, t = _hist_trials(n=22 + e, seed0=60 + e, exp_key=f"e{e}")
+            doms.append(dom)
+            trials.append(t)
+            seeds.append(5000 + 17 * e)
+        monkeypatch.delenv("HYPEROPT_TPU_DISPATCH", raising=False)
+        solo = [json.loads(json.dumps(
+            tpe.suggest([50 + e], doms[e], trials[e], seeds[e])))
+            for e in range(3)]
+        monkeypatch.setenv("HYPEROPT_TPU_DISPATCH", "sharded")
+        sched = fleet.CohortScheduler()
+        d0 = _counter("fleet.dispatches")
+        out = sched.suggest(
+            [([50 + e], doms[e], trials[e], seeds[e]) for e in range(3)])
+        assert _counter("fleet.dispatches") == d0 + 1   # one cohort
+        assert [json.loads(json.dumps(o)) for o in out] == solo
+
+
+# ---------------------------------------------------------------------------
+# composition: depth-2 pipeline handles × fleet lanes × sharding
+# ---------------------------------------------------------------------------
+
+
+class TestComposition:
+    def test_depth2_pipeline_fleet_lane_parity(self, monkeypatch):
+        # Two cohorts in flight at once (depth-2: cohort B dispatched
+        # before cohort A materializes), each lane start-transferred then
+        # materialized — every lane must equal the solo local dispatch
+        # against the same history snapshot.
+        pairs = [_hist_trials(n=22 + e, seed0=70 + e, exp_key=f"p{e}")
+                 for e in range(2)]
+        reqs_a = [([60], pairs[0][0], pairs[0][1], 111),
+                  ([61], pairs[1][0], pairs[1][1], 222)]
+        reqs_b = [([62], pairs[0][0], pairs[0][1], 333),
+                  ([63], pairs[1][0], pairs[1][1], 444)]
+        monkeypatch.delenv("HYPEROPT_TPU_DISPATCH", raising=False)
+        ref = [json.loads(json.dumps(tpe.suggest(ids, d, t, s)))
+               for ids, d, t, s in reqs_a + reqs_b]
+        monkeypatch.setenv("HYPEROPT_TPU_DISPATCH", "sharded")
+        sched = fleet.CohortScheduler()
+        ha = sched.suggest_dispatch(reqs_a)
+        hb = sched.suggest_dispatch(reqs_b)     # A still in flight
+        for h in ha + hb:
+            fleet.suggest_start_transfer(h)
+        out = [json.loads(json.dumps(fleet.suggest_materialize(h)))
+               for h in ha + hb]
+        assert out == ref
+
+
+# ---------------------------------------------------------------------------
+# compile discipline: one compile per (head, tier, mesh-shape)
+# ---------------------------------------------------------------------------
+
+
+class TestCompileDiscipline:
+    def test_one_kernel_per_tier_and_mesh_shape(self):
+        # compile_space memoizes: a private label set keeps this test's
+        # kernel cache isolated from other tests' prewarms on the shared
+        # _domain() space
+        dom = _domain(labels=("kd_x", "kd_lr", "kd_c", "kd_a"))
+        cs = dom.cs
+        meshes = [dispatch.default_mesh(),            # (dp=1, sp=8)
+                  dispatch.default_mesh(n_starts=2)]  # (dp=2, sp=4)
+        tiers = [64, 128]
+        kernel_cache_stats(reset=True)
+        for mesh in meshes:
+            for n_cap in tiers:
+                dispatch.get_kernel(cs, n_cap, 24, 25, "sqrt", mesh=mesh)
+        stats = kernel_cache_stats()
+        assert stats["misses"] == len(meshes) * len(tiers)
+        # steady state: every (tier, mesh-shape) combination is a hit
+        kernel_cache_stats(reset=True)
+        for mesh in meshes:
+            for n_cap in tiers:
+                dispatch.get_kernel(cs, n_cap, 24, 25, "sqrt", mesh=mesh)
+        stats = kernel_cache_stats()
+        assert stats["misses"] == 0
+        assert stats["requests"] >= len(meshes) * len(tiers)
+
+    def test_suggest_path_reuses_kernel_across_steps(self, monkeypatch):
+        dom, t = _hist_trials()
+        monkeypatch.setenv("HYPEROPT_TPU_DISPATCH", "sharded")
+        tpe.suggest_batch([90], dom, t, 1)          # warm the tier
+        kernel_cache_stats(reset=True)
+        for s in range(2, 6):
+            tpe.suggest_batch([90 + s], dom, t, s)
+        assert kernel_cache_stats()["misses"] == 0
+
+
+# ---------------------------------------------------------------------------
+# pickling: the substrate kernel cache is volatile
+# ---------------------------------------------------------------------------
+
+
+class TestVolatileCache:
+    def test_dispatch_kernels_dropped_from_pickles(self):
+        import pickle
+
+        dom, t = _hist_trials()
+        dispatch.get_kernel(dom.cs, 64, 24, 25, "sqrt",
+                            mesh=dispatch.default_mesh())
+        assert getattr(dom.cs, "_dispatch_kernels", None)
+        cs2 = pickle.loads(pickle.dumps(dom.cs))
+        assert not getattr(cs2, "_dispatch_kernels", None)

@@ -215,3 +215,58 @@ class TestDriverWorkerRoles:
         losses = [loss for loss in ft.losses() if loss is not None]
         assert len(losses) == 24
         assert min(losses) < 10.0
+
+
+class TestNetstoreExchange:
+    """PR 15 reroute: a service-URL ``store_root`` swaps the cross-host
+    exchange from the filestore mount to the PR 13 netstore."""
+
+    def test_service_url_discriminates_transport(self):
+        assert multihost._is_service_url("http://store:8080")
+        assert multihost._is_service_url("https://store")
+        assert not multihost._is_service_url("/mnt/shared/exp")
+        assert not multihost._is_service_url("gcs/exp")
+
+    def test_exchange_crosses_rpc_send_fault_point(self, monkeypatch):
+        """FP001 on the cross-host exchange: the netstore-routed driver
+        must pass the ``rpc.send`` fault point BEFORE any socket I/O.
+        With the point armed at probability 1 and retries off, the very
+        first exchange verb (``save_domain``) dies with the injected
+        fault as the cause — were the hook missing, the unreachable URL
+        would surface a plain ``URLError`` instead and the chaos drills
+        could never reach this edge."""
+        from hyperopt_tpu import faults, hp
+        from hyperopt_tpu.exceptions import (InjectedFault,
+                                             NetstoreUnavailable)
+
+        monkeypatch.setenv("HYPEROPT_TPU_NETSTORE_RETRIES", "0")
+        faults.configure({"rpc.send": 1.0})
+        try:
+            with pytest.raises(NetstoreUnavailable) as ei:
+                multihost.run_driver(
+                    lambda d: d["x"] ** 2,
+                    {"x": hp.uniform("x", -1.0, 1.0)},
+                    store_root="http://127.0.0.1:9/", max_evals=4,
+                    show_progressbar=False, verbose=False)
+            assert isinstance(ei.value.__cause__, InjectedFault)
+        finally:
+            faults.configure({})
+
+    def test_worker_routes_netstore_on_url(self, monkeypatch):
+        """``run_worker`` picks the netstore transport for a URL root
+        (NetWorker), the filestore for a path — pinned by intercepting
+        the transports' ``run``."""
+        from hyperopt_tpu.parallel import netstore
+
+        created = []
+
+        class _FakeWorker:
+            def __init__(self, url, exp_key="default", **kw):
+                created.append((url, exp_key))
+
+            def run(self):
+                return 7
+
+        monkeypatch.setattr(netstore, "NetWorker", _FakeWorker)
+        assert multihost.run_worker("http://127.0.0.1:9", exp_key="mh") == 7
+        assert created == [("http://127.0.0.1:9", "mh")]

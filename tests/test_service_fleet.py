@@ -734,3 +734,97 @@ class TestChaosFleetKill:
             router.shutdown()
             for p in by_url.values():
                 _stop(p)
+
+
+@pytest.mark.chaos
+class TestCohortGateSurvivesFailover:
+    def test_sigkill_mid_cohort_promoted_replica_resumes_batching(
+            self, tmp_path, monkeypatch):
+        """PR 13 follow-on regression: a primary serving a coalesced
+        2-tenant cohort is SIGKILLed at the suggest's WAL append.  The
+        promoted replica must ARM its cohort gate (it was held disarmed
+        while fenced) and resume cohort batching — before this fix a
+        promoted shard served solo suggests forever."""
+        import threading
+
+        import test_fleet as _tf
+
+        monkeypatch.setenv("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.01")
+        rp, rurl = _launch_shard(
+            ["--wal-dir", str(tmp_path / "r"), "--role", "replica",
+             "--cohort-window-ms", "150"])
+        # appends: (put_domain, insert_docs) x 2 exp_keys -> @4 fires at
+        # the 5th append: the first suggest of the coalesced cohort.
+        pp, purl = _launch_shard(
+            ["--wal-dir", str(tmp_path / "p"), "--role", "primary",
+             "--replicate-to", rurl, "--cohort-window-ms", "150"],
+            env={"HYPEROPT_TPU_WAL_CRASH": "kill",
+                 "HYPEROPT_TPU_FAULTS": "wal.write=1.0:1@4"})
+        router = Router({"s0": {"primary": purl, "replica": rurl}},
+                        retries=1, backoff=0.01)
+        router.start()
+        try:
+            nts = []
+            for e in ("e1", "e2"):
+                dom = _tf._domain()
+                local = base.Trials(exp_key=e)
+                _tf._run_exp(dom, 22, 50 + len(nts), trials=local)
+                nt = RouterTrials(router.url, exp_key=e, retries=2)
+                nt.save_domain(dom)
+                nt._insert_trial_docs(
+                    json.loads(json.dumps(list(local._dynamic_trials))))
+                nts.append(nt)
+            time.sleep(0.5)   # let the shipper drain the setup appends
+
+            # round 1: a coalesced cohort whose first WAL append kills
+            # the primary mid-cohort; pinned idem keys + the router's
+            # promote-and-retry make both suggests land exactly once.
+            out = [None, None]
+
+            def _r1(i):
+                out[i] = nts[i].suggest(901 + i, n=1)
+
+            ts = [threading.Thread(target=_r1, args=(i,)) for i in (0, 1)]
+            for th in ts:
+                th.start()
+            for th in ts:
+                th.join()
+            assert pp.wait(timeout=20) == -signal.SIGKILL
+            assert _counter("router.failovers") >= 1
+            assert out[0] and out[1]   # both retried suggests served
+
+            # exactly-once accounting across the kill: 22 seeded + 1
+            # suggested doc per tenant, no duplicates
+            for nt in nts:
+                nt.refresh()
+                tids = [d["tid"] for d in nt.trials]
+                assert len(tids) == 23
+                assert len(tids) == len(set(tids))
+
+            # round 2: a barrier-started pair against the promoted
+            # replica MUST coalesce — the regression (gate never armed
+            # after promotion) leaves fleet.dispatches at zero.
+            snap0 = NetTrials(rurl, exp_key="e1").metrics()
+            d0 = snap0.get("counters", {}).get("fleet.dispatches", 0)
+            barrier = threading.Barrier(2)
+
+            def _r2(i):
+                barrier.wait()
+                nts[i].suggest(911 + i, new_ids=[600], insert=False)
+
+            ts = [threading.Thread(target=_r2, args=(i,)) for i in (0, 1)]
+            for th in ts:
+                th.start()
+            for th in ts:
+                th.join()
+            snap = NetTrials(rurl, exp_key="e1").metrics()
+            ctr = snap.get("counters", {})
+            assert ctr.get("shard.promotions", 0) >= 1
+            assert ctr.get("shard.cohort_gate_armed", 0) >= 1, \
+                "promoted replica never armed its cohort gate"
+            assert ctr.get("fleet.dispatches", 0) >= d0 + 1, \
+                "promoted replica served the concurrent pair solo"
+        finally:
+            router.shutdown()
+            _stop(pp)
+            _stop(rp)

@@ -562,6 +562,69 @@ def child():
         partial["device_fmin_evals"] = n_ev
         partial["device_fmin_best_loss"] = round(dinfo["best_loss"], 4)
         _say("partial", partial)
+
+        # ISSUE 16 stride sweep: the promoted fmin(mode="device") API —
+        # same Trials landing as the hosted loop — at sync_stride
+        # 1 / 8 / 64 / ∞, with the host fetch count per run read from
+        # the device.fetch_syncs counter delta.  This is the "zero host
+        # round trips per trial" claim verified by counting, and the
+        # speedup denominator is the REAL hosted fmin loop at the same
+        # shape (not the reference-numpy step).  The shape is deliberately
+        # SMALL (2 params, 24 candidates, bucket-64 history): the sweep
+        # isolates the per-trial loop overhead the device mode deletes —
+        # kernel compute at flagship shape is the rest of this file.
+        from functools import partial as _fpartial
+
+        from hyperopt_tpu import hp as _hp_d
+        from hyperopt_tpu import tpe as _tpe_d
+        from hyperopt_tpu.obs.metrics import registry as _dreg
+
+        n_sw = 64
+        algo_sw = _fpartial(_tpe_d.suggest, n_EI_candidates=24)
+        space_sw = {"x": _hp_d.uniform("x", -5, 5),
+                    "c": _hp_d.choice("c", [0, 1, 2, 3])}
+
+        def sw_dev_obj(p):
+            return (p["x"] - 1.0) ** 2 + p["c"] * 0.1
+
+        def sw_host_obj(p):     # same math, host-typed return for the
+            return float(       # hosted loop's float-or-dict contract
+                (p["x"] - 1.0) ** 2 + p["c"] * 0.1)
+
+        def _fetches():
+            return _dreg().snapshot()["counters"].get(
+                "device.fetch_syncs", 0.0)
+
+        def _sweep_run(seed, stride=None, device=False):
+            t = ho_d.Trials()
+            kw = dict(mode="device", sync_stride=stride) if device else {}
+            f0 = _fetches()
+            t0 = time.perf_counter()
+            ho_d.fmin(sw_dev_obj if device else sw_host_obj, space_sw,
+                      algo=algo_sw, max_evals=n_sw,
+                      trials=t, rstate=np.random.default_rng(seed),
+                      show_progressbar=False, **kw)
+            dt_ = time.perf_counter() - t0
+            return n_sw / dt_, int(_fetches() - f0)
+
+        reps_sw = 2 if fast else 3
+        _sweep_run(0)                         # hosted warm-up (compiles)
+        host_ts = max(_sweep_run(1)[0] for _ in range(reps_sw))
+        partial["device_fmin_host_loop_trials_per_sec"] = round(host_ts, 1)
+        _say("partial", partial)
+        sweep = {}
+        for label, stride in (("1", 1), ("8", 8), ("64", 64),
+                              ("inf", None)):
+            _sweep_run(0, stride, device=True)        # warm per shape
+            runs = [_sweep_run(1, stride, device=True)
+                    for _ in range(reps_sw)]
+            ts = max(r[0] for r in runs)
+            sweep[label] = {
+                "trials_per_sec": round(ts, 1),
+                "fetches_per_run": runs[-1][1],
+                "speedup_vs_host_loop": round(ts / host_ts, 2)}
+            partial["device_fmin_stride_sweep"] = sweep
+            _say("partial", partial)          # feed the silence deadline
     except Exception as e:
         partial["device_fmin_error"] = f"{type(e).__name__}: {e}"
         _say("partial", partial)
@@ -591,24 +654,48 @@ def child():
             except (OSError, ValueError, IndexError):
                 return None, None
 
-        other_pre, load_pre = _runnable_other()
-        rng = np.random.default_rng(0)
-        rv = rng.uniform(-5, 5, (N_HISTORY, N_DIMS))
-        t0 = time.perf_counter()
-        suggest_step(rv, np.ones((N_HISTORY, N_DIMS), bool),
-                     (rv ** 2).sum(axis=1), np.ones(N_HISTORY, bool),
-                     [(-5.0, 5.0)] * N_DIMS, n_cand=N_CAND)
-        cpu_ms = (time.perf_counter() - t0) * 1e3
-        other_post, _ = _runnable_other()
-        partial["cpu_ref_ms"] = round(cpu_ms, 1)
-        if load_pre is not None:
-            partial["cpu_ref_load1_pre"] = round(load_pre, 2)
-        if other_pre is not None:
-            partial["cpu_ref_runnable_other"] = [other_pre, other_post]
-            if max(other_pre, other_post or 0) >= 1:
+        def _attempt():
+            other_pre, load_pre = _runnable_other()
+            rng = np.random.default_rng(0)
+            rv = rng.uniform(-5, 5, (N_HISTORY, N_DIMS))
+            t0 = time.perf_counter()
+            suggest_step(rv, np.ones((N_HISTORY, N_DIMS), bool),
+                         (rv ** 2).sum(axis=1), np.ones(N_HISTORY, bool),
+                         [(-5.0, 5.0)] * N_DIMS, n_cand=N_CAND)
+            ms = (time.perf_counter() - t0) * 1e3
+            other_post, _ = _runnable_other()
+            return {"ms": round(ms, 1), "load1_pre": load_pre,
+                    "runnable_other": [other_pre, other_post],
+                    "contended": other_pre is not None
+                    and max(other_pre, other_post or 0) >= 1}
+
+        # ISSUE 16: one retry on a quieter scheduler window before
+        # stamping the contended note — a transient competitor at the
+        # first sampling instants must not poison the denominator for the
+        # whole round.  Both attempts land in the artifact either way.
+        attempts = [_attempt()]
+        if attempts[0]["contended"]:
+            _say("partial", partial)    # feed the silence deadline
+            time.sleep(5.0)
+            attempts.append(_attempt())
+        pick = next((a for a in attempts if not a["contended"]),
+                    min(attempts, key=lambda a: a["ms"]))
+        cpu_ms = pick["ms"]
+        partial["cpu_ref_ms"] = cpu_ms
+        if len(attempts) > 1:
+            partial["cpu_ref_attempts"] = [
+                {k: a[k] for k in ("ms", "runnable_other", "contended")}
+                for a in attempts]
+        if pick["load1_pre"] is not None:
+            partial["cpu_ref_load1_pre"] = round(pick["load1_pre"], 2)
+        if pick["runnable_other"][0] is not None:
+            partial["cpu_ref_runnable_other"] = pick["runnable_other"]
+            if pick["contended"]:
+                worst = max(x for x in pick["runnable_other"]
+                            if x is not None)
                 partial["cpu_ref_note"] = (
-                    f"{max(other_pre, other_post or 0)} other runnable "
-                    "task(s) observed during the cpu_ref phase — "
+                    f"{worst} other runnable task(s) observed during the "
+                    "cpu_ref phase (persisted across a retry) — "
                     "denominator may be contended")
         if partial.get("value") and "cpu_ref_note" not in partial:
             partial["speedup_vs_cpu_ref"] = round(cpu_ms / partial["value"], 1)

@@ -3,7 +3,8 @@
 Eight AST-based checkers over the package (see each module's docstring
 for the rule catalog):
 
-* :mod:`.jit_purity`          JP001–JP005 — trace-time purity of jit/vmap paths
+* :mod:`.jit_purity`          JP001–JP007 — trace-time purity of jit/vmap
+  paths, host callbacks and Python RNG in lax control-flow bodies
 * :mod:`.lock_order`          LK001–LK003 — lock discipline in threaded layers
 * :mod:`.registry_drift`      RD001–RD010 — env/fault/verb/metric/SLO catalogs
 * :mod:`.artifacts`           AH001       — benchmark artifact schema guards

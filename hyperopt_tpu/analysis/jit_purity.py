@@ -24,6 +24,23 @@ JP005  Use-after-donation: an argument passed in a donated position of a
        ``jax.jit(..., donate_argnums=...)`` callable is read again after
        the call — donated buffers are invalidated by XLA aliasing (the
        ``history.py`` delta-append rings are the in-repo donors).
+JP006  Host callback (``pure_callback`` / ``io_callback`` /
+       ``jax.debug.callback`` / ``host_callback``) inside a traced
+       function — a host round trip per invocation, which in a scan body
+       means one per *carried step* and defeats the whole-loop-on-device
+       contract (``device_fmin`` / ``fmin(mode="device")``).
+JP007  Python-side RNG inside a traced function — ``np.random.*``,
+       stdlib ``random.*``, or a ``.integers()`` Generator draw.  Host
+       randomness is frozen at trace time (same value every execution)
+       and invisible to JAX's key discipline; thread a ``prng_key``
+       through the carry instead.
+
+Entry points include control-flow combinator bodies: the function
+handed to ``lax.scan`` (arg 0), ``lax.fori_loop`` (arg 2),
+``lax.while_loop`` (args 0 and 1), ``lax.cond`` (args 1 and 2) and
+``lax.map`` (arg 0) is traced even when the call site itself is not
+jitted, so those bodies get the full JP sweep — this is what keeps the
+``fmin(mode="device")`` carry loop honest.
 
 Purely lexical + same-module reachability: cross-module calls are out of
 scope (each module's own traced entry points cover its kernels).
@@ -35,10 +52,27 @@ import ast
 
 from .core import Finding, dotted_name, qualified_functions
 
-RULES = ("JP001", "JP002", "JP003", "JP004", "JP005")
+RULES = ("JP001", "JP002", "JP003", "JP004", "JP005", "JP006", "JP007")
 
 _TRACERS = {"jit", "vmap", "pmap", "pallas_call", "shard_map"}
 _CASTS = {"float", "int", "bool"}
+
+# Control-flow combinators whose function arguments are traced bodies:
+# name of the callable's last component -> positional indices to resolve.
+_CTRL_FLOW = {"scan": (0,), "fori_loop": (2,), "while_loop": (0, 1),
+              "cond": (1, 2)}
+
+
+def _ctrl_flow_positions(name: str | None):
+    """Traced-body arg positions for lax control-flow calls, else None.
+    ``map`` requires a ``lax`` qualifier so the Python builtin never
+    resolves; the other names are distinctive enough bare."""
+    if not name:
+        return None
+    parts = name.split(".")
+    if parts[-1] == "map":
+        return (0,) if "lax" in parts[:-1] else None
+    return _CTRL_FLOW.get(parts[-1])
 
 
 def _is_trace_wrapper(name: str | None) -> bool:
@@ -100,6 +134,7 @@ class _ModuleIndex:
         self.funcs: dict = {}      # name -> FunctionDef (top level)
         self.methods: dict = {}    # (class, name) -> FunctionDef
         self.np_aliases: set = set()
+        self.rng_aliases: set = set()   # stdlib random / numpy.random
         for qual, node, cls in qualified_functions(module.tree):
             if cls is None:
                 self.funcs[node.name] = node
@@ -110,9 +145,14 @@ class _ModuleIndex:
                 for a in node.names:
                     if a.name == "numpy":
                         self.np_aliases.add(a.asname or "numpy")
+                    elif a.name in ("random", "numpy.random"):
+                        self.rng_aliases.add(
+                            a.asname or a.name.split(".")[-1])
             elif isinstance(node, ast.ImportFrom):
                 if node.module == "numpy":
-                    continue    # from numpy import x — rare; skip
+                    for a in node.names:
+                        if a.name == "random":
+                            self.rng_aliases.add(a.asname or "random")
 
 
 def _entry_points(index: _ModuleIndex):
@@ -120,8 +160,11 @@ def _entry_points(index: _ModuleIndex):
     module hands to a trace wrapper, plus decorated ones."""
     entries = []
 
-    def resolve(node, cls):
+    def resolve(node, cls, scopes=()):
         if isinstance(node, ast.Name):
+            for scope in reversed(scopes):   # nested defs shadow globals
+                if node.id in scope:
+                    return (scope[node.id], cls)
             fn = index.funcs.get(node.id)
             return (fn, None) if fn is not None else None
         if isinstance(node, ast.Attribute) and \
@@ -146,11 +189,23 @@ def _entry_points(index: _ModuleIndex):
     class _Wraps(ast.NodeVisitor):
         def __init__(self):
             self.cls = None
+            self.scopes = []    # local def tables, innermost last
 
         def visit_ClassDef(self, node):
             prev, self.cls = self.cls, node.name
             self.generic_visit(node)
             self.cls = prev
+
+        def visit_FunctionDef(self, node):
+            # scan/cond bodies are usually CLOSURES of a builder — make
+            # the builder's nested defs resolvable while inside it.
+            local = {n.name: n for n in ast.walk(node)
+                     if isinstance(n, ast.FunctionDef) and n is not node}
+            self.scopes.append(local)
+            self.generic_visit(node)
+            self.scopes.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
 
         def visit_Call(self, node):
             if _is_trace_wrapper(dotted_name(node.func)) and node.args:
@@ -163,11 +218,29 @@ def _entry_points(index: _ModuleIndex):
                 if isinstance(target, ast.Lambda):
                     entries.append((target, self.cls, set()))
                 else:
-                    got = resolve(target, self.cls)
+                    got = resolve(target, self.cls, self.scopes)
                     if got is not None:
                         fn, cls = got
                         entries.append(
                             (fn, cls, _static_names(node, fn)))
+            # lax control flow: the body args are traced even when the
+            # call site itself isn't jitted (scan bodies ARE the device
+            # loop in fmin(mode="device")).
+            positions = _ctrl_flow_positions(dotted_name(node.func))
+            for pos in positions or ():
+                if pos >= len(node.args):
+                    continue
+                target = node.args[pos]
+                while isinstance(target, ast.Call) and \
+                        _is_trace_wrapper(dotted_name(target.func)) \
+                        and target.args:
+                    target = target.args[0]
+                if isinstance(target, ast.Lambda):
+                    entries.append((target, self.cls, set()))
+                else:
+                    got = resolve(target, self.cls, self.scopes)
+                    if got is not None:
+                        entries.append((got[0], got[1], set()))
             self.generic_visit(node)
 
     _Wraps().visit(index.module.tree)
@@ -243,6 +316,30 @@ def _structure_test_names(test):
     return exempt
 
 
+def _is_host_callback(name: str | None) -> bool:
+    if not name:
+        return False
+    last = name.split(".")[-1]
+    if last in ("pure_callback", "io_callback"):
+        return True
+    if "host_callback" in name:
+        return True
+    return last == "callback" and "debug" in name
+
+
+def _is_python_rng(name: str | None, node: ast.Call, index) -> bool:
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "integers":
+        return True     # np.random.Generator.integers draw
+    if not name:
+        return False
+    parts = name.split(".")
+    if parts[0] in index.np_aliases and len(parts) > 2 \
+            and parts[1] == "random":
+        return True     # np.random.normal(...) etc.
+    return len(parts) > 1 and parts[0] in index.rng_aliases
+
+
 def _check_body(findings, rel, fn, cls, static, index):
     sym = _fn_name(fn, cls)
     traced = _traced_params(fn, static)
@@ -257,6 +354,18 @@ def _check_body(findings, rel, fn, cls, static, index):
                         "JP001", rel, node.lineno, sym,
                         ".item() in a traced function forces a "
                         "device->host sync"))
+                elif _is_host_callback(name):
+                    findings.append(Finding(
+                        "JP006", rel, node.lineno, sym,
+                        f"host callback {name}() inside a traced function "
+                        "— one host round trip per call (per carried step "
+                        "in a scan body)"))
+                elif _is_python_rng(name, node, index):
+                    findings.append(Finding(
+                        "JP007", rel, node.lineno, sym,
+                        "Python-side RNG inside a traced function — the "
+                        "draw freezes at trace time; thread a jax PRNG "
+                        "key through the carry instead"))
                 elif name in _CASTS and node.args and not isinstance(
                         node.args[0], ast.Constant) and \
                         not _is_env_read(node.args[0]):

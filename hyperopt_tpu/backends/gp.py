@@ -67,6 +67,8 @@ _default_n_EI_candidates = 64
 _LS_GRID = np.asarray([0.1, 0.2, 0.4, 0.8], np.float32)
 _NOISE_GRID = np.asarray([1e-4, 1e-2], np.float32)
 
+_SQ2PI = np.sqrt(2.0 * np.pi)   # host constant, out of every trace
+
 
 def _max_fit_rows() -> int:
     raw = os.environ.get("HYPEROPT_TPU_GP_MAX_N", "")
@@ -158,7 +160,7 @@ def _build_suggest_fn(cs, n_cap, n_cand, m, max_n):
             best = jnp.min(jnp.where(mf2 > 0, y2, jnp.inf))
             zs = (best - mu) / sigma
             cdf = 0.5 * (1.0 + jax.scipy.special.erf(zs / np.sqrt(2.0)))
-            pdf = jnp.exp(-0.5 * zs * zs) / np.sqrt(2.0 * np.pi)
+            pdf = jnp.exp(-0.5 * zs * zs) / _SQ2PI
             ei = (best - mu) * cdf + sigma * pdf
             pick = jnp.argmax(ei)
             z2 = z2.at[n_eff + i].set(zc[pick])

@@ -178,7 +178,7 @@ def fmin_device(fn, space, max_evals, seed=0,
     if n_runs > 1 and init is not None:
         raise ValueError("init= does not compose with n_runs > 1 "
                          "(restarts are independent fresh runs)")
-    from .parallel.sharded import START_AXIS, _mesh_key
+    from .dispatch import START_AXIS, _mesh_key
 
     mesh_k = _mesh_key(mesh) if mesh is not None else None
     if mesh is not None and n_runs > 1:
@@ -205,8 +205,10 @@ def fmin_device(fn, space, max_evals, seed=0,
         # ShardedTpeKernel constraints parallel.sharded_suggest uses, with
         # the loop still one program — per-step EI sweeps ride ICI, the
         # argmax reduces across devices, and the sequential trial chain
-        # stays device-resident.
-        from .parallel.sharded import CAND_AXIS, _get_sharded_kernel
+        # stays device-resident.  The kernel comes from the PR-15 dispatch
+        # substrate (one acquisition point for every suggest path).
+        from .dispatch import CAND_AXIS
+        from .dispatch import get_kernel as _dispatch_get_kernel
 
         # Validate at THIS boundary (round-4 advisor finding): the default
         # n_EI_candidates is rarely divisible by a mesh's candidate axis,
@@ -221,10 +223,10 @@ def fmin_device(fn, space, max_evals, seed=0,
                     f"divisible by the {n_sp}-way '{CAND_AXIS}' mesh axis; "
                     f"pass n_EI_candidates={fixed} (next multiple) or a "
                     f"mesh whose '{CAND_AXIS}' axis divides it")
-        kern = _get_sharded_kernel(cs, n_cap, int(n_EI_candidates),
-                                   int(linear_forgetting), mesh, split,
-                                   multivariate=multivariate,
-                                   cat_prior=cat_prior)
+        kern = _dispatch_get_kernel(cs, n_cap, int(n_EI_candidates),
+                                    int(linear_forgetting), split,
+                                    multivariate, cat_prior,
+                                    mesh=mesh, strict=True)
     else:
         # n_runs > 1 shards the RESTART axis instead; per-run suggests
         # use the plain kernel so the two partitionings can't fight.
@@ -251,7 +253,7 @@ def fmin_device(fn, space, max_evals, seed=0,
                  float(gamma), float(prior_weight), int(linear_forgetting),
                  split, multivariate, kern.cat_prior, kern.comp_sampler,
                  kern.split_impl, kern.pallas, kern.pallas_ei,
-                 kern.ei_precision, kern.ei_topm,
+                 kern.ei_precision, kern.ei_topm, kern.fused_step,
                  _pallas_tile(), mesh_k,
                  n_runs, patience, float(min_improvement), prng_impl(),
                  _rhist.enabled())
@@ -302,7 +304,7 @@ def fmin_device(fn, space, max_evals, seed=0,
                 n_done = jnp.int32(max_evals)
             else:
                 # In-program no-progress stop (host: no_progress_loss).
-                mi = float(min_improvement)
+                mi = min_improvement    # host float (normalized above)
 
                 def wcond(st):
                     i, since = st[4], st[6]
@@ -383,3 +385,253 @@ def fmin_device(fn, space, max_evals, seed=0,
             "n_trials": (n_done.astype(int).tolist() if n_runs > 1
                          else int(n_done))}
     return best, info
+
+
+# ---------------------------------------------------------------------------
+# segmented engine — fmin(mode="device") lands results in a Trials
+# ---------------------------------------------------------------------------
+#
+# fmin_device above is the all-or-nothing form: one program, one fetch, an
+# info dict.  fmin(mode="device") needs the hosted loop's OBSERVABLE
+# contract — results in a Trials, early-stop/progress hooks, resumability —
+# without its per-trial fetch sync.  The middle ground is a segmented scan:
+# the suggest→evaluate→record chain runs `sync_stride` trials per compiled
+# program with the history ring as scan carry, and the host fetches ONE
+# [stride]-row slab per segment, lands it in the Trials, and runs the
+# hooks.  Per-trial seeds are drawn from the SAME rstate stream the hosted
+# loop draws (one integers(2**31-1) per trial), the startup branch is the
+# same `sample_traced` program `rand.suggest_batch` jits, and the TPE
+# branch is the same `_suggest_one(prng_key(seed), ...)` the hosted
+# suggest_seeded entry runs — so at any stride the proposal stream is
+# seeded-bit-parity with the hosted loop (pinned at sync_stride=1 by
+# tests/test_fmin_device_mode.py for histories within one bucket).
+
+
+def _build_segment(cs, kern, eval_one, n_startup, gamma, prior_weight):
+    """The per-segment scan: ``(seeds[s], hv, ha, hl, hok, i0) ->
+    ((hv, ha, hl, hok, i), (rows[s,P], acts[s,P], losses[s]))``.
+
+    One trial per scan step: startup draws route through
+    ``cs.sample_traced`` until ``n_startup`` ok trials exist (the hosted
+    gate), TPE draws through ``kern._suggest_one`` — both keyed by
+    ``prng_key(seed_t)``, exactly the hosted loop's seeded entries.
+    Losses land in the ring with the hosted ``Trials.history`` semantics
+    (non-finite → ``ok=False``, ``loss=+inf``) so a resumed or
+    mixed-stride run conditions on the same posterior; the raw loss goes
+    out in the slab for the Trials doc.
+    """
+    gamma_f = jnp.float32(gamma)
+    pw_f = jnp.float32(prior_weight)
+
+    def segment(seeds, hv, ha, hl, hok, i0):
+        def body(carry, seed):
+            hv, ha, hl, hok, i = carry
+            key = prng_key(seed)
+            n_ok = jnp.sum(hok)
+
+            def startup(k):
+                sv, sa = cs.sample_traced(k, 1)
+                return sv[0], sa[0]
+
+            def tpe_step(k):
+                return kern._suggest_one(k, hv, ha, hl, hok,
+                                         gamma_f, pw_f)
+
+            row, act = jax.lax.cond(n_ok < n_startup, startup, tpe_step,
+                                    key)
+            loss = eval_one(row, act)
+            lok = jnp.isfinite(loss)
+            hv, ha, hl, hok = _insert_row(
+                hv, ha, hl, hok, i, row, act,
+                jnp.where(lok, loss, jnp.inf))
+            hok = jax.lax.dynamic_update_slice(
+                hok, lok.reshape((1,)), (i,))
+            return (hv, ha, hl, hok, i + 1), (row, act, loss)
+
+        carry = (hv, ha, hl, hok, jnp.asarray(i0, jnp.int32))
+        carry, ys = jax.lax.scan(body, carry, seeds)
+        return carry, ys
+
+    return segment
+
+
+def fmin_trials(fn, space, max_evals, trials, rstate, sync_stride=None,
+                early_stop_fn=None, timeout=None, loss_threshold=None,
+                show_progressbar=True,
+                n_startup_jobs=_default_n_startup_jobs,
+                n_EI_candidates=_default_n_EI_candidates,
+                gamma=_default_gamma,
+                prior_weight=_default_prior_weight,
+                linear_forgetting=_default_linear_forgetting,
+                split="sqrt", multivariate=False, cat_prior=None,
+                mesh=None):
+    """Run TPE on-device in ``sync_stride``-trial segments, landing every
+    slab into ``trials`` (the engine behind ``fmin(mode='device')``).
+
+    ``sync_stride=None`` (∞) fetches once for the whole run; smaller
+    strides trade throughput for hook latency — early-stop, timeout and
+    loss-threshold checks run on the landed Trials between segments, so
+    they observe the run at stride granularity.  Prior completed trials
+    in ``trials`` seed the history ring (resume); the kernel is acquired
+    through ``dispatch.get_kernel`` so an ambient mesh
+    (``HYPEROPT_TPU_DISPATCH=sharded`` / ``dispatch.set_default_mesh``)
+    shards each suggest's candidate axis with no code change here.
+
+    Returns ``trials`` (mutated in place).  Host round trips:
+    ``ceil(n_new / sync_stride)`` slab fetches total, counted in the
+    ``device.fetch_syncs`` counter — zero per-trial syncs at any stride.
+    """
+    from time import time as _time
+
+    from . import dispatch as _dispatch
+    from .base import JOB_STATE_DONE, STATUS_OK, coarse_utcnow
+    from .base import docs_from_samples
+    from .obs import metrics as _metrics
+    from .utils.progress import default_callback, no_progress_callback
+
+    t_start = _time()
+    cs = space if isinstance(space, CompiledSpace) else compile_space(space)
+    max_evals = int(max_evals)
+    if max_evals < 1:
+        raise ValueError("max_evals must be >= 1")
+    if sync_stride is not None:
+        sync_stride = int(sync_stride)
+        if sync_stride < 1:
+            raise ValueError(
+                f"sync_stride must be >= 1 or None (∞), got {sync_stride}")
+    trials.refresh()
+    h = trials.history(cs)
+    n_prev = int(h["loss"].shape[0])
+    exp_key = getattr(trials, "exp_key", None)
+    if n_prev >= max_evals:
+        return trials
+
+    n_cap = _bucket(max_evals)
+    mesh = _dispatch.active_mesh(mesh)
+    mesh_k = _mesh_key_of(mesh)
+    # One acquisition point for every suggest path: with a mesh the
+    # candidate axis shards (collective argmax over ICI); indivisible
+    # n_EI_candidates falls back to the bit-identical local kernel.
+    kern = _dispatch.get_kernel(cs, n_cap, int(n_EI_candidates),
+                                int(linear_forgetting), split,
+                                multivariate, cat_prior, mesh=mesh)
+    eval_one = _wrap_objective(fn, cs)
+    n_startup = int(n_startup_jobs)
+
+    cache = getattr(cs, "_device_fmin_cache", None)
+    if cache is None:
+        cache = cs._device_fmin_cache = OrderedDict()
+    base_key = ("seg", id(fn), n_cap, n_startup, float(gamma),
+                float(prior_weight), int(linear_forgetting),
+                int(n_EI_candidates), split, multivariate, kern.cat_prior,
+                kern.comp_sampler, kern.split_impl, kern.pallas,
+                kern.pallas_ei, kern.ei_precision, kern.ei_topm,
+                kern.fused_step, _pallas_tile(), mesh_k, prng_impl())
+    segment = _build_segment(cs, kern, eval_one, n_startup, gamma,
+                             prior_weight)
+    reg = _metrics.registry()
+    from .obs import EVENTS
+
+    def seg_fn(s):
+        key = base_key + (s,)
+        run = cache.get(key)
+        if run is None:
+            reg.counter("device.run_cache.misses").inc()
+            EVENTS.emit("compile", name="fmin_device_segment", stride=s,
+                        max_evals=max_evals)
+            run = cache[key] = jax.jit(segment)
+            while len(cache) > _RUN_CACHE_CAP:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(key)
+            reg.counter("device.run_cache.hits").inc()
+        return run
+
+    # Ring seed: prior completed trials (resume), padded to the bucket.
+    hv = jnp.zeros((n_cap, cs.n_params), jnp.float32)
+    ha = jnp.zeros((n_cap, cs.n_params), bool)
+    hl = jnp.full((n_cap,), jnp.inf, jnp.float32)
+    hok = jnp.zeros((n_cap,), bool)
+    if n_prev:
+        hv = hv.at[:n_prev].set(h["vals"])
+        ha = ha.at[:n_prev].set(h["active"])
+        hl = hl.at[:n_prev].set(h["loss"])
+        hok = hok.at[:n_prev].set(h["ok"])
+
+    early_stop_args: list = []
+    i = n_prev
+    progress_ctx = default_callback if show_progressbar \
+        else no_progress_callback
+    with progress_ctx(initial=n_prev, total=max_evals) as prog:
+        while i < max_evals:
+            s = (max_evals - i if sync_stride is None
+                 else min(sync_stride, max_evals - i))
+            # One scalar draw per trial — the hosted batch cadence, so
+            # the seed stream matches fmin's host loop at every stride.
+            seeds = np.asarray(
+                [rstate.integers(2 ** 31 - 1) for _ in range(s)],
+                np.uint32)
+            (hv, ha, hl, hok, _), (rows, acts, losses) = seg_fn(s)(
+                seeds, hv, ha, hl, hok, np.int32(i))
+            # ONE bulk fetch per segment — the only host sync at this
+            # stride; bench.py verifies per-trial round trips are zero
+            # by diffing this counter.
+            rows_h = np.asarray(rows)
+            acts_h = np.asarray(acts)
+            losses_h = np.asarray(losses)
+            reg.counter("device.fetch_syncs").inc()
+            reg.counter("device.segments").inc()
+
+            new_ids = trials.new_trial_ids(s)
+            docs = docs_from_samples(cs, new_ids, rows_h, acts_h,
+                                     exp_key=exp_key)
+            now = coarse_utcnow()
+            for doc, loss in zip(docs, losses_h):
+                doc["state"] = JOB_STATE_DONE
+                doc["result"] = {"loss": float(loss), "status": STATUS_OK}
+                doc["book_time"] = now
+                doc["refresh_time"] = now
+            trials.insert_trial_docs(docs)
+            trials.refresh()
+            reg.counter("device.trials_landed").inc(s)
+            i += s
+            prog.update(s)
+            fin = losses_h[np.isfinite(losses_h)]
+            if len(fin):
+                prog.postfix(float(fin.min()))
+
+            # Stride-boundary hooks: they see the landed Trials, i.e. the
+            # run at slab granularity (docs/API.md "fmin modes").  The
+            # early-stop fn is replayed once per LANDED trial, not once
+            # per segment: hosted fmin calls it after every trial and
+            # stateful helpers (no_progress_loss) count invocations, so a
+            # per-segment call would stretch a patience of 5 trials into
+            # 5 segments.  Each replay sees the segment's final Trials —
+            # best-so-far only improves within a segment, so the stop
+            # lands at the first boundary at/after the hosted trigger.
+            if early_stop_fn is not None:
+                stop = False
+                for _ in range(s):
+                    stop, early_stop_args = early_stop_fn(trials,
+                                                          *early_stop_args)
+                    if stop:
+                        break
+                if stop:
+                    logger.info("early stop triggered (device mode)")
+                    break
+            if timeout is not None and _time() - t_start >= timeout:
+                break
+            if loss_threshold is not None:
+                try:
+                    if trials.best_trial["result"]["loss"] \
+                            <= loss_threshold:
+                        break
+                except Exception:
+                    pass
+    return trials
+
+
+def _mesh_key_of(mesh):
+    from .dispatch import _mesh_key
+
+    return _mesh_key(mesh) if mesh is not None else None

@@ -244,8 +244,8 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
     k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
          _tpe._pallas_mode(), _comp_sampler(), _tpe._pallas_tile(),
          _tpe._split_impl(), prng_impl(), _tpe._pallas_ei_impl(),
-         _tpe._ei_precision(), _tpe._ei_topm(), _rhist.enabled(),
-         ("mesh",) + _mesh_key(mesh))
+         _tpe._ei_precision(), _tpe._ei_topm(), _tpe._fused_step(),
+         _rhist.enabled(), ("mesh",) + _mesh_key(mesh))
     with _tpe._KERNELS_LOCK:
         hit = k in cache
         if not hit:

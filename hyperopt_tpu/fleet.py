@@ -481,3 +481,186 @@ def suggest_handle_ready(handle) -> bool:
     if handle[0] != "fleet":
         return tpe.suggest_handle_ready(handle)
     return handle[3][0].ready()
+
+
+# -- whole-loop fleet: vmapped device-resident fmin lanes -------------------
+
+
+def fmin_fleet(fn, space, n_lanes, max_evals, seed=0, sync_stride=None,
+               trials_list=None, mesh=None,
+               n_startup_jobs=tpe._default_n_startup_jobs,
+               n_EI_candidates=tpe._default_n_EI_candidates,
+               gamma=tpe._default_gamma,
+               prior_weight=tpe._default_prior_weight,
+               linear_forgetting=tpe._default_linear_forgetting,
+               split="sqrt", multivariate=False, cat_prior=None):
+    """Run ``n_lanes`` independent device-resident fmin loops in lockstep.
+
+    The population-as-array idiom applied to WHOLE optimizations: the
+    segmented scan behind ``fmin(mode='device')``
+    (``device._build_segment``) is ``vmap``-ed over a leading lane axis,
+    so every ``sync_stride``-trial segment is ONE dispatch and ONE slab
+    fetch for all lanes together — ``ceil(max_evals / stride)`` host
+    round trips for the entire fleet, regardless of lane count.  Lane
+    ``j`` draws its per-trial seeds from ``default_rng(seed + j)`` with
+    the hosted cadence, so each lane is seeded-bit-parity with a solo
+    ``fmin(mode='device')`` run under that rstate (pinned by
+    tests/test_fleet.py).
+
+    With a ``mesh``, lanes shard over its ``dp`` axis (restarts are
+    embarrassingly parallel; per-lane candidate axes stay local) — the
+    orthogonal composition with ``dispatch``'s candidate-axis sharding,
+    which applies to single-lane runs instead.
+
+    ``trials_list`` (optional, one ``Trials`` per lane) receives each
+    lane's slab as completed docs every segment, so per-tenant hooks and
+    stores see the run at stride granularity.  Early stopping is a
+    per-lane host decision and does not compose with lockstep lanes; use
+    solo device mode when you need it.
+
+    Returns a list of per-lane ``info`` dicts (``best``, ``best_loss``,
+    ``losses``, ``vals``, ``active``) in lane order.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from . import device as _device
+    from . import dispatch as _dispatch
+    from .base import JOB_STATE_DONE, STATUS_OK, coarse_utcnow
+    from .space import CompiledSpace, compile_space, prng_impl
+    from .tpe import _bucket, _pallas_tile
+
+    cs = space if isinstance(space, CompiledSpace) else compile_space(space)
+    n_lanes = int(n_lanes)
+    max_evals = int(max_evals)
+    if n_lanes < 1:
+        raise ValueError("n_lanes must be >= 1")
+    if max_evals < 1:
+        raise ValueError("max_evals must be >= 1")
+    if trials_list is not None and len(trials_list) != n_lanes:
+        raise ValueError(f"trials_list has {len(trials_list)} entries "
+                         f"for {n_lanes} lanes")
+    if sync_stride is not None:
+        sync_stride = int(sync_stride)
+        if sync_stride < 1:
+            raise ValueError("sync_stride must be >= 1 or None")
+    n_cap = _bucket(max_evals)
+    if mesh is not None:
+        from .dispatch import START_AXIS
+
+        if START_AXIS not in mesh.shape:
+            raise ValueError(
+                f"fmin_fleet shards lanes over the mesh's '{START_AXIS}' "
+                f"axis, but this mesh has axes {tuple(mesh.shape)}")
+        if n_lanes % mesh.shape[START_AXIS]:
+            raise ValueError(
+                f"n_lanes={n_lanes} not divisible by the "
+                f"{mesh.shape[START_AXIS]}-way '{START_AXIS}' mesh axis")
+    # Lanes shard over dp; per-lane suggests use the local kernel so the
+    # two partitionings cannot fight (same rule as fmin_device n_runs>1).
+    kern = _dispatch.get_kernel(cs, n_cap, int(n_EI_candidates),
+                                int(linear_forgetting), split,
+                                multivariate, cat_prior, mesh=None)
+    eval_one = _device._wrap_objective(fn, cs)
+    segment = _device._build_segment(cs, kern, eval_one,
+                                     int(n_startup_jobs), gamma,
+                                     prior_weight)
+
+    cache = getattr(cs, "_device_fmin_cache", None)
+    if cache is None:
+        from collections import OrderedDict
+
+        cache = cs._device_fmin_cache = OrderedDict()
+    base_key = ("fleet_seg", id(fn), n_lanes, n_cap, int(n_startup_jobs),
+                float(gamma), float(prior_weight), int(linear_forgetting),
+                int(n_EI_candidates), split, multivariate, kern.cat_prior,
+                kern.comp_sampler, kern.split_impl, kern.pallas,
+                kern.pallas_ei, kern.ei_precision, kern.ei_topm,
+                kern.fused_step, _pallas_tile(),
+                _device._mesh_key_of(mesh), prng_impl())
+    reg = _registry()
+
+    def seg_fn(s):
+        key = base_key + (s,)
+        run = cache.get(key)
+        if run is None:
+            reg.counter("device.run_cache.misses").inc()
+            run = cache[key] = jax.jit(
+                jax.vmap(segment, in_axes=(0, 0, 0, 0, 0, None)))
+            while len(cache) > _device._RUN_CACHE_CAP:
+                cache.popitem(last=False)
+        else:
+            cache.move_to_end(key)
+            reg.counter("device.run_cache.hits").inc()
+        return run
+
+    p_dim = cs.n_params
+    hv = jnp.zeros((n_lanes, n_cap, p_dim), jnp.float32)
+    ha = jnp.zeros((n_lanes, n_cap, p_dim), bool)
+    hl = jnp.full((n_lanes, n_cap), jnp.inf, jnp.float32)
+    hok = jnp.zeros((n_lanes, n_cap), bool)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from .dispatch import START_AXIS
+
+        def _lane_sharded(x):
+            spec = [None] * x.ndim
+            spec[0] = START_AXIS
+            return jax.device_put(x, NamedSharding(mesh,
+                                                   PartitionSpec(*spec)))
+
+        hv, ha, hl, hok = (_lane_sharded(a) for a in (hv, ha, hl, hok))
+    rstates = [np.random.default_rng(int(seed) + j) for j in range(n_lanes)]
+
+    all_rows = []
+    all_acts = []
+    all_losses = []
+    i = 0
+    while i < max_evals:
+        s = (max_evals - i if sync_stride is None
+             else min(sync_stride, max_evals - i))
+        seeds = np.asarray(
+            [[r.integers(2 ** 31 - 1) for _ in range(s)] for r in rstates],
+            np.uint32)
+        (hv, ha, hl, hok, _), (rows, acts, losses) = seg_fn(s)(
+            seeds, hv, ha, hl, hok, np.int32(i))
+        rows_h = np.asarray(rows)        # [B, s, P] — ONE fetch, all lanes
+        acts_h = np.asarray(acts)
+        losses_h = np.asarray(losses)
+        reg.counter("device.fetch_syncs").inc()
+        reg.counter("device.segments").inc()
+        all_rows.append(rows_h)
+        all_acts.append(acts_h)
+        all_losses.append(losses_h)
+        if trials_list is not None:
+            now = coarse_utcnow()
+            for j, trials in enumerate(trials_list):
+                new_ids = trials.new_trial_ids(s)
+                docs = base.docs_from_samples(
+                    cs, new_ids, rows_h[j], acts_h[j],
+                    exp_key=getattr(trials, "exp_key", None))
+                for doc, loss in zip(docs, losses_h[j]):
+                    doc["state"] = JOB_STATE_DONE
+                    doc["result"] = {"loss": float(loss),
+                                     "status": STATUS_OK}
+                    doc["book_time"] = now
+                    doc["refresh_time"] = now
+                trials.insert_trial_docs(docs)
+                trials.refresh()
+            reg.counter("device.trials_landed").inc(s * n_lanes)
+        i += s
+
+    vals = np.concatenate(all_rows, axis=1)      # [B, max_evals, P]
+    active = np.concatenate(all_acts, axis=1)
+    losses = np.concatenate(all_losses, axis=1)  # [B, max_evals]
+    out = []
+    for j in range(n_lanes):
+        order = np.where(np.isnan(losses[j]), np.inf, losses[j])
+        bi = int(np.argmin(order))
+        best = {p.label: cs._param_value(p, vals[j, bi, p.pid])
+                for p in cs.params if active[j, bi, p.pid]}
+        out.append({"best": best, "best_loss": float(losses[j, bi]),
+                    "best_index": bi, "losses": losses[j],
+                    "vals": vals[j], "active": active[j]})
+    return out

@@ -523,7 +523,8 @@ def fmin(fn, space, algo=None, max_evals=None,
          points_to_evaluate=None, max_queue_len=1,
          show_progressbar=True, early_stop_fn=None,
          trials_save_file="", trace_dir=None, overlap_suggest=False,
-         overlap_depth=None, evaluators=None, max_trial_retries=None):
+         overlap_depth=None, evaluators=None, max_trial_retries=None,
+         mode=None, sync_stride=None):
     """Minimize ``fn`` over ``space`` using ``algo``.
 
     Reference-parity signature: ``hyperopt/fmin.py::fmin`` (SURVEY.md §2 L5).
@@ -558,7 +559,26 @@ def fmin(fn, space, algo=None, max_evals=None,
     before it settles as a permanent failure; each retry increments
     ``fail_count`` in the trial's ``misc``.  Default 0 (fail fast);
     ``HYPEROPT_TPU_MAX_TRIAL_RETRIES`` sets the process-wide default.
+
+    Whole-loop-on-device addition: ``mode='device'`` runs the entire
+    suggest→evaluate→record loop on the accelerator for JAX-traceable
+    objectives (``hyperopt_tpu/device.py`` module doc for the objective
+    contract: a flat ``{label: f32 scalar}`` dict under jit).  Trials land
+    in ``trials`` in bulk every ``sync_stride`` evaluations (``None`` = one
+    fetch for the whole run); ``early_stop_fn``, ``timeout`` and
+    ``loss_threshold`` are checked on the landed slab between strides.  At
+    ``sync_stride=1`` the run is seeded-bit-parity with the hosted loop
+    (same ``rstate`` draw cadence, same seeded kernel entries).  Only
+    TPE-family ``algo`` values compose (``tpe.suggest`` /
+    ``suggest_quantile``, optionally ``partial``-bound); host-loop-only
+    options (``points_to_evaluate``, ``pass_expr_memo_ctrl``, pipelining,
+    retries, ``trials_save_file``) raise.  See docs/API.md "fmin modes".
     """
+    if mode not in (None, "host", "device"):
+        raise ValueError(f"mode must be None, 'host' or 'device', "
+                         f"got {mode!r}")
+    if sync_stride is not None and mode != "device":
+        raise ValueError("sync_stride only applies to mode='device'")
     if algo is None:
         algo = "tpe"
     if isinstance(algo, str):
@@ -601,6 +621,46 @@ def fmin(fn, space, algo=None, max_evals=None,
                 raise ValueError("points_to_evaluate must be a list of dicts")
             trials = generate_trials_to_calculate(points_to_evaluate)
 
+    if mode == "device":
+        unsupported = [name for name, v in (
+            ("points_to_evaluate", points_to_evaluate),
+            ("pass_expr_memo_ctrl", pass_expr_memo_ctrl),
+            ("catch_eval_exceptions", catch_eval_exceptions or None),
+            ("overlap_suggest", overlap_suggest or None),
+            ("overlap_depth", overlap_depth),
+            ("evaluators", evaluators),
+            ("max_trial_retries", max_trial_retries),
+            ("trials_save_file", trials_save_file or None),
+        ) if v is not None]
+        if unsupported:
+            raise ValueError(
+                "mode='device' runs the whole loop on the accelerator; "
+                "host-loop option(s) do not apply: "
+                + ", ".join(unsupported))
+        if max_evals is None:
+            raise ValueError("mode='device' requires max_evals (the "
+                             "compiled loop needs a trial budget)")
+        if getattr(trials, "asynchronous", False):
+            raise ValueError("mode='device' evaluates on device; "
+                             "asynchronous Trials backends do not apply")
+        algo_kw = _device_algo_kwargs(algo)
+        from .device import fmin_trials as _device_fmin_trials
+
+        _device_fmin_trials(
+            fn, space, max_evals=max_evals, trials=trials, rstate=rstate,
+            sync_stride=sync_stride, early_stop_fn=early_stop_fn,
+            timeout=timeout, loss_threshold=loss_threshold,
+            show_progressbar=show_progressbar and verbose, **algo_kw)
+        if return_argmin:
+            if len(trials.trials) == 0:
+                raise AllTrialsFailed(
+                    "There are no evaluation tasks, cannot return argmin "
+                    "of task losses.")
+            return trials.argmin
+        if len(trials) > 0:
+            return trials.best_trial["result"]["loss"]
+        return None
+
     if allow_trials_fmin and hasattr(trials, "fmin") and \
             type(trials).fmin is not Trials.fmin:
         # durable/async backends may implement their own fmin; delegate.
@@ -638,6 +698,57 @@ def fmin(fn, space, algo=None, max_evals=None,
     if len(trials) > 0:
         return trials.best_trial["result"]["loss"]
     return None
+
+
+#: algo keywords the device loop bakes into its compiled program — the
+#: TPE tuning surface, minus anything host-loop-only.
+_DEVICE_ALGO_KEYS = frozenset((
+    "gamma", "prior_weight", "n_startup_jobs", "n_EI_candidates",
+    "linear_forgetting", "split", "multivariate", "cat_prior"))
+
+
+def _device_algo_kwargs(algo):
+    """Map a TPE-family ``algo`` callable onto device-loop kwargs.
+
+    The device loop does not call ``algo`` (its suggest step is compiled
+    into the scan body), so the callable is only a carrier for tuning
+    kwargs: ``functools.partial(tpe.suggest, gamma=...)`` unwraps to
+    ``{'gamma': ...}``.  Anything that is not ``tpe.suggest`` /
+    ``suggest_quantile`` — or that binds a host-only option like
+    ``startup='qmc'`` — raises, because silently running a different
+    algorithm than the one the caller named would be worse than failing.
+    """
+    from . import tpe as _tpe
+
+    kw = {}
+    fn_ = algo
+    while isinstance(fn_, partial):
+        if fn_.args:
+            raise ValueError("mode='device': partial-bound positional "
+                             "algo args are not supported")
+        for k, v in (fn_.keywords or {}).items():
+            kw.setdefault(k, v)
+        fn_ = fn_.func
+    if fn_ is _tpe.suggest_quantile:
+        kw.setdefault("split", "quantile")
+    elif fn_ is not _tpe.suggest:
+        name = getattr(fn_, "__name__", repr(fn_))
+        raise ValueError(
+            f"mode='device' supports the TPE family only (tpe.suggest / "
+            f"tpe.suggest_quantile, optionally functools.partial-bound); "
+            f"got {name}. Use algo='tpe' or run mode=None.")
+    kw.pop("verbose", None)
+    startup = kw.pop("startup", None)
+    if startup not in (None, "rand"):
+        raise ValueError(
+            f"mode='device': startup={startup!r} is host-only; the "
+            "compiled loop warm-starts with the pseudo-random sampler")
+    bad = sorted(set(kw) - _DEVICE_ALGO_KEYS)
+    if bad:
+        raise ValueError(
+            "mode='device' cannot honor algo keyword(s) "
+            f"{bad}; supported: {sorted(_DEVICE_ALGO_KEYS)}")
+    return kw
 
 
 def validate_timeout(timeout):

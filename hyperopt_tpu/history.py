@@ -34,7 +34,9 @@ choice.
 
 Instrumentation (``obs.metrics``): ``history.upload_bytes`` (every
 host→device byte this module moves), ``history.append_hits`` (calls
-served by the delta path), ``history.rebuilds`` (full re-uploads).  The
+served by the delta path), ``history.rebuilds`` (full re-uploads),
+``history.order_violations`` (true tid reorders — these raise
+:class:`HistoryOrderError` instead of silently rebuilding).  The
 steady-state per-trial upload contract — O(P) bytes, not O(n_cap·P) —
 is asserted from these counters in the tier-1 suite.
 
@@ -393,6 +395,42 @@ def _validate(st, cs, h, p):
             and np.array_equal(st.tids, h["tids"][: st.n]))
 
 
+class HistoryOrderError(RuntimeError):
+    """The trials log REORDERED rows the resident ring already holds.
+
+    The append path's contract is that completed trials are append-only
+    in tid order; a silent full rebuild on reorder would mask the bug
+    that scrambled the log (and burn a full re-upload every step while
+    doing so).  Raised only on a *true* reorder — every resident tid
+    still present, relative order changed — which no legitimate store
+    operation (shrink, warm-start injection, a late async completion
+    inserting a lower tid mid-history) produces; those keep the counted
+    silent-rebuild fallback.
+    """
+
+
+def _check_tid_order(st, cs, h, p, reg):
+    """Distinguish reorders from legitimate rebuild causes; raise on the
+    former (``history.order_violations`` counter), return on the latter."""
+    if st is None or st.cs is not cs or st.bufs[0].shape[1] != p \
+            or st.n == 0:
+        return
+    pos = {int(t): i for i, t in
+           enumerate(np.asarray(h["tids"]).tolist())}
+    idxs = [pos.get(int(t)) for t in np.asarray(st.tids).tolist()]
+    if any(ix is None for ix in idxs):
+        return      # resident rows vanished/replaced: legitimate rebuild
+    if all(b > a for a, b in zip(idxs, idxs[1:])):
+        return      # still a subsequence (mid-insert): legitimate rebuild
+    reg.counter("history.order_violations").inc()
+    raise HistoryOrderError(
+        f"resident history rows appended out of tid order: the trials "
+        f"log still contains all {st.n} resident tids but permuted them "
+        f"(first rows now at log positions {idxs[:8]}...). The device "
+        f"ring is append-only in tid order; a store that reorders "
+        f"completed trials is corrupting the optimization history.")
+
+
 def device_history(trials, cs, h, n_cap, fantasies=None, sharding=None,
                    shard_key=None):
     """Return ``(hv, ha, hl, hok)`` device arrays bit-identical to
@@ -422,6 +460,7 @@ def device_history(trials, cs, h, n_cap, fantasies=None, sharding=None,
     with _LOCK:
         st = states.get(key) if states is not None else None
         if not _validate(st, cs, h, p):
+            _check_tid_order(st, cs, h, p, reg)
             # Prefix mismatch (or first touch): ONE full re-upload at the
             # requested capacity — correctness fallback, never wrong rows.
             cap = max(n_cap, st.cap if st is not None else 0)

@@ -1,0 +1,72 @@
+"""Fused fit+truncate+EI step primitives for the TPE suggest kernel.
+
+The unfused step (``tpe._TpeKernel._cont_fit``) lowers the below and above
+adaptive-Parzen fits as TWO ``vmap``-ed ``fit_parzen`` sweeps per group —
+two sorts, two gather pyramids, two weight normalizations, each a separate
+fusion island for XLA.  Both fits consume the SAME per-column observation
+layout (values, linear-forgetting weights, live counts), differing only in
+the set mask and the output capacity, so they stack into ONE ``vmap`` over
+``2·C`` columns at the above capacity and the below model falls out as a
+slice.
+
+Bit-exactness of the slice (why the fusion is an identity, not an
+approximation): ``fit_parzen`` sorts each column ascending with ``+inf``
+padding at the tail and masks every derived quantity by the live-component
+count ``m = n_obs + 1``.  A below column has at most
+``min(lf, n_ok) + 1 <= cap_b`` live components, so slots ``[cap_b:]`` of
+its wide fit are pure padding; slots ``[:cap_b]`` see identical sorted
+neighbors (the bandwidth of slot ``i`` reads ``s[i±1]`` only when those
+slots are live, i.e. also inside the slice) and an identical weight
+normalizer (summed over live slots only).  Pinned by
+``tests/test_tpe.py`` fused-parity and the ``benchmarks/step_ei_ab.py``
+proposal canary; selected via ``HYPEROPT_TPU_FUSED_STEP`` (on by default)
+and keyed through every kernel cache (``tpe.get_kernel``,
+``dispatch.get_kernel``, the device-fmin run cache).
+
+Downstream of the fused fit, the step reuses the existing heads — top-M
+truncation (``ops/gmm.py::truncate_mixture``) and the Pallas/XLA EI
+scorers — inside the same jitted program, so the whole
+fit→truncate→score chain stays one fusion region per group.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .parzen import fit_parzen
+
+
+def fused_parzen_fit(x_b, w_b, n_b, x_a, w_a, n_a, prior_mu, prior_sigma,
+                     prior_weight, cap_b, cap_a):
+    """Fit below AND above Parzen mixtures in one vmapped sweep.
+
+    Args:
+      x_b, x_a: f32[N, C] fit-space observations per column, ``+inf`` on
+        rows outside the respective split set.
+      w_b, w_a: f32[N, C] linear-forgetting weights, 0 outside the set.
+      n_b, n_a: i32[C] live-observation counts per column.
+      prior_mu, prior_sigma: f32[C] prior-component parameters.
+      prior_weight: f32 scalar.
+      cap_b, cap_a: static ints — below/above component capacities with
+        ``cap_b <= cap_a`` (callers pass ``min(lf, n_cap)+1`` and
+        ``n_cap+1``).
+
+    Returns ``(lwb[C, cap_b], mub, sgb, lwa[C, cap_a], mua, sga)`` —
+    log-weights, means, sigmas — bit-identical to two separate
+    ``fit_parzen`` sweeps at ``cap_b`` / ``cap_a``.
+    """
+    c = x_b.shape[1]
+    xs = jnp.concatenate([x_b, x_a], axis=1)            # [N, 2C]
+    ws = jnp.concatenate([w_b, w_a], axis=1)
+    ns = jnp.concatenate([n_b, n_a])
+    pmu = jnp.concatenate([prior_mu, prior_mu])
+    psg = jnp.concatenate([prior_sigma, prior_sigma])
+    fit = jax.vmap(partial(fit_parzen, out_cap=cap_a),
+                   in_axes=(1, 1, 0, 0, 0, None))
+    w, mu, sg = fit(xs, ws, ns, pmu, psg, prior_weight)  # [2C, cap_a]
+    wb, mub, sgb = w[:c, :cap_b], mu[:c, :cap_b], sg[:c, :cap_b]
+    wa, mua, sga = w[c:], mu[c:], sg[c:]
+    return jnp.log(wb), mub, sgb, jnp.log(wa), mua, sga

@@ -224,6 +224,23 @@ def _split_impl() -> str:
     return env if env in ("topk", "sort") else "topk"
 
 
+def _fused_step() -> bool:
+    """Fused fit+truncate+EI step lowering (``HYPEROPT_TPU_FUSED_STEP``).
+
+    On (default) — the below/above adaptive-Parzen fits of every
+    continuous group run as ONE stacked ``vmap`` sweep
+    (``ops/step_ei.py::fused_parzen_fit``), feeding the unchanged
+    truncation + EI heads inside the same fusion region.  Bit-identical
+    to the unfused two-sweep lowering by the slice argument in the
+    module doc (pinned by ``tests/test_tpe.py``); ``0``/``off`` keeps the
+    historical two-sweep form for A/B
+    (``benchmarks/device_fmin_stride.py`` records the wall-time diff).
+    Snapshotted at kernel construction; part of every kernel cache key.
+    """
+    env = os.environ.get("HYPEROPT_TPU_FUSED_STEP", "1").strip().lower()
+    return env not in ("0", "off", "false", "no", "")
+
+
 def _cat_prior_default() -> str:
     """Default categorical prior-strength schedule (see ``_cat_scores``).
 
@@ -385,6 +402,7 @@ class _TpeKernel:
         self.ei_precision = _ei_precision()
         self.ei_topm = _ei_topm()
         self.split_impl = _split_impl()
+        self.fused_step = _fused_step()
         # Snapshot at construction: the cache key records this value, and a
         # lazily-traced program must bake in the SAME lowering even if the
         # env toggle changed between get_kernel() and the first call.
@@ -554,10 +572,25 @@ class _TpeKernel:
         z = vals[:, g.pids]
         z = jnp.where(g.is_log, jnp.log(jnp.maximum(z, _TINY)), z)
         act = active[:, g.pids]
+        cap_b = min(self.lf, self.n_cap) + 1
+        cap_a = self.n_cap + 1
+
+        def set_obs(set_mask):
+            m, w, n_set = self._set_weights(set_mask, act)
+            return jnp.where(m, z, jnp.inf), w, n_set
+
+        if self.fused_step:
+            # One stacked sweep over below+above columns; the below model
+            # is a bit-exact slice of the wide fit (ops/step_ei.py).
+            from .ops.step_ei import fused_parzen_fit
+
+            return fused_parzen_fit(*set_obs(below), *set_obs(above),
+                                    jnp.asarray(g.prior_mu),
+                                    jnp.asarray(g.prior_sigma),
+                                    prior_weight, cap_b, cap_a)
 
         def models(set_mask, cap):
-            m, w, n_set = self._set_weights(set_mask, act)
-            x = jnp.where(m, z, jnp.inf)
+            x, w, n_set = set_obs(set_mask)
             fit = jax.vmap(partial(fit_parzen, out_cap=cap),
                            in_axes=(1, 1, 0, 0, 0, None))
             return fit(x, w, n_set, jnp.asarray(g.prior_mu),
@@ -567,8 +600,8 @@ class _TpeKernel:
         # the history bucket holds); above mixtures span the full bucketed
         # history — that [n_cand, N+1] broadcast is the dominant FLOP block
         # of the step.
-        wb, mub, sgb = models(below, min(self.lf, self.n_cap) + 1)
-        wa, mua, sga = models(above, self.n_cap + 1)
+        wb, mub, sgb = models(below, cap_b)
+        wa, mua, sga = models(above, cap_a)
         return jnp.log(wb), mub, sgb, jnp.log(wa), mua, sga
 
     def _cont_draw(self, g: _ContGroup, key, lwb, mub, sgb):
@@ -1053,7 +1086,7 @@ def get_kernel(cs: CompiledSpace, n_cap: int, n_cand: int, lf: int,
     k = (n_cap, n_cand, lf, split, multivariate, cat_prior,
          _pallas_mode(), _comp_sampler(), _pallas_tile(), _split_impl(),
          prng_impl(), _pallas_ei_impl(), _ei_precision(), _ei_topm(),
-         _rhist.enabled())
+         _fused_step(), _rhist.enabled())
     with _KERNELS_LOCK:
         hit = k in cache
         if not hit:

@@ -166,6 +166,111 @@ def test_jp005_use_after_donation_fires_and_rebind_silent():
     assert not rules_fired(run_checker("jit-purity", ok), "JP005")
 
 
+def test_jp006_host_callback_fires_and_clean_twin_silent():
+    bad = _jp("import jax\n"
+              "def f(x):\n"
+              "    return jax.pure_callback(abs, x, x)\n"
+              "g = jax.jit(f)\n")
+    ok = _jp("import jax\n"
+             "import jax.numpy as jnp\n"
+             "def f(x):\n"
+             "    return jnp.abs(x)\n"
+             "g = jax.jit(f)\n")
+    assert rules_fired(run_checker("jit-purity", bad), "JP006")
+    assert not rules_fired(run_checker("jit-purity", ok), "JP006")
+
+
+def test_jp006_debug_callback_and_io_callback_fire():
+    bad = _jp("import jax\n"
+              "def f(x):\n"
+              "    jax.debug.callback(print, x)\n"
+              "    return jax.experimental.io_callback(abs, x, x)\n"
+              "g = jax.jit(f)\n")
+    assert len(rules_fired(run_checker("jit-purity", bad), "JP006")) == 2
+
+
+def test_jp007_python_rng_fires_and_jax_random_silent():
+    bad = _jp("import jax\n"
+              "import numpy as np\n"
+              "import random\n"
+              "def f(x, rstate):\n"
+              "    a = np.random.normal()\n"
+              "    b = random.random()\n"
+              "    c = rstate.integers(100)\n"
+              "    return x + a + b + c\n"
+              "g = jax.jit(f)\n")
+    ok = _jp("import jax\n"
+             "def f(key, x):\n"
+             "    return x + jax.random.normal(key)\n"
+             "g = jax.jit(f)\n")
+    assert len(rules_fired(run_checker("jit-purity", bad), "JP007")) == 3
+    assert not rules_fired(run_checker("jit-purity", ok), "JP007")
+
+
+def test_jp_scan_body_is_an_entry_point():
+    # The carry loop of fmin(mode='device'): a NESTED body handed to
+    # lax.scan inside a builder that is never itself jitted.  The body
+    # must still get the full JP sweep (JP006 here).
+    bad = _jp("import jax\n"
+              "from jax import lax\n"
+              "def build(fn):\n"
+              "    def body(carry, seed):\n"
+              "        loss = jax.pure_callback(fn, carry, carry)\n"
+              "        return carry + loss, loss\n"
+              "    def segment(c0, seeds):\n"
+              "        return lax.scan(body, c0, seeds)\n"
+              "    return segment\n")
+    ok = _jp("import jax\n"
+             "from jax import lax\n"
+             "def build():\n"
+             "    def body(carry, seed):\n"
+             "        key = jax.random.wrap_key_data(seed)\n"
+             "        return carry + jax.random.normal(key), carry\n"
+             "    def segment(c0, seeds):\n"
+             "        return lax.scan(body, c0, seeds)\n"
+             "    return segment\n")
+    fired = rules_fired(run_checker("jit-purity", bad), "JP006")
+    assert fired and fired[0].symbol == "body"
+    assert not run_checker("jit-purity", ok)
+
+
+def test_jp_other_ctrl_flow_bodies_are_entry_points():
+    # fori_loop arg 2, while_loop args 0+1, cond args 1+2, lax.map arg 0
+    # — and the Python builtin map must NOT become an entry point.
+    bad = _jp("import jax\n"
+              "from jax import lax\n"
+              "import random\n"
+              "def fb(i, c):\n"
+              "    return c + random.random()\n"
+              "def wc(c):\n"
+              "    return c.item() < 10\n"
+              "def wb(c):\n"
+              "    return c + random.random()\n"
+              "def ct(c):\n"
+              "    return c + random.random()\n"
+              "def cf(c):\n"
+              "    return c - random.random()\n"
+              "def mf(x):\n"
+              "    return x + random.random()\n"
+              "def run(c, xs, p):\n"
+              "    a = lax.fori_loop(0, 4, fb, c)\n"
+              "    b = lax.while_loop(wc, wb, c)\n"
+              "    d = lax.cond(p, ct, cf, c)\n"
+              "    e = lax.map(mf, xs)\n"
+              "    return a + b + d + e\n")
+    findings = run_checker("jit-purity", bad)
+    assert {f.symbol for f in rules_fired(findings, "JP007")} == \
+        {"fb", "wb", "ct", "cf", "mf"}
+    assert {f.symbol for f in rules_fired(findings, "JP001")} == {"wc"}
+
+    builtin_map = _jp("import random\n"
+                      "def host(x):\n"
+                      "    return x + random.random()\n"
+                      "def run(xs):\n"
+                      "    return list(map(host, xs))\n")
+    assert not run_checker("jit-purity", builtin_map)
+
+
 # ---------------------------------------------------------------------------
 # LK — lock discipline
 # ---------------------------------------------------------------------------

@@ -179,6 +179,49 @@ class TestBenchmarkArtifacts:
             assert head["parity_all_rows"] is True, name
             assert head["steady_compiles_all_zero"] is True, name
 
+    def test_device_fmin_stride_artifact_schema(self):
+        """ISSUE 16 acceptance artifact: fmin(mode='device') trials/s vs
+        the REAL hosted fmin loop at sync_stride 1/8/64/∞, host round
+        trips per run counted from device.fetch_syncs, stride-1 seeded
+        bit-parity, and the fused-step A/B — written by
+        benchmarks/device_fmin_stride.py."""
+        paths = sorted(glob.glob(
+            os.path.join(_BENCH_DIR, "device_fmin_stride_*.json")))
+        assert paths, \
+            "no benchmarks/device_fmin_stride_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == \
+                "device_fmin_trials_per_sec_by_sync_stride", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            assert doc["host_loop_trials_per_sec"] > 0, name
+            strides = [r["sync_stride"] for r in doc["rows"]]
+            assert strides == ["1", "8", "64", "inf"], f"{name}: {strides}"
+            for r in doc["rows"]:
+                assert {"trials_per_sec", "fetches_per_run",
+                        "host_round_trips_per_trial",
+                        "speedup_vs_host_loop"} <= set(r), f"{name}: {r}"
+            by = {r["sync_stride"]: r for r in doc["rows"]}
+            assert by["1"]["fetches_per_run"] == doc["n_evals"], (
+                f"{name}: stride-1 must fetch once per trial")
+            assert by["inf"]["fetches_per_run"] == 1, (
+                f"{name}: stride-∞ must fetch exactly once per run — "
+                "the zero-per-trial-round-trips claim")
+            head = doc["headline"]
+            assert head["meets_5x_at_stride_inf"] is True, (
+                f"{name}: stride-∞ speedup "
+                f"{head['speedup_at_stride_inf']}x is below the 5x "
+                "acceptance bar vs the hosted loop")
+            assert head["bit_parity_stride1_vs_host"] is True, (
+                f"{name}: device stride-1 run diverged from the seeded "
+                "hosted loop")
+            assert head["fused_step_bit_parity"] is True, (
+                f"{name}: fused step kernel changed the proposals")
+            assert {"fused", "unfused"} <= set(doc["fused_ab"]), name
+
     def test_multichip_artifact_schema(self):
         """PR 15 acceptance artifact: the dispatch substrate's sharded
         suggest at fixed total work over 1/2/4/8-device meshes — per-row

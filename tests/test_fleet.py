@@ -508,3 +508,59 @@ class TestServiceGate:
             assert _counter("fleet.dispatches") == d0
         finally:
             srv.shutdown()
+
+
+class TestFminFleet:
+    """fmin_fleet: lockstep vmapped device loops (ISSUE 16 tentpole).
+
+    Lane j must be seeded-bit-parity with a solo fmin(mode="device") run
+    under default_rng(seed + j) — the vmap is a pure batching transform,
+    not a different algorithm — and trials_list landing must carry the
+    same losses the info dicts report.  The objective avoids
+    multiply-into-add chains so the vmapped and solo XLA programs cannot
+    diverge by an FMA rounding.
+    """
+
+    SPACE = {"x": hp.uniform("x", -5, 5),
+             "c": hp.choice("c", [0, 1, 2, 3])}
+
+    def test_lane_parity_and_landing(self):
+        import jax.numpy as jnp
+
+        import hyperopt_tpu as ho
+
+        def obj(p):
+            return jnp.abs(p["x"] - 1.0) + p["c"]
+
+        n = 24
+        tl = [ho.Trials() for _ in range(2)]
+        infos = fleet.fmin_fleet(obj, self.SPACE, n_lanes=2, max_evals=n,
+                                 seed=3, sync_stride=8, trials_list=tl)
+        assert len(infos) == 2
+        for j, info in enumerate(infos):
+            t = ho.Trials()
+            fmin(obj, self.SPACE, algo=tpe.suggest, max_evals=n, trials=t,
+                 rstate=np.random.default_rng(3 + j),
+                 show_progressbar=False, mode="device", sync_stride=8)
+            solo = [float(d["result"]["loss"]) for d in t._dynamic_trials]
+            np.testing.assert_array_equal(
+                np.asarray(info["losses"], np.float64), np.asarray(solo))
+            assert float(info["best_loss"]) == min(solo)
+            landed = [float(d["result"]["loss"])
+                      for d in tl[j]._dynamic_trials]
+            assert landed == solo
+        # distinct per-lane seed streams, not one stream copied
+        assert not np.array_equal(infos[0]["losses"], infos[1]["losses"])
+
+    def test_validation(self):
+        def obj(p):
+            return p["x"]
+
+        with pytest.raises(ValueError, match="n_lanes"):
+            fleet.fmin_fleet(obj, self.SPACE, n_lanes=0, max_evals=4)
+        with pytest.raises(ValueError, match="trials_list"):
+            fleet.fmin_fleet(obj, self.SPACE, n_lanes=2, max_evals=4,
+                             trials_list=[base.Trials()])
+        with pytest.raises(ValueError, match="sync_stride"):
+            fleet.fmin_fleet(obj, self.SPACE, n_lanes=2, max_evals=4,
+                             sync_stride=0)

@@ -1,0 +1,262 @@
+"""fmin(mode="device") — the whole-loop-on-device path (ISSUE 16).
+
+Contracts pinned here:
+
+* **Seeded bit-parity with the hosted loop** at ``sync_stride=1``: same
+  ``rstate`` → byte-identical trial documents (tids, vals, losses,
+  statuses) across three domains — a continuous quadratic, a pure
+  categorical bandit, and a quantized + categorical conditional space.
+  Objectives compute in per-op float32 on BOTH sides and avoid
+  multiply-into-add chains (XLA would fuse those into FMAs inside the
+  scan and round once where the host rounds twice).
+* **Stride invariance**: the landed trials are independent of
+  ``sync_stride`` — the stride only moves the fetch boundary.
+* **Fetch accounting**: host round trips per run = ``ceil(n / stride)``
+  (1 at ``sync_stride=None``), read from ``device.fetch_syncs``; the
+  zero-per-trial claim of the bench is counted, not assumed.
+* **Resume**: a device run continues an existing ``Trials`` exactly like
+  the hosted loop would (ring seeded from completed docs).
+* **Early stop** (`utils/early_stop.py`): fires at the first sync
+  boundary at which the hosted loop would have stopped — within one
+  stride of the trigger.
+* **Validation**: the device branch rejects what it cannot honor
+  (non-TPE algos, host-callback features, async trials, bad strides)
+  instead of silently degrading.
+"""
+
+import math
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hyperopt_tpu as ho
+from hyperopt_tpu import hp, rand, tpe
+from hyperopt_tpu.obs.metrics import registry
+from hyperopt_tpu.utils.early_stop import no_progress_loss
+
+# ---------------------------------------------------------------------------
+# device/host objective twins (identical f32 math, FMA-free)
+# ---------------------------------------------------------------------------
+
+SPACE_QUAD = {"x": hp.uniform("x", -5, 5)}
+
+
+def quad_dev(p):
+    return (p["x"] - 3.0) ** 2
+
+
+def quad_host(d):
+    return float((np.float32(d["x"]) - np.float32(3.0)) ** 2)
+
+
+SPACE_ARMS = {"arm": hp.choice("arm", list(range(6)))}
+
+
+def arms_dev(p):
+    return p["arm"] * 0.1
+
+
+def arms_host(d):
+    return float(np.float32(d["arm"]) * np.float32(0.1))
+
+
+# Quantized + categorical conditional space: loss values are exact small
+# integers, so parity cannot hinge on rounding at all.
+SPACE_QCAT = {
+    "q": hp.quniform("q", 0, 20, 2),
+    "c": hp.choice("c", [
+        {"kind": 0},
+        {"kind": 1, "depth": hp.quniform("depth", 1, 8, 1)},
+    ]),
+}
+
+
+def qcat_dev(p):
+    return jnp.abs(p["q"] - 6.0) + jnp.where(p["c"] > 0, p["depth"], 0.0)
+
+
+def qcat_host(d):
+    base = abs(np.float32(d["q"]) - np.float32(6.0))
+    extra = np.float32(d["c"]["depth"]) if d["c"]["kind"] == 1 \
+        else np.float32(0.0)
+    return float(base + extra)
+
+
+DOMAINS = [
+    ("quadratic1", SPACE_QUAD, quad_dev, quad_host),
+    ("n_arms", SPACE_ARMS, arms_dev, arms_host),
+    ("qcat", SPACE_QCAT, qcat_dev, qcat_host),
+]
+
+ALGO = tpe.suggest
+N = 32      # one history bucket on both sides — hosted bucket floor is 32
+
+
+def _host(fn, space, seed, n=N, trials=None, **kw):
+    t = trials if trials is not None else ho.Trials()
+    ho.fmin(fn, space, algo=ALGO, max_evals=n, trials=t,
+            rstate=np.random.default_rng(seed), show_progressbar=False,
+            **kw)
+    return t
+
+
+def _device(fn, space, seed, stride, n=N, trials=None, **kw):
+    t = trials if trials is not None else ho.Trials()
+    ho.fmin(fn, space, algo=ALGO, max_evals=n, trials=t,
+            rstate=np.random.default_rng(seed), show_progressbar=False,
+            mode="device", sync_stride=stride, **kw)
+    return t
+
+
+def _rows(t):
+    return [(d["tid"],
+             {k: tuple(map(float, v))
+              for k, v in sorted(d["misc"]["vals"].items())},
+             float(d["result"]["loss"]), d["result"]["status"])
+            for d in t._dynamic_trials]
+
+
+def _counter(name):
+    return registry().snapshot()["counters"].get(name, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("name,space,fdev,fhost", DOMAINS,
+                         ids=[d[0] for d in DOMAINS])
+def test_stride1_bit_parity_vs_hosted_loop(name, space, fdev, fhost):
+    a = _host(fhost, space, seed=5)
+    b = _device(fdev, space, seed=5, stride=1)
+    assert _rows(a) == _rows(b)
+
+
+def test_stride_invariance_and_fetch_accounting():
+    runs = {}
+    for stride, want_fetches in ((1, N), (8, N // 8), (None, 1)):
+        f0 = _counter("device.fetch_syncs")
+        runs[stride] = _rows(_device(qcat_dev, SPACE_QCAT, seed=9,
+                                     stride=stride))
+        assert _counter("device.fetch_syncs") - f0 == want_fetches
+    assert runs[1] == runs[8] == runs[None]
+
+
+def test_counters_segments_and_landings():
+    s0 = _counter("device.segments")
+    l0 = _counter("device.trials_landed")
+    _device(quad_dev, SPACE_QUAD, seed=3, stride=8)
+    assert _counter("device.segments") - s0 == N // 8
+    assert _counter("device.trials_landed") - l0 == N
+
+
+def test_resume_from_existing_trials_matches_hosted_continuation():
+    a = _host(quad_host, SPACE_QUAD, seed=7, n=10)
+    _host(quad_host, SPACE_QUAD, seed=11, n=N, trials=a)
+
+    b = _host(quad_host, SPACE_QUAD, seed=7, n=10)
+    _device(quad_dev, SPACE_QUAD, seed=11, stride=1, n=N, trials=b)
+    assert _rows(a) == _rows(b)
+
+
+def test_return_value_matches_hosted():
+    t1, t2 = ho.Trials(), ho.Trials()
+    best_h = ho.fmin(quad_host, SPACE_QUAD, algo=ALGO, max_evals=N,
+                     trials=t1, rstate=np.random.default_rng(5),
+                     show_progressbar=False)
+    best_d = ho.fmin(quad_dev, SPACE_QUAD, algo=ALGO, max_evals=N,
+                     trials=t2, rstate=np.random.default_rng(5),
+                     show_progressbar=False, mode="device", sync_stride=1)
+    assert best_h == best_d
+    assert t1.best_trial["result"]["loss"] == t2.best_trial["result"]["loss"]
+
+
+def test_algo_config_flows_through_partial():
+    # A non-default TPE config must produce the SAME non-default run on
+    # both paths (i.e. the device branch really unwraps the partial).
+    algo = partial(tpe.suggest, n_startup_jobs=5, gamma=0.5,
+                   n_EI_candidates=13)
+    a, b = ho.Trials(), ho.Trials()
+    ho.fmin(quad_host, SPACE_QUAD, algo=algo, max_evals=N, trials=a,
+            rstate=np.random.default_rng(2), show_progressbar=False)
+    ho.fmin(quad_dev, SPACE_QUAD, algo=algo, max_evals=N, trials=b,
+            rstate=np.random.default_rng(2), show_progressbar=False,
+            mode="device", sync_stride=1)
+    assert _rows(a) == _rows(b)
+
+
+# ---------------------------------------------------------------------------
+# early stop at the stride boundary
+# ---------------------------------------------------------------------------
+
+
+def flat_dev(p):
+    return p["x"] * 0.0 + 1.0
+
+
+def flat_host(d):
+    return 1.0
+
+
+def test_early_stop_halts_within_one_stride():
+    stride = 4
+    a = _host(flat_host, SPACE_QUAD, seed=1, n=64,
+              early_stop_fn=no_progress_loss(5))
+    n_host = len(a)
+    assert n_host < 64      # the trigger actually fired
+
+    b = _device(flat_dev, SPACE_QUAD, seed=1, stride=stride, n=64,
+                early_stop_fn=no_progress_loss(5))
+    n_dev = len(b)
+    assert n_dev < 64
+    # the first sync boundary at/after the hosted stop point
+    assert n_host <= n_dev == stride * math.ceil(n_host / stride)
+
+
+def test_loss_threshold_stops_at_boundary():
+    t = _device(quad_dev, SPACE_QUAD, seed=5, stride=4, n=64,
+                loss_threshold=1.0)
+    assert len(t) < 64
+    assert t.best_trial["result"]["loss"] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+
+def test_mode_and_stride_validation():
+    with pytest.raises(ValueError, match="mode"):
+        _host(quad_host, SPACE_QUAD, seed=0, n=4, mode="banana")
+    with pytest.raises(ValueError, match="sync_stride"):
+        _host(quad_host, SPACE_QUAD, seed=0, n=4, sync_stride=8)
+    with pytest.raises(ValueError, match="sync_stride"):
+        _device(quad_dev, SPACE_QUAD, seed=0, stride=0, n=4)
+
+
+def test_non_tpe_algo_rejected():
+    with pytest.raises(ValueError, match="device"):
+        ho.fmin(quad_dev, SPACE_QUAD, algo=rand.suggest, max_evals=4,
+                trials=ho.Trials(), rstate=np.random.default_rng(0),
+                show_progressbar=False, mode="device")
+
+
+def test_host_callback_features_rejected():
+    for kw in (dict(points_to_evaluate=[{"x": 0.0}]),
+               dict(pass_expr_memo_ctrl=True),
+               dict(catch_eval_exceptions=True),
+               dict(trials_save_file="/tmp/x.pkl")):
+        with pytest.raises(ValueError, match="host-loop option"):
+            ho.fmin(quad_dev, SPACE_QUAD, algo=ALGO, max_evals=4,
+                    trials=ho.Trials(), rstate=np.random.default_rng(0),
+                    show_progressbar=False, mode="device", **kw)
+
+
+def test_max_evals_required():
+    with pytest.raises(ValueError, match="max_evals"):
+        ho.fmin(quad_dev, SPACE_QUAD, algo=ALGO, trials=ho.Trials(),
+                rstate=np.random.default_rng(0), show_progressbar=False,
+                mode="device")

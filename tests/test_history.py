@@ -227,6 +227,68 @@ class TestFeedBitEquality:
         assert _counter("history.rebuilds") == r0 + 2
 
 
+class TestAppendOrderContract:
+    """ISSUE 16 satellite: steady-state loops must ride the delta path
+    (``history_rebuilds`` ≤ 1 over a whole cold run, every later suggest
+    an append hit), and a trials log that REORDERS rows the ring already
+    holds must fail loudly instead of silently re-uploading."""
+
+    class _T:   # weakref-able stand-in for a Trials object
+        pass
+
+    def _h(self, rng, n, p, tids):
+        vals = rng.standard_normal((n, p)).astype(np.float32)
+        active = np.ones((n, p), bool)
+        loss = rng.standard_normal(n).astype(np.float32)
+        ok = np.ones(n, bool)
+        return dict(vals=vals, active=active, loss=loss, ok=ok,
+                    tids=np.asarray(tids, np.int64))
+
+    def test_cold_loop_rebuilds_at_most_once(self, monkeypatch):
+        # 44 evals = 20 startup + 24 TPE suggests: the first TPE suggest
+        # is the one allowed rebuild (first touch), the other 23 must all
+        # be delta appends — the loop_breakdown counters bench.py diffs.
+        r0 = _counter("history.rebuilds")
+        a0 = _counter("history.append_hits")
+        _run(True, 31, 44, monkeypatch)
+        assert _counter("history.rebuilds") - r0 <= 1
+        assert _counter("history.append_hits") - a0 == 23
+
+    def test_reorder_raises_loudly(self, rng):
+        trials, cs = self._T(), object()
+        h = self._h(rng, 6, 3, tids=range(6))
+        rhist.device_history(trials, cs, h, 16)         # warm the store
+        swapped = {k: v.copy() for k, v in h.items()}
+        swapped["tids"][2], swapped["tids"][4] = h["tids"][4], h["tids"][2]
+        v0 = _counter("history.order_violations")
+        with pytest.raises(rhist.HistoryOrderError):
+            rhist.device_history(trials, cs, swapped, 16)
+        assert _counter("history.order_violations") == v0 + 1
+
+    def test_mid_insert_rebuilds_without_raising(self, rng):
+        # A late async completion landing a LOWER tid between resident
+        # rows keeps relative order (still a subsequence): legitimate
+        # counted rebuild, no raise.
+        trials, cs = self._T(), object()
+        h = self._h(rng, 5, 3, tids=[0, 2, 4, 6, 8])
+        rhist.device_history(trials, cs, h, 16)
+        ins = self._h(rng, 6, 3, tids=[0, 2, 3, 4, 6, 8])
+        r0 = _counter("history.rebuilds")
+        v0 = _counter("history.order_violations")
+        rhist.device_history(trials, cs, ins, 16)
+        assert _counter("history.rebuilds") == r0 + 1
+        assert _counter("history.order_violations") == v0
+
+    def test_deletion_rebuilds_without_raising(self, rng):
+        trials, cs = self._T(), object()
+        h = self._h(rng, 5, 3, tids=range(5))
+        rhist.device_history(trials, cs, h, 16)
+        short = {k: v[1:] for k, v in h.items()}
+        v0 = _counter("history.order_violations")
+        rhist.device_history(trials, cs, short, 16)
+        assert _counter("history.order_violations") == v0
+
+
 class TestTransferContract:
     def test_steady_state_upload_is_o_p(self, monkeypatch):
         """Regression guard on ISSUE 3's acceptance criterion: once warm,

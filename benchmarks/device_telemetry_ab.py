@@ -1,0 +1,176 @@
+"""Device-loop telemetry overhead A/B: armed vs disarmed slab + backfill.
+
+ISSUE 17's acceptance measurement.  The telemetry slab rides the
+``lax.scan`` carry of ``fmin(mode="device")`` and is fetched in the SAME
+bulk transfer as the trial slab, so arming it must cost (a) nothing on
+the device program beyond the slab reductions XLA can overlap, and
+(b) only boundary-rate host work for the backfill
+(``obs/devtel.py::backfill_segment``).  Two questions, counted:
+
+* **Throughput overhead** — trials/s armed
+  (``HYPEROPT_TPU_DEVICE_TELEMETRY=1``) vs disarmed (``=0``) at
+  ``sync_stride ∈ {1, 8, ∞}``, same seeds, arms INTERLEAVED per rep so
+  background-load drift cancels instead of landing on whichever arm ran
+  second.  The acceptance bar is ≤5% at stride ∞ — one boundary per
+  run, i.e. the regime the device mode exists for.  Stride 1 is the
+  worst case on purpose: a backfill (span + synthetic anchor + gauge
+  writes) per TRIAL bounds the per-boundary host cost from above.
+* **Bit-parity** — the armed and disarmed runs must land byte-identical
+  trials (the tests/test_fmin_device_mode.py contract, re-checked here
+  on the bench shape): the slab only reads tensors the proposal math
+  already computes, never feeds them.
+
+The env toggle is keyed into the segment run cache, so in-process
+flipping is safe — each arm traces its own program.
+
+Run::
+
+    env JAX_PLATFORMS=cpu python benchmarks/device_telemetry_ab.py
+
+Writes ``benchmarks/device_telemetry_ab_<backend>_<stamp>.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+
+def jnp_abs(x):
+    import jax.numpy as jnp
+
+    return jnp.abs(x)
+
+
+SEED = 1
+N_EVALS = 256                  # long enough to amortize run-end health
+N_CAND = 24
+REPS = 7                       # best-of, absorbs scheduler noise
+STRIDES = (("1", 1), ("8", 8), ("inf", None))
+ARMS = (("armed", "1"), ("disarmed", "0"))
+
+
+def _space():
+    from hyperopt_tpu import hp
+
+    return {"x": hp.uniform("x", -5, 5),
+            "c": hp.choice("c", [0, 1, 2, 3])}
+
+
+def _dev_obj(p):
+    # |x-1| + c: FMA-free (see device_fmin_stride.py) so the parity bit
+    # cannot be broken by a rounding difference between arms.
+    return jnp_abs(p["x"] - 1.0) + p["c"]
+
+
+def _run(seed, stride):
+    """One full device-mode optimization; returns (trials/s, Trials)."""
+    import hyperopt_tpu as ho
+    from hyperopt_tpu import tpe
+
+    t = ho.Trials()
+    t0 = time.perf_counter()
+    ho.fmin(_dev_obj, _space(),
+            algo=partial(tpe.suggest, n_EI_candidates=N_CAND),
+            max_evals=N_EVALS, trials=t,
+            rstate=np.random.default_rng(seed), show_progressbar=False,
+            mode="device", sync_stride=stride)
+    dt = time.perf_counter() - t0
+    return N_EVALS / dt, t
+
+
+def _vals(t):
+    return [(d["tid"], {k: tuple(map(float, v))
+                        for k, v in d["misc"]["vals"].items()},
+             float(d["result"]["loss"]))
+            for d in t._dynamic_trials]
+
+
+def main():
+    import jax
+
+    backend = jax.default_backend()
+    print(f"backend={backend}  n_evals={N_EVALS} n_cand={N_CAND} "
+          f"strides={[s for s, _ in STRIDES]}  (best of {REPS})",
+          flush=True)
+
+    rows = []
+    for label, stride in STRIDES:
+        for _arm, env in ARMS:                # warm both programs first
+            os.environ["HYPEROPT_TPU_DEVICE_TELEMETRY"] = env
+            _run(0, stride)
+        best = {a: 0.0 for a, _ in ARMS}
+        trials = {}
+        for _ in range(REPS):
+            for arm, env in ARMS:
+                os.environ["HYPEROPT_TPU_DEVICE_TELEMETRY"] = env
+                ts, t = _run(SEED, stride)
+                best[arm] = max(best[arm], ts)
+                trials[arm] = _vals(t)
+        overhead = (best["disarmed"] / best["armed"] - 1.0) * 100.0
+        row = {
+            "sync_stride": label,
+            "armed_trials_per_sec": round(best["armed"], 1),
+            "disarmed_trials_per_sec": round(best["disarmed"], 1),
+            "overhead_pct": round(overhead, 2),
+            "parity_bit_identical": trials["armed"] == trials["disarmed"],
+        }
+        rows.append(row)
+        print(f"  stride {label:>3}: armed {best['armed']:8.1f} "
+              f"disarmed {best['disarmed']:8.1f} trials/s  "
+              f"overhead {row['overhead_pct']:+.2f}%  "
+              f"parity {row['parity_bit_identical']}", flush=True)
+    os.environ.pop("HYPEROPT_TPU_DEVICE_TELEMETRY", None)
+
+    by = {r["sync_stride"]: r for r in rows}
+    headline = {
+        "overhead_pct_at_stride_inf": by["inf"]["overhead_pct"],
+        "within_5pct_at_stride_inf": by["inf"]["overhead_pct"] <= 5.0,
+        "overhead_pct_worst_case_stride_1": by["1"]["overhead_pct"],
+        "parity_all_rows": all(r["parity_bit_identical"] for r in rows),
+    }
+
+    doc = {
+        "metric": "device_telemetry_overhead_armed_vs_disarmed",
+        "backend": backend,
+        "device": str(jax.devices()[0]),
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "seed": SEED,
+        "n_evals": N_EVALS,
+        "n_EI_candidates": N_CAND,
+        "reps": REPS,
+        "space": "2-param (uniform + 4-way choice), bucket-64 history",
+        "rows": rows,
+        "headline": headline,
+        "note": "best-of-reps with interleaved arms; overhead_pct is "
+                "(disarmed/armed - 1)*100, so noise can drive it "
+                "slightly negative.  Stride 1 backfills per trial and "
+                "upper-bounds the per-boundary host cost; stride inf "
+                "(one boundary per run) carries the <=5% acceptance "
+                "bar.  Armed cost is boundary-rate (~150us/boundary "
+                "host backfill + one O(n_docs) health pass per run), "
+                "so it amortizes with run length — n_evals=256 is the "
+                "representative regime; a 64-trial CPU run is ~5ms "
+                "total and fixed costs read as noise there.  The slab "
+                "itself adds no sync boundaries — device.fetch_syncs "
+                "deltas are pinned by tests/test_fmin_device_mode.py",
+    }
+    stamp = time.strftime("%Y%m%d")
+    path = os.path.join(_ROOT, "benchmarks",
+                        f"device_telemetry_ab_{backend}_{stamp}.json")
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    print(json.dumps(doc["headline"], indent=1))
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -407,7 +407,8 @@ def fmin_device(fn, space, max_evals, seed=0,
 # tests/test_fmin_device_mode.py for histories within one bucket).
 
 
-def _build_segment(cs, kern, eval_one, n_startup, gamma, prior_weight):
+def _build_segment(cs, kern, eval_one, n_startup, gamma, prior_weight,
+                   telemetry=False):
     """The per-segment scan: ``(seeds[s], hv, ha, hl, hok, i0) ->
     ((hv, ha, hl, hok, i), (rows[s,P], acts[s,P], losses[s]))``.
 
@@ -419,26 +420,75 @@ def _build_segment(cs, kern, eval_one, n_startup, gamma, prior_weight):
     (non-finite → ``ok=False``, ``loss=+inf``) so a resumed or
     mixed-stride run conditions on the same posterior; the raw loss goes
     out in the slab for the Trials doc.
+
+    With ``telemetry=True`` each scan step additionally emits its EI
+    stats as plain outputs, reduced VECTORIZED after the scan (still
+    inside the compiled segment) into a fixed-shape slab the segment
+    returns as a third output ``(best, ei_max, ei_sum, n_tpe,
+    n_nonfinite, n_ties, bsf[R])`` — the counters ``obs.devtel``
+    backfills at each sync boundary.  The slab is a pure PASSENGER: it
+    reads tensors the proposal/evaluate chain already computes (the
+    suggest routes through ``_suggest_one_tel`` in BOTH arms — disarmed
+    merely drops the stat outputs, so armed/disarmed trace the identical
+    proposal subgraph and sampled trials stay bit-identical; pinned by
+    the parity tests in tests/test_fmin_device_mode.py), and keeping the
+    reductions out of the loop body keeps the armed scan step within
+    noise of the disarmed one (the overhead A/B's acceptance bar).
+    ``bsf`` is the best-so-far loss after each trial, downsampled to
+    ``devtel.RESERVOIR`` slots via slot ``t*R//s`` (segments shorter
+    than R fill a prefix; the rest stay ``+inf``).
     """
     gamma_f = jnp.float32(gamma)
     pw_f = jnp.float32(prior_weight)
 
+    def _propose(key, hv, ha, hl, hok, n_ok):
+        """One suggest — startup or TPE — plus its passenger EI stats
+        (neutral ``(-inf, 0)`` in the startup arm so the ``lax.cond``
+        branch signatures match)."""
+
+        def startup(k):
+            sv, sa = cs.sample_traced(k, 1)
+            return sv[0], sa[0], jnp.float32(-jnp.inf), jnp.int32(0)
+
+        def tpe_step(k):
+            return kern._suggest_one_tel(k, hv, ha, hl, hok,
+                                         gamma_f, pw_f)
+
+        return jax.lax.cond(n_ok < n_startup, startup, tpe_step, key)
+
+    if not telemetry:
+        def segment(seeds, hv, ha, hl, hok, i0):
+            def body(carry, seed):
+                hv, ha, hl, hok, i = carry
+                key = prng_key(seed)
+                n_ok = jnp.sum(hok)
+                row, act, _eb, _et = _propose(key, hv, ha, hl, hok, n_ok)
+                loss = eval_one(row, act)
+                lok = jnp.isfinite(loss)
+                hv, ha, hl, hok = _insert_row(
+                    hv, ha, hl, hok, i, row, act,
+                    jnp.where(lok, loss, jnp.inf))
+                hok = jax.lax.dynamic_update_slice(
+                    hok, lok.reshape((1,)), (i,))
+                return (hv, ha, hl, hok, i + 1), (row, act, loss)
+
+            carry = (hv, ha, hl, hok, jnp.asarray(i0, jnp.int32))
+            carry, ys = jax.lax.scan(body, carry, seeds)
+            return carry, ys
+
+        return segment
+
+    from .obs.devtel import RESERVOIR
+
     def segment(seeds, hv, ha, hl, hok, i0):
+        s = int(seeds.shape[0])
+
         def body(carry, seed):
             hv, ha, hl, hok, i = carry
             key = prng_key(seed)
             n_ok = jnp.sum(hok)
-
-            def startup(k):
-                sv, sa = cs.sample_traced(k, 1)
-                return sv[0], sa[0]
-
-            def tpe_step(k):
-                return kern._suggest_one(k, hv, ha, hl, hok,
-                                         gamma_f, pw_f)
-
-            row, act = jax.lax.cond(n_ok < n_startup, startup, tpe_step,
-                                    key)
+            is_tpe = n_ok >= n_startup
+            row, act, ei_b, ties = _propose(key, hv, ha, hl, hok, n_ok)
             loss = eval_one(row, act)
             lok = jnp.isfinite(loss)
             hv, ha, hl, hok = _insert_row(
@@ -446,11 +496,38 @@ def _build_segment(cs, kern, eval_one, n_startup, gamma, prior_weight):
                 jnp.where(lok, loss, jnp.inf))
             hok = jax.lax.dynamic_update_slice(
                 hok, lok.reshape((1,)), (i,))
-            return (hv, ha, hl, hok, i + 1), (row, act, loss)
+            # The stats leave as plain per-step scan OUTPUTS (three
+            # stores); all slab reduction happens vectorized after the
+            # scan, keeping the armed loop body within noise of the
+            # disarmed one (the overhead A/B's stride-∞ bar).
+            return (hv, ha, hl, hok, i + 1), (row, act, loss,
+                                              ei_b, ties, is_tpe)
 
+        best0 = jnp.min(jnp.where(hok, hl, jnp.inf))        # run best
         carry = (hv, ha, hl, hok, jnp.asarray(i0, jnp.int32))
-        carry, ys = jax.lax.scan(body, carry, seeds)
-        return carry, ys
+        carry, (rows, acts, losses, ei_bs, ties_s, tpe_s) = \
+            jax.lax.scan(body, carry, seeds)
+
+        lok = jnp.isfinite(losses)
+        traj = jnp.minimum(jax.lax.cummin(
+            jnp.where(lok, losses, jnp.inf), axis=0), best0)
+        if s <= RESERVOIR:                 # short segment: prefix fill
+            bsf = jnp.concatenate(
+                [traj, jnp.full((RESERVOIR - s,), jnp.inf, jnp.float32)])
+        else:
+            # Slot t*R//s keeps the LAST step landing in each slot; the
+            # winning step per slot r is floor(((r+1)s - 1)/R) — static,
+            # so the downsample is one gather.
+            idx = ((np.arange(RESERVOIR) + 1) * s - 1) // RESERVOIR
+            bsf = traj[idx]
+        slab = (traj[-1],
+                jnp.max(ei_bs),            # startup steps emit -inf
+                jnp.sum(jnp.where(tpe_s, ei_bs, jnp.float32(0))),
+                jnp.sum(tpe_s.astype(jnp.int32)),
+                jnp.sum((~lok).astype(jnp.int32)),
+                jnp.sum(ties_s),
+                bsf)
+        return carry, (rows, acts, losses), slab
 
     return segment
 
@@ -480,12 +557,21 @@ def fmin_trials(fn, space, max_evals, trials, rstate, sync_stride=None,
     Returns ``trials`` (mutated in place).  Host round trips:
     ``ceil(n_new / sync_stride)`` slab fetches total, counted in the
     ``device.fetch_syncs`` counter — zero per-trial syncs at any stride.
+
+    Telemetry (``HYPEROPT_TPU_DEVICE_TELEMETRY``, default on): each
+    segment carries the ``obs.devtel`` slab, fetched in the SAME bulk
+    transfer and backfilled into events/metrics/costs/time-series at the
+    boundary; sampled trials are bit-identical armed vs. disarmed (the
+    slab is a passenger — see ``_build_segment``).
     """
+    from time import perf_counter as _perf
     from time import time as _time
 
     from . import dispatch as _dispatch
     from .base import JOB_STATE_DONE, STATUS_OK, coarse_utcnow
     from .base import docs_from_samples
+    from .obs import costs as _costs
+    from .obs import devtel as _devtel
     from .obs import metrics as _metrics
     from .utils.progress import default_callback, no_progress_callback
 
@@ -521,16 +607,24 @@ def fmin_trials(fn, space, max_evals, trials, rstate, sync_stride=None,
     cache = getattr(cs, "_device_fmin_cache", None)
     if cache is None:
         cache = cs._device_fmin_cache = OrderedDict()
+    # The telemetry toggle changes the traced program (slab carry +
+    # extra outputs), so it MUST key the run cache — flipping the env
+    # var can never serve a stale segment.
+    telemetry = _devtel.enabled()
+    stride_label = "inf" if sync_stride is None else str(sync_stride)
     base_key = ("seg", id(fn), n_cap, n_startup, float(gamma),
                 float(prior_weight), int(linear_forgetting),
                 int(n_EI_candidates), split, multivariate, kern.cat_prior,
                 kern.comp_sampler, kern.split_impl, kern.pallas,
                 kern.pallas_ei, kern.ei_precision, kern.ei_topm,
-                kern.fused_step, _pallas_tile(), mesh_k, prng_impl())
+                kern.fused_step, _pallas_tile(), mesh_k, prng_impl(),
+                telemetry)
     segment = _build_segment(cs, kern, eval_one, n_startup, gamma,
-                             prior_weight)
+                             prior_weight, telemetry=telemetry)
     reg = _metrics.registry()
     from .obs import EVENTS
+
+    fresh_strides: set = set()
 
     def seg_fn(s):
         key = base_key + (s,)
@@ -539,6 +633,7 @@ def fmin_trials(fn, space, max_evals, trials, rstate, sync_stride=None,
             reg.counter("device.run_cache.misses").inc()
             EVENTS.emit("compile", name="fmin_device_segment", stride=s,
                         max_evals=max_evals)
+            fresh_strides.add(s)
             run = cache[key] = jax.jit(segment)
             while len(cache) > _RUN_CACHE_CAP:
                 cache.popitem(last=False)
@@ -560,6 +655,7 @@ def fmin_trials(fn, space, max_evals, trials, rstate, sync_stride=None,
 
     early_stop_args: list = []
     i = n_prev
+    seg_index = 0
     progress_ctx = default_callback if show_progressbar \
         else no_progress_callback
     with progress_ctx(initial=n_prev, total=max_evals) as prog:
@@ -571,16 +667,35 @@ def fmin_trials(fn, space, max_evals, trials, rstate, sync_stride=None,
             seeds = np.asarray(
                 [rstate.integers(2 ** 31 - 1) for _ in range(s)],
                 np.uint32)
-            (hv, ha, hl, hok, _), (rows, acts, losses) = seg_fn(s)(
-                seeds, hv, ha, hl, hok, np.int32(i))
+            t0_mono = _perf()
+            out = seg_fn(s)(seeds, hv, ha, hl, hok, np.int32(i))
+            if telemetry:
+                (hv, ha, hl, hok, _), (rows, acts, losses), slab = out
+            else:
+                (hv, ha, hl, hok, _), (rows, acts, losses) = out
+                slab = None
             # ONE bulk fetch per segment — the only host sync at this
             # stride; bench.py verifies per-trial round trips are zero
-            # by diffing this counter.
+            # by diffing this counter.  The telemetry slab rides the same
+            # program output, so fetching it adds no sync boundary.
             rows_h = np.asarray(rows)
             acts_h = np.asarray(acts)
             losses_h = np.asarray(losses)
+            t1_mono = _perf()
             reg.counter("device.fetch_syncs").inc()
             reg.counter("device.segments").inc()
+            if telemetry:
+                _devtel.bump_labeled(reg, "solo", stride_label)
+                cost_key = ("device", "solo", s)
+                if s in fresh_strides:
+                    # First call of a fresh program: its wall time is
+                    # dominated by trace+compile — that's the ledger's
+                    # compile row (joined by key with the dispatch rows
+                    # of every later warm segment).
+                    fresh_strides.discard(s)
+                    _costs.record_compile(
+                        "device", cost_key, compile_s=t1_mono - t0_mono,
+                        n_cap=n_cap, P=cs.n_params, m=s)
 
             new_ids = trials.new_trial_ids(s)
             docs = docs_from_samples(cs, new_ids, rows_h, acts_h,
@@ -594,6 +709,16 @@ def fmin_trials(fn, space, max_evals, trials, rstate, sync_stride=None,
             trials.insert_trial_docs(docs)
             trials.refresh()
             reg.counter("device.trials_landed").inc(s)
+            if slab is not None:
+                # Sync-boundary backfill: the slab lands in every hosted
+                # obs layer with back-dated (synthetic-marked) stamps.
+                _devtel.backfill_segment(
+                    reg, mode="solo", stride=stride_label,
+                    slab_h=_devtel.slab_host(slab), n_trials=s,
+                    n_lanes=1, t0_mono=t0_mono, t1_mono=t1_mono,
+                    seg_index=seg_index, cost_key=("device", "solo", s),
+                    tids=new_ids, label=exp_key)
+            seg_index += 1
             i += s
             prog.update(s)
             fin = losses_h[np.isfinite(losses_h)]
@@ -628,6 +753,11 @@ def fmin_trials(fn, space, max_evals, trials, rstate, sync_stride=None,
                         break
                 except Exception:
                     pass
+    if telemetry:
+        # One O(n_docs) health pass per run, off the docs the segments
+        # just landed — stagnation / EI-collapse verdicts for device
+        # mode (per-segment series already backfilled above).
+        _devtel.finish_run(reg, trials, mode="solo", label=exp_key)
     return trials
 
 

@@ -51,6 +51,34 @@ __all__ = ["CohortScheduler", "fleet_report", "space_signature",
 #: Live schedulers, for the flight-bundle ``fleet`` section.
 _SCHEDULERS: "weakref.WeakSet" = weakref.WeakSet()
 
+#: Live fmin_fleet lane stacks (one handle per running call), for
+#: obs.device HBM accounting — the vmapped history buffers are plain
+#: arrays invisible to the resident-history walk.
+_LANE_STACKS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class _LaneStackHandle:
+    """Size marker for one live :func:`fmin_fleet` lane stack.
+
+    The fleet loop's ``hv/ha/hl/hok`` buffers (``[B, n_cap, P]`` etc.)
+    live as locals in the loop frame, so ``obs/device.py::report()``
+    cannot find them by walking ``history._STORE``.  The loop keeps one
+    of these alive for its duration; the WeakSet drops it when the run
+    returns, so ``lane_stacks`` goes back to zero without any explicit
+    release call."""
+
+    __slots__ = ("n_lanes", "n_cap", "p_dim", "__weakref__")
+
+    def __init__(self, n_lanes, n_cap, p_dim):
+        self.n_lanes = n_lanes
+        self.n_cap = n_cap
+        self.p_dim = p_dim
+
+    def nbytes(self) -> int:
+        # hv f32 + ha bool per [B, cap, P] cell; hl f32 + hok bool per
+        # [B, cap] cell.
+        return self.n_lanes * self.n_cap * (self.p_dim * 5 + 5)
+
 
 def fleet_report() -> dict:
     """Cohort-state snapshot for postmortem bundles: per scheduler, each
@@ -562,9 +590,16 @@ def fmin_fleet(fn, space, n_lanes, max_evals, seed=0, sync_stride=None,
                                 int(linear_forgetting), split,
                                 multivariate, cat_prior, mesh=None)
     eval_one = _device._wrap_objective(fn, cs)
+    # Same toggle/cache discipline as device.fmin_trials: the slab
+    # changes the traced program, so it keys the run cache; the vmap
+    # carries a per-lane slab twin at zero extra sync boundaries.
+    from .obs import devtel as _devtel
+
+    telemetry = _devtel.enabled()
+    stride_label = "inf" if sync_stride is None else str(sync_stride)
     segment = _device._build_segment(cs, kern, eval_one,
                                      int(n_startup_jobs), gamma,
-                                     prior_weight)
+                                     prior_weight, telemetry=telemetry)
 
     cache = getattr(cs, "_device_fmin_cache", None)
     if cache is None:
@@ -577,14 +612,16 @@ def fmin_fleet(fn, space, n_lanes, max_evals, seed=0, sync_stride=None,
                 kern.comp_sampler, kern.split_impl, kern.pallas,
                 kern.pallas_ei, kern.ei_precision, kern.ei_topm,
                 kern.fused_step, _pallas_tile(),
-                _device._mesh_key_of(mesh), prng_impl())
+                _device._mesh_key_of(mesh), prng_impl(), telemetry)
     reg = _registry()
+    fresh_strides: set = set()
 
     def seg_fn(s):
         key = base_key + (s,)
         run = cache.get(key)
         if run is None:
             reg.counter("device.run_cache.misses").inc()
+            fresh_strides.add(s)
             run = cache[key] = jax.jit(
                 jax.vmap(segment, in_axes=(0, 0, 0, 0, 0, None)))
             while len(cache) > _device._RUN_CACHE_CAP:
@@ -611,25 +648,57 @@ def fmin_fleet(fn, space, n_lanes, max_evals, seed=0, sync_stride=None,
                                                    PartitionSpec(*spec)))
 
         hv, ha, hl, hok = (_lane_sharded(a) for a in (hv, ha, hl, hok))
+    # Live lane-stack marker for obs.device HBM accounting; freed with
+    # this frame when the run returns.
+    _stack = _LaneStackHandle(n_lanes, n_cap, p_dim)
+    _LANE_STACKS.add(_stack)
     rstates = [np.random.default_rng(int(seed) + j) for j in range(n_lanes)]
 
     all_rows = []
     all_acts = []
     all_losses = []
+    slab_hs = []                         # per-segment lane-stacked slabs
     i = 0
+    seg_index = 0
     while i < max_evals:
         s = (max_evals - i if sync_stride is None
              else min(sync_stride, max_evals - i))
         seeds = np.asarray(
             [[r.integers(2 ** 31 - 1) for _ in range(s)] for r in rstates],
             np.uint32)
-        (hv, ha, hl, hok, _), (rows, acts, losses) = seg_fn(s)(
-            seeds, hv, ha, hl, hok, np.int32(i))
+        t0_mono = perf_counter()
+        out = seg_fn(s)(seeds, hv, ha, hl, hok, np.int32(i))
+        if telemetry:
+            (hv, ha, hl, hok, _), (rows, acts, losses), slab = out
+        else:
+            (hv, ha, hl, hok, _), (rows, acts, losses) = out
+            slab = None
         rows_h = np.asarray(rows)        # [B, s, P] — ONE fetch, all lanes
         acts_h = np.asarray(acts)
         losses_h = np.asarray(losses)
+        t1_mono = perf_counter()
         reg.counter("device.fetch_syncs").inc()
         reg.counter("device.segments").inc()
+        if slab is not None:
+            from .obs import costs as _costs
+
+            _devtel.bump_labeled(reg, "fleet", stride_label)
+            cost_key = ("device", "fleet", s, n_lanes)
+            if s in fresh_strides:
+                fresh_strides.discard(s)
+                _costs.record_compile(
+                    "device", cost_key, compile_s=t1_mono - t0_mono,
+                    n_cap=n_cap, P=p_dim, m=s, tier=n_lanes)
+            slab_h = _devtel.slab_host(slab)
+            slab_hs.append(slab_h)
+            # Fleet segments backfill the span + aggregates; per-trial
+            # anchors are a solo-mode feature (B×s instants per boundary
+            # would swamp the ring at fleet scale).
+            _devtel.backfill_segment(
+                reg, mode="fleet", stride=stride_label, slab_h=slab_h,
+                n_trials=s, n_lanes=n_lanes, t0_mono=t0_mono,
+                t1_mono=t1_mono, seg_index=seg_index, cost_key=cost_key)
+        seg_index += 1
         all_rows.append(rows_h)
         all_acts.append(acts_h)
         all_losses.append(losses_h)
@@ -660,7 +729,27 @@ def fmin_fleet(fn, space, n_lanes, max_evals, seed=0, sync_stride=None,
         bi = int(np.argmin(order))
         best = {p.label: cs._param_value(p, vals[j, bi, p.pid])
                 for p in cs.params if active[j, bi, p.pid]}
-        out.append({"best": best, "best_loss": float(losses[j, bi]),
-                    "best_index": bi, "losses": losses[j],
-                    "vals": vals[j], "active": active[j]})
+        info = {"best": best, "best_loss": float(losses[j, bi]),
+                "best_index": bi, "losses": losses[j],
+                "vals": vals[j], "active": active[j]}
+        if slab_hs:
+            # Per-lane telemetry twin, reduced across segments (min/max
+            # for levels, sums for counts; trajectory = final segment's
+            # reservoir — it already tracks run-level best-so-far).
+            n_tpe = sum(int(sh["tpe_steps"][j]) for sh in slab_hs)
+            ei_sum = sum(float(sh["ei_sum"][j]) for sh in slab_hs)
+            info["telemetry"] = {
+                "best_loss": min(float(sh["best_loss"][j])
+                                 for sh in slab_hs),
+                "ei_max": max(float(sh["ei_max"][j]) for sh in slab_hs),
+                "ei_mean": (ei_sum / n_tpe) if n_tpe else None,
+                "tpe_steps": n_tpe,
+                "nonfinite": sum(int(sh["nonfinite"][j])
+                                 for sh in slab_hs),
+                "argmax_ties": sum(int(sh["argmax_ties"][j])
+                                   for sh in slab_hs),
+                "best_trajectory": slab_hs[-1]["best_trajectory"][j],
+            }
+        out.append(info)
+    del _stack
     return out

@@ -572,7 +572,13 @@ def fmin(fn, space, algo=None, max_evals=None,
     TPE-family ``algo`` values compose (``tpe.suggest`` /
     ``suggest_quantile``, optionally ``partial``-bound); host-loop-only
     options (``points_to_evaluate``, ``pass_expr_memo_ctrl``, pipelining,
-    retries, ``trials_save_file``) raise.  See docs/API.md "fmin modes".
+    retries, ``trials_save_file``) raise.  Device runs stay observable
+    through the in-carry telemetry slab (``HYPEROPT_TPU_DEVICE_TELEMETRY``,
+    default on): per-segment best-so-far / EI levels / anomaly counts are
+    backfilled into events, metrics, health, costs and flight bundles at
+    every sync boundary without perturbing sampled trials — see
+    ``obs/devtel.py`` and docs/OBSERVABILITY.md "Device mode".  See
+    docs/API.md "fmin modes".
     """
     if mode not in (None, "host", "device"):
         raise ValueError(f"mode must be None, 'host' or 'device', "

@@ -69,6 +69,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .obs.events import EVENTS
 from .obs.metrics import registry as _registry
 
 __all__ = ["enabled", "device_history", "pregrow", "forget", "generation",
@@ -423,6 +424,11 @@ def _check_tid_order(st, cs, h, p, reg):
     if all(b > a for a, b in zip(idxs, idxs[1:])):
         return      # still a subsequence (mid-insert): legitimate rebuild
     reg.counter("history.order_violations").inc()
+    # Typed record alongside the counter: order violations are exactly
+    # the corruption postmortems are opened for, so the event must be in
+    # the ring when a flight bundle snapshots it.
+    EVENTS.emit("history_order_violation", name="resident_ring",
+                n_resident=int(st.n), positions=idxs[:8])
     raise HistoryOrderError(
         f"resident history rows appended out of tid order: the trials "
         f"log still contains all {st.n} resident tids but permuted them "

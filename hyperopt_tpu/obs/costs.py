@@ -64,6 +64,9 @@ _FAMILY_SERIES = {
               "suggest.fetch_sync_ms"),
     "gp": ("suggest.upload_ms", "backend.gp.dispatch_ms"),
     "es": ("suggest.upload_ms", "backend.es.dispatch_ms"),
+    # Device-loop segments: one dispatch == one compiled scan segment
+    # (obs.devtel backfills the histogram at each sync boundary).
+    "device": ("device.telemetry.segment_ms",),
 }
 
 

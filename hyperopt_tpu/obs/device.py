@@ -49,6 +49,17 @@ def _ring_bytes():
         for st in list(_hist._BATCHED):
             stacks += 1
             stack_b += int(st.b) * int(st.cap) * _hist._row_bytes(int(st.p))
+    # fmin_fleet's whole-loop lane stacks are plain arrays in the loop
+    # frame, not BatchedResident entries — counted via the live handles
+    # the loop registers.  sys.modules guard: a process that never
+    # imported fleet has no stacks, and report() must not drag the
+    # kernel stack in just to say so.
+    import sys
+    _fleet = sys.modules.get("hyperopt_tpu.fleet")
+    if _fleet is not None:
+        for h in list(_fleet._LANE_STACKS):
+            stacks += 1
+            stack_b += int(h.nbytes())
     return rings, ring_b, stacks, stack_b
 
 

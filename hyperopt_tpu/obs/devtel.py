@@ -1,0 +1,269 @@
+"""Device-loop telemetry: sync-boundary backfill into the obs stack.
+
+The device-resident loop (``fmin(mode="device")``, ``fleet.fmin_fleet``)
+runs suggest → evaluate → record inside compiled ``lax.scan`` segments,
+so between sync boundaries every obs layer is blind — at
+``sync_stride=None`` a whole run lands as one opaque fetch.  ISSUE 17
+closes that hole with a **telemetry slab**: a small fixed-shape struct
+of per-trial aggregates computed inside the compiled segment as a pure
+passenger — per-step scan outputs, reduced vectorized after the scan so
+the loop body pays three stores, not a carried reduction
+(``device._build_segment``):
+
+* best-so-far loss trajectory, downsampled into a ``RESERVOIR``-slot
+  ring (slot ``t * R // s`` for segment step ``t`` of ``s``),
+* per-segment EI max / mean over TPE steps (winning-score surrogate,
+  log density-ratio units — comparable within one run only),
+* non-finite-loss count and candidate-argmax tie count
+  (``ops/step_ei.py::ei_argmax_stats`` — the flat-acquisition signal),
+* per-lane twins under ``fmin_fleet`` (the slab vmaps with the segment).
+
+The slab rides the SAME bulk fetch as the trial slab — zero extra sync
+boundaries (``device.fetch_syncs`` deltas are pinned by tests) — and
+this module **backfills** it into the hosted layers as if the trials had
+run hosted:
+
+* ``obs.events`` — a back-dated ``device_segment`` span plus synthetic
+  per-trial ``trial_end`` anchors spread uniformly across the measured
+  segment wall window, every record marked ``synthetic=True`` (solo mode
+  only; fleet segments emit the span but not B×s per-trial anchors), so
+  ``hyperopt-tpu-show trace`` / ``--merge`` Perfetto lanes stay coherent;
+* ``obs.metrics`` — ``device.fetch_syncs.<mode>.<stride>`` /
+  ``device.segments.<mode>.<stride>`` labeled twins of the unlabeled
+  counters (LRU-bounded like every dynamic-label family) plus the slab
+  gauges/counters/histograms under ``device.telemetry.*``;
+* the time-series store — when a store is registered via
+  :func:`set_backfill_store`, each boundary scrapes it at the segment's
+  end wall time, so per-segment rows (and therefore SLO burn rates)
+  exist for device-mode runs;
+* ``obs.health`` — the run's landed docs are assessed at the final
+  boundary and published as ``health.verdict.device:<label>``;
+* ``obs.costs`` — per-segment dispatch wall times via
+  ``observe_dispatch`` (compile rows recorded by the loop on run-cache
+  misses) under the ``device`` family;
+* flight-recorder bundles — the latest slab per run is served by the
+  ``device_telemetry`` bundle provider.
+
+Armed vs. disarmed is **bit-identical** in sampled trials: the slab only
+consumes tensors the proposal math already computes
+(``tpe._TpeKernel._suggest_one_tel``), never feeds them, and the toggle
+(``HYPEROPT_TPU_DEVICE_TELEMETRY``, default on) is keyed into the
+segment run caches so flipping it can never serve a stale program.
+Everything in this module is host-side, boundary-rate work — nothing
+here touches the traced programs.
+"""
+
+from __future__ import annotations
+
+import os
+import weakref
+from collections import OrderedDict
+from threading import Lock
+
+import numpy as np
+
+from . import bundle as _bundle
+from . import costs as _costs
+from . import health as _health
+from . import metrics as _metrics
+from .events import EVENTS
+
+__all__ = ["RESERVOIR", "enabled", "bump_labeled", "slab_host",
+           "backfill_segment", "finish_run", "set_backfill_store",
+           "backfill_store", "report"]
+
+#: Slots in the best-so-far trajectory ring carried through each segment.
+RESERVOIR = 32
+
+
+def enabled() -> bool:
+    """``HYPEROPT_TPU_DEVICE_TELEMETRY`` — default ON; the device loop
+    reads this once per run and keys it into its compiled-segment cache."""
+    return os.environ.get("HYPEROPT_TPU_DEVICE_TELEMETRY", "1").lower() \
+        not in ("0", "off", "false")
+
+
+# Labeled-series bookkeeping: <mode>.<stride> labels are caller inputs,
+# so the live set is LRU-bounded exactly like health.verdict.<store>.
+_LABELS = _metrics.LabelLru()
+
+# Latest slab per (mode, label) for the flight-bundle provider; bounded
+# because labels are caller-controlled.
+_LAST_CAP = 8
+_LAST: "OrderedDict" = OrderedDict()
+_LAST_LOCK = Lock()
+_PROVIDER_REGISTERED = False
+
+#: Optional weakref to a TimeSeriesStore scraped at each sync boundary.
+_STORE_REF = None
+
+
+def set_backfill_store(store) -> None:
+    """Register ``store`` (a :class:`~hyperopt_tpu.obs.timeseries.
+    TimeSeriesStore`, or ``None`` to clear) to receive one scrape per
+    sync boundary, timestamped at the segment's END wall time — the
+    back-dated per-segment rows health/SLO evaluation reads.  Held by
+    weakref: a store owned by a server scrape loop dies with it."""
+    global _STORE_REF
+    _STORE_REF = None if store is None else weakref.ref(store)
+
+
+def backfill_store():
+    return _STORE_REF() if _STORE_REF is not None else None
+
+
+def bump_labeled(reg, mode: str, stride: str) -> None:
+    """Bump the ``<mode>.<stride>``-labeled twins of the unlabeled
+    ``device.fetch_syncs`` / ``device.segments`` counters (which keep
+    their exact semantics — tests pin their deltas)."""
+    label = f"{mode}.{stride}"
+    for old in _LABELS.touch(label):
+        reg.remove(f"device.fetch_syncs.{old}")
+        reg.remove(f"device.segments.{old}")
+    reg.counter(f"device.fetch_syncs.{label}").inc()
+    reg.counter(f"device.segments.{label}").inc()
+
+
+def slab_host(slab) -> dict:
+    """Fetch a device slab tuple to host scalars/arrays.
+
+    ``slab`` is ``(best, ei_max, ei_sum, n_tpe, n_nonfinite, n_ties,
+    bsf[R])`` — scalars per segment, or lane-stacked ``[B]``/``[B, R]``
+    under ``fmin_fleet``.  Rides the same device→host sync as the trial
+    slab (the program already completed; no extra dispatch).
+    """
+    best, ei_max, ei_sum, n_tpe, n_bad, n_ties, bsf = (
+        np.asarray(x) for x in slab)
+    return {"best_loss": best, "ei_max": ei_max, "ei_sum": ei_sum,
+            "tpe_steps": n_tpe, "nonfinite": n_bad,
+            "argmax_ties": n_ties, "best_trajectory": bsf}
+
+
+def _emit_backdated(etype, mono, **fields):
+    """Emit one event with an explicit back-dated timestamp pair derived
+    from the log's own wall/mono anchor (so ordering vs live events stays
+    consistent); every synthesized record carries ``synthetic=True``."""
+    wall = EVENTS._wall0 + (mono - EVENTS._mono0)
+    return EVENTS.emit(etype, t_mono=mono, t_wall=wall, synthetic=True,
+                       **fields)
+
+
+def _aggregate(h: dict) -> dict:
+    """Collapse a (possibly lane-stacked) host slab to run-level scalars:
+    best = min over lanes, ei_max = max, counts summed, ei mean over all
+    TPE steps pooled across lanes."""
+    n_tpe = int(h["tpe_steps"].sum())
+    ei_sum = float(h["ei_sum"].sum())
+    return {
+        "best_loss": float(h["best_loss"].min()),
+        "ei_max": float(h["ei_max"].max()),
+        "ei_mean": (ei_sum / n_tpe) if n_tpe else None,
+        "tpe_steps": n_tpe,
+        "nonfinite": int(h["nonfinite"].sum()),
+        "argmax_ties": int(h["argmax_ties"].sum()),
+    }
+
+
+def backfill_segment(reg, *, mode: str, stride: str, slab_h: dict,
+                     n_trials: int, n_lanes: int, t0_mono: float,
+                     t1_mono: float, seg_index: int, cost_key=None,
+                     tids=None, label=None) -> dict:
+    """Backfill ONE segment's slab into events / metrics / costs / the
+    time-series store.  ``t0_mono``/``t1_mono`` bracket the segment's
+    host wall window (dispatch → fetch landed); ``tids`` (solo mode)
+    are the landed trial ids for the synthetic per-trial anchors.
+    Returns the aggregated slab summary (also cached for bundles).
+    """
+    agg = _aggregate(slab_h)
+    dur = max(t1_mono - t0_mono, 0.0)
+    total = n_trials * max(n_lanes, 1)
+
+    # -- metrics: slab gauges + counters + the per-segment histogram -----
+    if np.isfinite(agg["best_loss"]):
+        reg.gauge("device.telemetry.best_loss").set(agg["best_loss"])
+    if np.isfinite(agg["ei_max"]):
+        reg.gauge("device.telemetry.ei_max").set(agg["ei_max"])
+    if agg["ei_mean"] is not None and np.isfinite(agg["ei_mean"]):
+        reg.gauge("device.telemetry.ei_mean").set(agg["ei_mean"])
+    if agg["nonfinite"]:
+        reg.counter("device.telemetry.nonfinite").inc(agg["nonfinite"])
+    if agg["argmax_ties"]:
+        reg.counter("device.telemetry.argmax_ties").inc(
+            agg["argmax_ties"])
+    reg.histogram("device.telemetry.segment_ms").observe(dur * 1e3)
+    if dur > 0:
+        reg.gauge("device.telemetry.trials_per_sec").set(total / dur)
+
+    # -- events: back-dated segment span + synthetic trial anchors -------
+    if EVENTS.enabled:
+        sid = next(EVENTS._span_ids)
+        _emit_backdated("span_begin", t0_mono, name="device_segment",
+                        span=sid, parent=None, mode=mode, stride=stride,
+                        seg=seg_index, n_trials=n_trials,
+                        n_lanes=n_lanes)
+        if tids is not None and n_trials:
+            # Uniform spread across the measured window: the host cannot
+            # know per-trial device timing, only the bulk boundary — the
+            # "synthetic" mark is the honesty bit readers filter on.
+            step = dur / n_trials
+            for k, tid in enumerate(tids):
+                _emit_backdated("trial_end", t0_mono + (k + 0.5) * step,
+                                name="device_trial", trial=int(tid),
+                                span=sid, mode=mode, seg=seg_index)
+        _emit_backdated("span_end", t1_mono, name="device_segment",
+                        span=sid, parent=None)
+
+    # -- costs: per-segment dispatch row under the device family --------
+    if cost_key is not None:
+        _costs.observe_dispatch(cost_key, dur * 1e3)
+
+    # -- time-series: one back-dated scrape per boundary -----------------
+    store = backfill_store()
+    if store is not None:
+        t1_wall = EVENTS._wall0 + (t1_mono - EVENTS._mono0)
+        store.scrape(now=t1_wall)
+
+    # -- bundle cache -----------------------------------------------------
+    global _PROVIDER_REGISTERED
+    summary = dict(agg)
+    summary.update({
+        "mode": mode, "stride": stride, "seg": seg_index,
+        "n_trials": n_trials, "n_lanes": n_lanes,
+        "segment_s": dur,
+        "best_trajectory": np.round(
+            np.ravel(slab_h["best_trajectory"])[:RESERVOIR].astype(
+                np.float64), 6).tolist(),
+    })
+    with _LAST_LOCK:
+        key = (mode, label or mode)
+        _LAST.pop(key, None)
+        _LAST[key] = summary
+        while len(_LAST) > _LAST_CAP:
+            _LAST.popitem(last=False)
+        if not _PROVIDER_REGISTERED:
+            _bundle.register_provider("device_telemetry", report)
+            _PROVIDER_REGISTERED = True
+    return summary
+
+
+def finish_run(reg, trials, *, mode: str, label=None) -> dict | None:
+    """Run-end health pass over the landed docs (which the slab fetches
+    just backfilled): one ``health.assess`` + publish under
+    ``device:<label>``.  Boundary-rate work happens per segment; the
+    O(n_docs) assessment runs once per run, here."""
+    try:
+        docs = list(trials.trials)
+    except Exception:
+        return None
+    if not docs:
+        return None
+    rep = _health.assess(docs)
+    _health.publish(f"device:{label or mode}", rep, reg)
+    return rep
+
+
+def report() -> dict:
+    """Flight-bundle section: the latest slab summary per live run."""
+    with _LAST_LOCK:
+        runs = [dict(v) for v in _LAST.values()]
+    return {"enabled": enabled(), "reservoir": RESERVOIR, "runs": runs}

@@ -84,6 +84,7 @@ EVENT_TYPES = frozenset(
         "rpc",
         "slo_alert",
         "flight_dump",
+        "history_order_violation",
     }
 )
 

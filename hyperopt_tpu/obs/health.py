@@ -64,17 +64,17 @@ def _finite_losses(docs):
 def _dup_rate(docs, window):
     """Duplicate fraction among the last ``window`` suggested points
     (rounded param fingerprints from ``misc.vals``)."""
+    tail = sorted(docs, key=lambda d: d.get("tid", 0))[-window:]
+    if len(tail) < 2:
+        return None
     prints = []
-    for d in sorted(docs, key=lambda d: d.get("tid", 0)):
+    for d in tail:
         vals = ((d.get("misc") or {}).get("vals") or {})
         fp = tuple(sorted(
             (k, round(float(v[0]), 9) if v else None)
             for k, v in vals.items()))
         prints.append(fp)
-    tail = prints[-window:]
-    if len(tail) < 2:
-        return None
-    return 1.0 - len(set(tail)) / len(tail)
+    return 1.0 - len(set(prints)) / len(prints)
 
 
 def unwrap(fn):

@@ -39,6 +39,37 @@ import jax.numpy as jnp
 from .parzen import fit_parzen
 
 
+def ei_argmax_stats(scores):
+    """Per-row argmax of an EI/score sheet plus pure-passenger stats.
+
+    ``scores`` is ``[rows, n_cand]`` (one row per column group / cat
+    dimension, or ``[n_cand]`` for the multivariate joint total).
+    Returns ``(bi, best, ties)``:
+
+    * ``bi``   — ``jnp.argmax(scores, axis=-1)``, the EXACT winner index
+      the un-instrumented step computes (``tpe._TpeKernel._cont_best`` /
+      ``_cat_best``); telemetry reads it, never replaces it.
+    * ``best`` — the winning score per row (gathered at ``bi``).
+    * ``ties`` — per-row count of candidates that TIE the winner
+      (``scores == best``, minus the winner itself).  A high tie count
+      means the acquisition sheet is flat — the device-loop analog of
+      the health layer's EI-collapse signal.
+
+    Consumers only: both reductions read the same ``scores`` tensor the
+    argmax consumes, so arming telemetry cannot perturb candidate math —
+    and because the FUSED step (``fused_parzen_fit``) and the unfused
+    two-sweep path both feed this same sheet downstream of
+    ``_cont_scores``, the stats are path-invariant by construction
+    (pinned by the armed/disarmed parity tests under
+    ``HYPEROPT_TPU_FUSED_STEP`` both ways).
+    """
+    bi = jnp.argmax(scores, axis=-1)
+    best = jnp.take_along_axis(scores, bi[..., None], axis=-1)[..., 0]
+    ties = (jnp.sum(scores == best[..., None], axis=-1) - 1).astype(
+        jnp.int32)
+    return bi, best, ties
+
+
 def fused_parzen_fit(x_b, w_b, n_b, x_a, w_a, n_a, prior_mu, prior_sigma,
                      prior_weight, cap_b, cap_a):
     """Fit below AND above Parzen mixtures in one vmapped sweep.

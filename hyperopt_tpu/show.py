@@ -373,6 +373,46 @@ def render_live(snap: dict, out=None, prev=None) -> dict:
               f"padding {waste:.0%}   dispatches {int(disp)}   "
               f"suggestions {int(counters.get('fleet.suggestions', 0))}",
               file=out)
+    # DEVICE: the device-resident loop's sync-boundary view — segment /
+    # fetch totals split by (mode, stride) label, plus the in-carry
+    # telemetry slab's latest levels (obs.devtel backfill).
+    segs = counters.get("device.segments", 0)
+    if segs:
+        print(f"device:  segments {int(segs)}   fetches "
+              f"{int(counters.get('device.fetch_syncs', 0))}   landed "
+              f"{int(counters.get('device.trials_landed', 0))}", file=out)
+        labeled = {}
+        for k, v in counters.items():
+            if k.startswith("device.segments."):
+                labeled.setdefault(k[len("device.segments."):],
+                                   [0, 0])[0] += v
+            elif k.startswith("device.fetch_syncs."):
+                labeled.setdefault(k[len("device.fetch_syncs."):],
+                                   [0, 0])[1] += v
+        if labeled:
+            print(f"  {'mode.stride':<16s} {'segments':>9s} "
+                  f"{'fetches':>8s}", file=out)
+            for lab in sorted(labeled):
+                sN, fN = labeled[lab]
+                print(f"  {lab:<16s} {int(sN):>9d} {int(fN):>8d}",
+                      file=out)
+        tel_best = gauges.get("device.telemetry.best_loss",
+                              m_gauges.get("device.telemetry.best_loss"))
+        if tel_best is not None:
+            ei_mx = gauges.get("device.telemetry.ei_max",
+                               m_gauges.get("device.telemetry.ei_max"))
+            ei_mn = gauges.get("device.telemetry.ei_mean",
+                               m_gauges.get("device.telemetry.ei_mean"))
+            tps = gauges.get(
+                "device.telemetry.trials_per_sec",
+                m_gauges.get("device.telemetry.trials_per_sec"))
+            fmt = lambda v: "-" if v is None else f"{v:.4g}"  # noqa: E731
+            print(f"  slab: best {fmt(tel_best)}   ei max {fmt(ei_mx)} "
+                  f"mean {fmt(ei_mn)}   {fmt(tps)} trials/s   nonfinite "
+                  f"{int(counters.get('device.telemetry.nonfinite', 0))}"
+                  f"   ties "
+                  f"{int(counters.get('device.telemetry.argmax_ties', 0))}",
+                  file=out)
     faults = counters.get("faults.injected", 0)
     requeued = counters.get("store.requeued", 0)
     fenced = (counters.get("store.write.fenced", 0)

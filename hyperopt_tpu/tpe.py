@@ -791,25 +791,67 @@ class _TpeKernel:
     # -- the step ------------------------------------------------------------
 
     def _suggest_one(self, key, vals, active, loss, ok, gamma, prior_weight):
+        row, act_row, _ei_best, _ei_ties = self._suggest_one_tel(
+            key, vals, active, loss, ok, gamma, prior_weight)
+        return row, act_row
+
+    def _suggest_one_tel(self, key, vals, active, loss, ok, gamma,
+                         prior_weight):
+        """The step, instrumented: ``(row, act, ei_best, ei_ties)``.
+
+        This is the ONE implementation of the per-trial proposal;
+        :meth:`_suggest_one` delegates here and drops the last two
+        outputs, so the armed (device-telemetry) and disarmed programs
+        share a single traced proposal subgraph by construction — XLA
+        dead-code-eliminates the unused reductions when the caller
+        discards them, and arming can never perturb RNG or candidate
+        math (the bit-parity contract of ISSUE 17).
+
+        The stats are pure passengers over the same score sheets the
+        argmax consumes (``ops/step_ei.py::ei_argmax_stats``):
+        ``ei_best`` is the winning EI-surrogate score (max across column
+        groups and the categorical sheet — log density-ratio units, so
+        only comparable within one space), ``ei_ties`` counts candidates
+        tying their sheet's winner (a flat-acquisition signal).
+        """
+        from .ops.step_ei import ei_argmax_stats
+
         below, above = self._split(loss, ok, gamma)
         k_cat, *k_cont = jax.random.split(key, 1 + len(self.groups))
         if self.multivariate:
-            return self._suggest_one_joint(k_cat, k_cont, vals, active,
-                                           below, above, prior_weight)
+            return self._suggest_one_joint_tel(k_cat, k_cont, vals, active,
+                                               below, above, prior_weight)
         row = jnp.zeros((self.cs.n_params,), jnp.float32)
+        ei_best = jnp.float32(-jnp.inf)
+        ei_ties = jnp.int32(0)
         for g, kg in zip(self.groups, k_cont):
+            v, ei = self._cont_scores(g, kg, vals, active, below, above,
+                                      prior_weight)
+            bi, best, ties = ei_argmax_stats(ei)
+            # Same gather _cont_best performs off the same argmax index.
             row = row.at[jnp.asarray(g.pids)].set(
-                self._cont_best(g, kg, vals, active, below, above,
-                                prior_weight))
+                v[jnp.arange(len(g)), bi])
+            ei_best = jnp.maximum(ei_best, jnp.max(best))
+            ei_ties = ei_ties + jnp.sum(ties)
         if len(self.cat_pids):
+            cv, score = self._cat_scores(k_cat, vals, active, below, above,
+                                         prior_weight)
+            bi, best, ties = ei_argmax_stats(score)
             row = row.at[jnp.asarray(self.cat_pids)].set(
-                self._cat_best(k_cat, vals, active, below, above,
-                               prior_weight))
+                cv[jnp.arange(len(self.cat_pids)), bi])
+            ei_best = jnp.maximum(ei_best, jnp.max(best))
+            ei_ties = ei_ties + jnp.sum(ties)
         act_row = self.cs.active_mask(row[None, :])[0]
-        return row, act_row
+        return row, act_row, ei_best, ei_ties
 
     def _suggest_one_joint(self, k_cat, k_cont, vals, active, below, above,
                            prior_weight):
+        row, act_row, _ei_best, _ei_ties = self._suggest_one_joint_tel(
+            k_cat, k_cont, vals, active, below, above, prior_weight)
+        return row, act_row
+
+    def _suggest_one_joint_tel(self, k_cat, k_cont, vals, active, below,
+                               above, prior_weight):
         """Multivariate winner: score whole candidate VECTORS.
 
         The reference's ``broadcast_best`` arg-maxes every hyperparameter
@@ -836,8 +878,13 @@ class _TpeKernel:
             ei_cols = ei_cols.at[:, jnp.asarray(self.cat_pids)].set(score.T)
         act = self.cs.active_mask(cand)                    # [n_cand, P]
         total = jnp.sum(jnp.where(act, ei_cols, 0.0), axis=1)
-        bi = jnp.argmax(total)
-        return cand[bi], act[bi]
+        # Same argmax as before, read through the shared stats helper so
+        # the telemetry outputs (winning joint score, tie count) are
+        # guaranteed consumers of the identical total vector.
+        from .ops.step_ei import ei_argmax_stats
+
+        bi, ei_best, ei_ties = ei_argmax_stats(total)
+        return cand[bi], act[bi], ei_best, ei_ties
 
     def __call__(self, key, vals, active, loss, ok, gamma, prior_weight):
         return self._fn(key, vals, active, loss, ok,

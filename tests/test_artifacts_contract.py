@@ -222,6 +222,41 @@ class TestBenchmarkArtifacts:
                 f"{name}: fused step kernel changed the proposals")
             assert {"fused", "unfused"} <= set(doc["fused_ab"]), name
 
+    def test_device_telemetry_ab_artifact_schema(self):
+        """ISSUE 17 acceptance artifact: armed vs disarmed device-loop
+        telemetry trials/s at sync_stride 1/8/∞ with per-row bit-parity
+        and the ≤5%-overhead-at-stride-∞ headline — written by
+        benchmarks/device_telemetry_ab.py."""
+        paths = sorted(glob.glob(os.path.join(
+            _BENCH_DIR, "device_telemetry_ab_*.json")))
+        assert paths, \
+            "no benchmarks/device_telemetry_ab_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == \
+                "device_telemetry_overhead_armed_vs_disarmed", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            strides = [r["sync_stride"] for r in doc["rows"]]
+            assert strides == ["1", "8", "inf"], f"{name}: {strides}"
+            for r in doc["rows"]:
+                assert {"armed_trials_per_sec", "disarmed_trials_per_sec",
+                        "overhead_pct",
+                        "parity_bit_identical"} <= set(r), f"{name}: {r}"
+                assert r["armed_trials_per_sec"] > 0, f"{name}: {r}"
+                assert r["disarmed_trials_per_sec"] > 0, f"{name}: {r}"
+                assert r["parity_bit_identical"] is True, (
+                    f"{name}: arming the telemetry slab changed the "
+                    f"sampled trials at stride {r['sync_stride']}")
+            head = doc["headline"]
+            assert head["within_5pct_at_stride_inf"] is True, (
+                f"{name}: telemetry costs "
+                f"{head['overhead_pct_at_stride_inf']}% at stride ∞ — "
+                "over the 5% acceptance bar")
+            assert head["parity_all_rows"] is True, name
+
     def test_multichip_artifact_schema(self):
         """PR 15 acceptance artifact: the dispatch substrate's sharded
         suggest at fixed total work over 1/2/4/8-device meshes — per-row

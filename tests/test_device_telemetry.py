@@ -1,0 +1,289 @@
+"""Device-loop telemetry backfill (ISSUE 17, ``obs/devtel.py``).
+
+The armed/disarmed **bit-parity** of the in-carry slab is pinned next to
+the device loop itself (tests/test_fmin_device_mode.py); this file pins
+the OTHER half of the contract — that an armed run really lands in every
+hosted obs layer at sync-boundary granularity:
+
+* labeled ``device.segments.<mode>.<stride>`` /
+  ``device.fetch_syncs.<mode>.<stride>`` twins (the unlabeled counters
+  keep their exact pinned semantics);
+* ``device.telemetry.*`` slab gauges/counters and the per-segment
+  ``segment_ms`` histogram;
+* back-dated ``device_segment`` spans + synthetic per-trial anchors in
+  the event ring, all marked ``synthetic=True`` and Perfetto-renderable;
+* per-segment scrapes into a registered ``TimeSeriesStore``;
+* compile + dispatch rows in the cost ledger's ``device`` family;
+* the run-end ``health.verdict.device:<label>`` gauge;
+* the ``device_telemetry`` flight-bundle section.
+
+Plus the satellites: ``fleet._LANE_STACKS`` HBM accounting visible
+mid-run and freed after (``obs/device.py``), the per-lane ``telemetry``
+dict in ``fmin_fleet`` info results, the typed
+``history_order_violation`` event, and the disarmed path being a strict
+metrics/events no-op.
+"""
+
+from functools import partial
+
+import numpy as np
+import pytest
+
+import hyperopt_tpu as ho
+from hyperopt_tpu import fleet, hp, tpe
+from hyperopt_tpu import history as rhist
+from hyperopt_tpu.obs import bundle, costs, devtel
+from hyperopt_tpu.obs import device as obs_device
+from hyperopt_tpu.obs.events import EVENTS
+from hyperopt_tpu.obs.metrics import registry
+from hyperopt_tpu.obs.timeseries import TimeSeriesStore
+
+@pytest.fixture(autouse=True)
+def _event_ring_hygiene():
+    """These tests enable the global event ring and fill it with
+    synthetic backfill records; leave it the way we found it so
+    exact-count assertions elsewhere (e.g. the trace-dir artifact
+    test) don't inherit our leftovers."""
+    was_enabled = EVENTS.enabled
+    yield
+    if not was_enabled:
+        EVENTS.disable()
+    EVENTS.clear()
+
+
+SPACE = {"x": hp.uniform("x", -5, 5)}
+
+
+def dev_obj(p):
+    return (p["x"] - 3.0) ** 2
+
+
+N = 16
+# Startup count below the stride so segments contain real TPE steps —
+# the EI stats stay (-inf, 0) through an all-startup segment.
+ALGO = partial(tpe.suggest, n_startup_jobs=5)
+
+
+def _snap():
+    return registry().snapshot()
+
+
+def _counter(name):
+    return _snap()["counters"].get(name, 0.0)
+
+
+def _gauge(name):
+    return _snap()["gauges"].get(name)
+
+
+def _hist_count(name):
+    return _snap()["histograms"].get(name, {}).get("count", 0)
+
+
+def _run(seed, stride, n=N, **kw):
+    t = ho.Trials()
+    ho.fmin(dev_obj, SPACE, algo=ALGO, max_evals=n, trials=t,
+            rstate=np.random.default_rng(seed), show_progressbar=False,
+            mode="device", sync_stride=stride, **kw)
+    return t
+
+
+def _device_events():
+    return [e for e in EVENTS.snapshot()
+            if e.get("name") in ("device_segment", "device_trial")]
+
+
+# ---------------------------------------------------------------------------
+# solo run: one armed run must reach every layer
+# ---------------------------------------------------------------------------
+
+
+def test_solo_backfill_reaches_every_layer(monkeypatch):
+    monkeypatch.setattr(costs, "_armed", True)
+    EVENTS.enable()
+    reg = registry()
+    ts = TimeSeriesStore(reg)
+    devtel.set_backfill_store(ts)
+    stride, n_segs = 4, N // 4
+    seg0 = _counter(f"device.segments.solo.{stride}")
+    fs0 = _counter(f"device.fetch_syncs.solo.{stride}")
+    h0 = _hist_count("device.telemetry.segment_ms")
+    ev0 = len(_device_events())
+    try:
+        t = _run(seed=21, stride=stride)
+    finally:
+        devtel.set_backfill_store(None)
+
+    # -- labeled counter twins, one bump per boundary --------------------
+    assert _counter(f"device.segments.solo.{stride}") - seg0 == n_segs
+    assert _counter(f"device.fetch_syncs.solo.{stride}") - fs0 == n_segs
+
+    # -- slab gauges + histogram ----------------------------------------
+    best = _gauge("device.telemetry.best_loss")
+    assert best is not None and np.isfinite(best)
+    assert best == pytest.approx(
+        min(float(d["result"]["loss"]) for d in t._dynamic_trials))
+    assert np.isfinite(_gauge("device.telemetry.ei_max"))
+    assert np.isfinite(_gauge("device.telemetry.ei_mean"))
+    assert _gauge("device.telemetry.trials_per_sec") > 0
+    assert _hist_count("device.telemetry.segment_ms") - h0 == n_segs
+
+    # -- events: back-dated spans + per-trial anchors, all synthetic -----
+    evs = _device_events()[ev0:]
+    spans = [e for e in evs if e["type"] == "span_begin"
+             and e["name"] == "device_segment"]
+    anchors = [e for e in evs if e["type"] == "trial_end"]
+    assert len(spans) == n_segs
+    assert len(anchors) == N
+    assert all(e.get("synthetic") is True for e in evs)
+    assert all(e["mode"] == "solo" and e["stride"] == str(stride)
+               for e in spans)
+    landed_tids = {d["tid"] for d in t._dynamic_trials}
+    assert {e["trial"] for e in anchors} == landed_tids
+    # anchors stay inside their segment's measured wall window and the
+    # whole synthetic block renders as Perfetto complete-events
+    for e in anchors:
+        assert e["t_mono"] > 0
+    chrome = EVENTS.to_chrome_trace()["traceEvents"]
+    xs = [e for e in chrome
+          if e.get("ph") == "X" and e.get("name") == "device_segment"]
+    assert len(xs) >= n_segs
+    assert all(e["dur"] > 0 for e in xs)
+
+    # -- time-series: one back-dated scrape per boundary -----------------
+    assert ts.n_scrapes == n_segs
+
+    # -- costs: compile row on the fresh stride + per-segment dispatches -
+    led = costs.ledger_report()
+    key = repr(("device", "solo", stride))
+    rows = [e for e in led["entries"]
+            if e["kernel"] == "device" and e["key"] == key]
+    assert rows, f"no device-family ledger row for {key}"
+    assert rows[0]["compile_s"] > 0
+    assert rows[0]["m"] == stride
+    assert rows[0]["dispatches"] == n_segs
+    assert "device.telemetry.segment_ms" in led["live_ms"]
+
+    # -- health: run-end verdict published under the device label --------
+    assert _gauge("health.verdict.device:solo") is not None
+
+    # -- flight bundle: the slab summary rides the payload ---------------
+    payload = bundle.collect_payload("test")
+    sec = payload["device_telemetry"]
+    assert sec["enabled"] is True and sec["reservoir"] == devtel.RESERVOIR
+    runs = [r for r in sec["runs"]
+            if r["mode"] == "solo" and r["stride"] == str(stride)]
+    assert runs
+    run = runs[-1]
+    assert run["n_trials"] == stride and run["n_lanes"] == 1
+    traj = np.asarray(run["best_trajectory"], np.float64)
+    filled = traj[np.isfinite(traj)]
+    assert filled.size == stride          # s <= RESERVOIR: one slot per step
+    assert np.all(np.diff(filled) <= 0)   # best-so-far is monotone
+
+
+# ---------------------------------------------------------------------------
+# disarmed: a strict metrics/events no-op
+# ---------------------------------------------------------------------------
+
+
+def test_disarmed_is_a_metrics_and_events_noop(monkeypatch):
+    monkeypatch.setenv("HYPEROPT_TPU_DEVICE_TELEMETRY", "0")
+    EVENTS.enable()
+    ev0 = len(_device_events())
+    lab0 = _counter("device.segments.solo.8")
+    u0 = _counter("device.segments")
+    h0 = _hist_count("device.telemetry.segment_ms")
+    _run(seed=22, stride=8)
+    # the unlabeled counters keep their pinned semantics either way...
+    assert _counter("device.segments") - u0 == N // 8
+    # ...but nothing telemetry-shaped moves
+    assert _counter("device.segments.solo.8") == lab0
+    assert _hist_count("device.telemetry.segment_ms") == h0
+    assert len(_device_events()) == ev0
+
+
+# ---------------------------------------------------------------------------
+# fleet: lane-stack HBM accounting + per-lane slab twins
+# ---------------------------------------------------------------------------
+
+
+class _ProbeTrials(ho.Trials):
+    """Samples the obs.device HBM report at every per-segment landing —
+    i.e. strictly inside the fmin_fleet run frame."""
+
+    def __init__(self):
+        self.hbm_samples = []
+        super().__init__()
+        self.hbm_samples.clear()     # drop the constructor's refresh
+
+    def refresh(self):
+        self.hbm_samples.append(obs_device.report())
+        super().refresh()
+
+
+def test_fleet_lane_stacks_visible_mid_run_then_freed():
+    assert obs_device.report()["lane_stacks"] == 0
+    tl = [_ProbeTrials(), _ProbeTrials()]
+    seg0 = _counter("device.segments.fleet.4")
+    infos = fleet.fmin_fleet(dev_obj, SPACE, n_lanes=2, max_evals=8,
+                             seed=4, sync_stride=4, trials_list=tl,
+                             n_startup_jobs=3)
+    # mid-run samples saw the live lane stack and its byte estimate...
+    mid = [s for t in tl for s in t.hbm_samples]
+    assert mid
+    assert all(s["lane_stacks"] >= 1 for s in mid)
+    assert all(s["lane_stack_bytes"] > 0 for s in mid)
+    # ...and it is freed with the run frame, not leaked
+    after = obs_device.report()
+    assert after["lane_stacks"] == 0
+    assert after["lane_stack_bytes"] == 0
+
+    assert _counter("device.segments.fleet.4") - seg0 == 2
+    for info in infos:
+        tel = info["telemetry"]
+        assert tel["tpe_steps"] > 0          # n_startup=3 < max_evals
+        assert np.isfinite(tel["ei_max"])
+        assert tel["best_loss"] == pytest.approx(info["best_loss"])
+        traj = np.asarray(tel["best_trajectory"], np.float64)
+        filled = traj[np.isfinite(traj)]
+        assert filled.size
+        assert np.all(np.diff(filled) <= 0)
+
+
+# ---------------------------------------------------------------------------
+# history order violations carry a typed event (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_order_violation_emits_typed_event():
+    EVENTS.enable()
+    rng = np.random.default_rng(0)
+
+    class _T:       # weakref-able stand-in for a Trials object
+        pass
+
+    def _h(n, tids):
+        return dict(
+            vals=rng.standard_normal((n, 3)).astype(np.float32),
+            active=np.ones((n, 3), bool),
+            loss=rng.standard_normal(n).astype(np.float32),
+            ok=np.ones(n, bool),
+            tids=np.asarray(list(tids), np.int64))
+
+    trials, cs = _T(), object()
+    h = _h(6, range(6))
+    rhist.device_history(trials, cs, h, 16)         # warm the store
+    swapped = {k: v.copy() for k, v in h.items()}
+    swapped["tids"][2], swapped["tids"][4] = h["tids"][4], h["tids"][2]
+    n0 = len([e for e in EVENTS.snapshot()
+              if e["type"] == "history_order_violation"])
+    with pytest.raises(rhist.HistoryOrderError):
+        rhist.device_history(trials, cs, swapped, 16)
+    evs = [e for e in EVENTS.snapshot()
+           if e["type"] == "history_order_violation"]
+    assert len(evs) == n0 + 1
+    rec = evs[-1]
+    assert rec["name"] == "resident_ring"
+    assert rec["n_resident"] == 6
+    assert rec["positions"]     # where the resident tids landed post-swap

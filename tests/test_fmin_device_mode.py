@@ -260,3 +260,36 @@ def test_max_evals_required():
         ho.fmin(quad_dev, SPACE_QUAD, algo=ALGO, trials=ho.Trials(),
                 rstate=np.random.default_rng(0), show_progressbar=False,
                 mode="device")
+
+
+# ---------------------------------------------------------------------------
+# telemetry armed/disarmed bit-parity (ISSUE 17)
+# ---------------------------------------------------------------------------
+#
+# The in-carry telemetry slab (obs/devtel.py) must be a pure passenger:
+# arming it may not perturb a single sampled value or loss.  The toggle
+# keys the segment run cache, so flipping the env var in-process is a
+# clean A/B — each arm traces its own program.
+
+
+@pytest.mark.parametrize("name,space,fdev,fhost", DOMAINS,
+                         ids=[d[0] for d in DOMAINS])
+def test_telemetry_armed_disarmed_bit_parity(monkeypatch, name, space,
+                                             fdev, fhost):
+    monkeypatch.setenv("HYPEROPT_TPU_DEVICE_TELEMETRY", "1")
+    armed = _rows(_device(fdev, space, seed=9, stride=8))
+    monkeypatch.setenv("HYPEROPT_TPU_DEVICE_TELEMETRY", "0")
+    disarmed = _rows(_device(fdev, space, seed=9, stride=8))
+    assert armed == disarmed
+
+
+def test_telemetry_parity_holds_on_unfused_step(monkeypatch):
+    # The EI stats read the same score sheet both the fused and unfused
+    # fit paths produce (ops/step_ei.py::ei_argmax_stats) — parity must
+    # not depend on HYPEROPT_TPU_FUSED_STEP.
+    monkeypatch.setenv("HYPEROPT_TPU_FUSED_STEP", "0")
+    monkeypatch.setenv("HYPEROPT_TPU_DEVICE_TELEMETRY", "1")
+    armed = _rows(_device(qcat_dev, SPACE_QCAT, seed=9, stride=8))
+    monkeypatch.setenv("HYPEROPT_TPU_DEVICE_TELEMETRY", "0")
+    disarmed = _rows(_device(qcat_dev, SPACE_QCAT, seed=9, stride=8))
+    assert armed == disarmed

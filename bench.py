@@ -70,6 +70,7 @@ PHASE_DEADLINES = {
     "cpu_ref": 300.0,
     "obs": 300.0,
     "multichip": 600.0,
+    "service_hotpath": 600.0,
     "result": 60.0,
 }
 
@@ -736,6 +737,24 @@ def child():
         _say("partial", partial)
     except Exception as e:
         partial["multichip_error"] = f"{type(e).__name__}: {e}"
+        _say("partial", partial)
+
+    # Service hot path (ISSUE r18): interleaved A/B arms over a
+    # multi-tenant service shape at fsync=always — pooled keep-alive
+    # RPC, WAL group commit, parallel read dispatch and long-poll
+    # claims, each toggled by env knob, plus a chaos arm at 32.5%
+    # combined RPC loss that audits exactly-once claim/result
+    # semantics.  Host-only — no device work.
+    _say("phase", {"name": "service_hotpath"})
+    try:
+        from benchmarks.service_hotpath_ab import collect as _shp_collect
+
+        shp = _shp_collect(fast=fast)
+        assert shp["chaos"]["zero_lost_dup"], "chaos arm lost/duped a tid"
+        partial["service_hotpath"] = shp
+        _say("partial", partial)
+    except Exception as e:
+        partial["service_hotpath_error"] = f"{type(e).__name__}: {e}"
         _say("partial", partial)
 
     _say("phase", {"name": "result"})

@@ -1,0 +1,429 @@
+"""Service hot path A/B: pool × group commit × read dispatch × long-poll.
+
+The ISSUE 18 acceptance harness.  Each arm runs the SAME multi-tenant
+workload against an in-process WAL-durable
+:class:`~hyperopt_tpu.service.server.ServiceServer` at ``fsync=always``
+(the durability mode the overhaul must make affordable):
+
+* per tenant, one **driver** enqueues its trial budget through the
+  server-side ``suggest`` verb (batched, inserted server-side);
+* a pool of **workers** runs reserve→heartbeat→write_result cycles
+  (long-poll ``reserve(wait_s=...)`` in the arm that enables it, the
+  classic 10 ms client poll loop otherwise);
+* **readers** burn a fixed budget of poll iterations (cheap
+  ``att_keys`` status polls punctuated by full ``docs`` exports) — the
+  poll-heavy fleet traffic the read-dispatch path exists for, sized
+  identically in every arm so wall-clock compares the same work.
+
+Arms toggle the four knobs:
+
+===========  =========================================================
+baseline     pool off, group commit off, read dispatch off, client poll
+pool         + ``HYPEROPT_TPU_RPC_POOL=8`` (keep-alive connection pool)
+group        + ``HYPEROPT_TPU_WAL_GROUP_COMMIT=1`` (leader fsync batch)
+read         + ``HYPEROPT_TPU_READ_DISPATCH=1`` (reads skip write lock)
+hotpath      everything on + server-side long-poll claims
+===========  =========================================================
+
+Per arm: aggregate verbs/sec, per-verb p50/p95/p99 server latency,
+fsyncs-per-verb, TCP-connects-per-verb, the ``wal.group_size``
+amortization stats (DESIGN.md §7's measured curve) and the pool /
+long-poll counter families.  A chaos pass re-runs the hotpath arm under
+the 32.5 % combined RPC loss schedule and audits exactly-once
+accounting (zero lost, zero duplicated tids).  A suggest-copy probe
+times the ``_canon_docs`` fast path against the retired
+``json.loads(json.dumps(docs))`` roundtrip at cohort 16 / 64.
+
+Headline gates: hotpath ≥ 2.5× baseline verbs/sec; hotpath
+fsyncs-per-verb < 0.2; chaos completes with zero lost/dup.
+
+Run::
+
+    env JAX_PLATFORMS=cpu python benchmarks/service_hotpath_ab.py
+
+Writes ``benchmarks/service_hotpath_ab_cpu_<stamp>.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+N_TENANTS = 4
+TRIALS_PER_TENANT = 96
+WORKERS_PER_TENANT = 4
+READERS_PER_TENANT = 2
+POLLS_PER_READER = 400            # fixed budget: every arm does the
+                                  # same read work, wall is the metric
+POLL_CHEAP_PER_EXPORT = 8         # att_keys polls per full docs export
+SUGGEST_BATCH = 8
+SEED = 0
+SEND_P, RECV_P = 0.25, 0.10       # combined loss 1-(.75*.90) = 0.325
+
+ARMS = (
+    {"arm": "baseline", "pool": 0, "group": 0, "read": 0, "longpoll": False},
+    {"arm": "pool",     "pool": 8, "group": 0, "read": 0, "longpoll": False},
+    {"arm": "group",    "pool": 0, "group": 1, "read": 0, "longpoll": False},
+    {"arm": "read",     "pool": 0, "group": 0, "read": 1, "longpoll": False},
+    {"arm": "hotpath",  "pool": 8, "group": 1, "read": 1, "longpoll": True},
+)
+
+_KNOB_ENVS = ("HYPEROPT_TPU_RPC_POOL", "HYPEROPT_TPU_WAL_GROUP_COMMIT",
+              "HYPEROPT_TPU_READ_DISPATCH")
+
+
+def _mk_domain():
+    from hyperopt_tpu import base, hp
+
+    space = {"x": hp.uniform("x", -5, 5),
+             "c": hp.choice("c", [0, 1, 2])}
+    return base.Domain(lambda a: a["x"] ** 2, space)
+
+
+def _arm_env(arm):
+    os.environ["HYPEROPT_TPU_RPC_POOL"] = str(arm["pool"])
+    os.environ["HYPEROPT_TPU_WAL_GROUP_COMMIT"] = str(arm["group"])
+    os.environ["HYPEROPT_TPU_READ_DISPATCH"] = str(arm["read"])
+
+
+def _hist_row(h):
+    return {"count": h.get("count", 0),
+            "p50_ms": round(1e3 * h.get("p50", 0), 3),
+            "p95_ms": round(1e3 * h.get("p95", 0), 3),
+            "p99_ms": round(1e3 * h.get("p99", 0), 3)}
+
+
+def _size_row(h):
+    # Dimensionless histogram (records per covering fsync) — no
+    # seconds→ms scaling.
+    return {"count": h.get("count", 0),
+            "p50": round(h.get("p50", 0), 2),
+            "p95": round(h.get("p95", 0), 2),
+            "p99": round(h.get("p99", 0), 2)}
+
+
+def _run_arm(arm, n_tenants, trials, reads, chaos=False):
+    """One full workload pass under ``arm``'s knobs; returns the row."""
+    from hyperopt_tpu import faults
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+    from hyperopt_tpu.exceptions import NetstoreUnavailable
+    from hyperopt_tpu.obs import metrics as _metrics
+    from hyperopt_tpu.parallel.netstore import NetTrials
+    from hyperopt_tpu.service import Tenant, TenantTable
+    from hyperopt_tpu.service.server import ServiceServer
+
+    _arm_env(arm)
+    _metrics.registry().snapshot(reset=True)
+    wal_dir = tempfile.mkdtemp(prefix=f"hotpath_{arm['arm']}_")
+    tenants = TenantTable([Tenant(f"tenant-{i}", f"tok-{i}")
+                           for i in range(n_tenants)])
+    srv = ServiceServer(wal_dir, tenants=tenants, fsync="always")
+    srv.start()
+    domain = _mk_domain()
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    stats = [{"completed": 0, "fenced": 0} for _ in range(n_tenants)]
+    threads = []
+
+    def driver(i):
+        nt = NetTrials(srv.url, exp_key="exp", token=f"tok-{i}",
+                       refresh=False)
+        nt.save_domain(domain)
+        inserted = 0
+        while inserted < trials and not stop.is_set():
+            n = min(SUGGEST_BATCH, trials - inserted)
+            try:
+                nt.suggest(SEED + inserted, n=n, algo="rand", insert=True)
+            except NetstoreUnavailable:
+                continue
+            inserted += n
+
+    def worker(i, w):
+        nt = NetTrials(srv.url, exp_key="exp", token=f"tok-{i}",
+                       refresh=False)
+        owner = f"tenant-{i}-w{w}"
+        while not stop.is_set():
+            with lock:
+                if stats[i]["completed"] >= trials:
+                    return
+            try:
+                if arm["longpoll"]:
+                    doc = nt.reserve(owner, wait_s=0.25)
+                else:
+                    doc = nt.reserve(owner)
+            except NetstoreUnavailable:
+                continue
+            if doc is None:
+                if not arm["longpoll"]:
+                    time.sleep(0.01)   # the classic client poll cadence
+                continue
+            try:
+                nt.heartbeat(doc, owner=owner)
+            except NetstoreUnavailable:
+                pass
+            doc["state"] = JOB_STATE_DONE
+            doc["result"] = {"status": STATUS_OK,
+                             "loss": float(doc["misc"]["vals"]["x"][0] ** 2),
+                             "tenant": f"tenant-{i}"}
+            try:
+                ok = nt.write_result(doc, owner=owner)
+            except NetstoreUnavailable:
+                continue
+            with lock:
+                stats[i]["completed" if ok else "fenced"] += 1
+
+    def reader(i):
+        # Fixed poll budget (not a free-running spin): every arm pays
+        # for the SAME read work, so wall-clock — and with it
+        # verbs/sec — compares identical workloads across arms.  The
+        # mix mirrors fleet poll traffic: mostly cheap status polls
+        # (``att_keys``), punctuated by a full ``docs`` export.
+        nt = NetTrials(srv.url, exp_key="exp", token=f"tok-{i}",
+                       refresh=False)
+        done = 0
+        while done < reads and not stop.is_set():
+            try:
+                for _ in range(POLL_CHEAP_PER_EXPORT):
+                    nt._rpc("att_keys")
+                nt.refresh()               # the "docs" verb
+            except NetstoreUnavailable:
+                continue
+            done += 1
+
+    t0 = time.perf_counter()
+    if chaos:
+        faults.configure({"rpc.send": SEND_P, "rpc.recv": RECV_P},
+                         seed=SEED)
+    try:
+        for i in range(n_tenants):
+            threads.append(threading.Thread(target=driver, args=(i,),
+                                            daemon=True))
+            for w in range(WORKERS_PER_TENANT):
+                threads.append(threading.Thread(target=worker, args=(i, w),
+                                                daemon=True))
+            for _ in range(READERS_PER_TENANT):
+                threads.append(threading.Thread(target=reader, args=(i,),
+                                                daemon=True))
+        for t in threads:
+            t.start()
+        # Every thread terminates on its own (drivers exhaust their
+        # budget, workers exit at trial count, readers at read count);
+        # the deadline is a safety net, not the exit condition.
+        deadline = time.time() + 600
+        for t in threads:
+            t.join(timeout=max(1.0, deadline - time.time()))
+        wall_s = time.perf_counter() - t0
+    finally:
+        if chaos:
+            faults.clear()
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+
+    snap = srv.metrics_payload()
+    counters = snap.get("counters", {})
+    hists = snap.get("histograms", {})
+    verb_rows = []
+    total_verbs = 0
+    for name in sorted(counters):
+        if name.startswith("netstore.verb.") and name.endswith(".calls"):
+            total_verbs += counters[name]
+    for name, h in sorted(hists.items()):
+        if name.startswith("netstore.verb.") and name.endswith(".s") \
+                and h.get("count"):
+            verb_rows.append(dict(
+                {"verb": name[len("netstore.verb."):-len(".s")]},
+                **_hist_row(h)))
+
+    fsyncs = counters.get("wal.fsyncs", 0)
+    appends = counters.get("wal.appends", 0)
+    pool_hits = counters.get("rpc.pool.hits", 0)
+    pool_misses = counters.get("rpc.pool.misses", 0)
+    stale = counters.get("rpc.pool.stale_reconnects", 0)
+    rpc_calls = pool_hits + pool_misses
+    gsz = hists.get("wal.group_size", {})
+
+    # Exactly-once audit (chaos off for the read: clean verbs)
+    lost_dup = 0
+    per_tenant = []
+    for i in range(n_tenants):
+        nt = NetTrials(srv.url, exp_key="exp", token=f"tok-{i}")
+        nt.refresh()
+        tids = sorted(d["tid"] for d in nt._dynamic_trials)
+        ok_range = tids == list(range(trials))
+        dups = len(tids) - len(set(tids))
+        if not ok_range or dups:
+            lost_dup += 1
+        per_tenant.append({"tenant": f"tenant-{i}",
+                           "completed": stats[i]["completed"],
+                           "fenced": stats[i]["fenced"],
+                           "tid_range_ok": ok_range, "dups": dups})
+    srv.shutdown()
+
+    return {
+        "arm": arm["arm"],
+        "knobs": {k: arm[k] for k in ("pool", "group", "read", "longpoll")},
+        "chaos": chaos,
+        "wall_s": round(wall_s, 3),
+        "verbs_total": int(total_verbs),
+        "verbs_per_sec": round(total_verbs / wall_s, 1),
+        "fsyncs": int(fsyncs),
+        "wal_appends": int(appends),
+        "fsyncs_per_verb": round(fsyncs / total_verbs, 4) if total_verbs
+        else None,
+        "fsyncs_per_wal_verb": round(fsyncs / appends, 4) if appends
+        else None,
+        "wal_group_size": _size_row(gsz) if gsz.get("count") else None,
+        "wal_group_mean": round(gsz["sum"] / gsz["count"], 3)
+        if gsz.get("count") else None,
+        "connects_per_verb": round((pool_misses + stale) / rpc_calls, 4)
+        if rpc_calls else None,
+        "pool": {"hits": int(pool_hits), "misses": int(pool_misses),
+                 "stale_reconnects": int(stale),
+                 "evicted": int(counters.get("rpc.pool.evicted", 0))},
+        "longpoll": {
+            "parked": int(counters.get("store.longpoll.parked", 0)),
+            "woken": int(counters.get("store.longpoll.woken", 0)),
+            "timeouts": int(counters.get("store.longpoll.timeouts", 0))},
+        "rpc_retries": int(counters.get("netstore.rpc.retry", 0)),
+        "idem_hits": int(counters.get("netstore.idem.hits", 0)),
+        "faults_injected": int(counters.get("faults.injected", 0)),
+        "tenants": per_tenant,
+        "completed": all(s["completed"] >= trials for s in stats),
+        "zero_lost_dup": lost_dup == 0,
+        "rows": verb_rows,
+    }
+
+
+def _suggest_copy_probe(reps=200):
+    """Satellite 1: the retired per-suggest deep copy, measured.
+
+    ``docs_from_samples`` output is already canonical plain JSON, so
+    ``_canon_docs`` validates and returns it by reference; the old path
+    paid a full ``json.loads(json.dumps(docs))`` encode+decode per
+    suggest.  Cohort 16 / 64 are the fleet shapes from DESIGN.md §7."""
+    from hyperopt_tpu import base
+    from hyperopt_tpu.parallel.netstore import _canon_docs
+
+    out = []
+    for n in (16, 64):
+        docs = []
+        for tid in range(n):
+            d = base.new_trial_doc(tid, "exp", None)
+            d["misc"]["idxs"] = {"x": [tid], "c": [tid]}
+            d["misc"]["vals"] = {"x": [float(tid) / 7.0], "c": [tid % 3]}
+            docs.append(d)
+        assert _canon_docs(docs) is docs     # fast path engaged
+
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _canon_docs(docs)
+        canon_us = (time.perf_counter() - t0) * 1e6 / reps
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            json.loads(json.dumps(docs))
+        roundtrip_us = (time.perf_counter() - t0) * 1e6 / reps
+        out.append({"cohort": n,
+                    "canon_us": round(canon_us, 2),
+                    "roundtrip_us": round(roundtrip_us, 2),
+                    "speedup": round(roundtrip_us / canon_us, 1)})
+    return out
+
+
+def collect(fast=False):
+    os.environ.setdefault("HYPEROPT_TPU_NETSTORE_RETRIES", "30")
+    os.environ.setdefault("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.002")
+    saved = {k: os.environ.get(k) for k in _KNOB_ENVS}
+
+    n_tenants = 2 if fast else N_TENANTS
+    trials = 24 if fast else TRIALS_PER_TENANT
+    reads = 60 if fast else POLLS_PER_READER
+    arms = [a for a in ARMS if a["arm"] in ("baseline", "hotpath")] \
+        if fast else list(ARMS)
+    try:
+        rows = [_run_arm(a, n_tenants, trials, reads) for a in arms]
+        chaos_arm = next(a for a in ARMS if a["arm"] == "hotpath")
+        chaos_row = _run_arm(chaos_arm, 2 if fast else n_tenants,
+                             24 if fast else 48, 20, chaos=True)
+        probe = _suggest_copy_probe(reps=50 if fast else 200)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    by_arm = {r["arm"]: r for r in rows}
+    base_r, hot_r = by_arm["baseline"], by_arm["hotpath"]
+    speedup = round(hot_r["verbs_per_sec"] / base_r["verbs_per_sec"], 2)
+    return {
+        "metric": "service_hotpath_ab",
+        "backend": "cpu",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "config": {
+            "tenants": n_tenants,
+            "trials_per_tenant": trials,
+            "workers_per_tenant": WORKERS_PER_TENANT,
+            "readers_per_tenant": READERS_PER_TENANT,
+            "polls_per_reader": reads,
+            "poll_cheap_per_export": POLL_CHEAP_PER_EXPORT,
+            "suggest_batch": SUGGEST_BATCH,
+            "fsync": "always",
+            "fast": bool(fast),
+            "chaos_rpc_loss": {"send_p": SEND_P, "recv_p": RECV_P,
+                               "combined": round(
+                                   1 - (1 - SEND_P) * (1 - RECV_P), 4)},
+        },
+        "arms": rows,
+        "chaos": chaos_row,
+        "suggest_copy_probe": probe,
+        "headline": {
+            "verbs_per_sec_baseline": base_r["verbs_per_sec"],
+            "verbs_per_sec_hotpath": hot_r["verbs_per_sec"],
+            "speedup": speedup,
+            "gate_speedup_ge_2p5": speedup >= 2.5,
+            "fsyncs_per_verb_hotpath": hot_r["fsyncs_per_verb"],
+            "gate_fsyncs_per_verb_lt_0p2":
+                (hot_r["fsyncs_per_verb"] or 1.0) < 0.2,
+            "wal_group_mean_hotpath": hot_r["wal_group_mean"],
+            "connects_per_verb_baseline": base_r["connects_per_verb"],
+            "connects_per_verb_hotpath": hot_r["connects_per_verb"],
+            "chaos_completed": chaos_row["completed"],
+            "chaos_zero_lost_dup": chaos_row["zero_lost_dup"],
+            "chaos_rpc_loss_combined": round(
+                1 - (1 - SEND_P) * (1 - RECV_P), 4),
+        },
+    }
+
+
+def main(fast=False):
+    doc = collect(fast=fast)
+    stamp = time.strftime("%Y%m%d")
+    out_path = os.path.join(_ROOT, "benchmarks",
+                            f"service_hotpath_ab_cpu_{stamp}.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc["headline"], indent=1))
+    print(f"wrote {out_path}")
+    head = doc["headline"]
+    ok = (head["gate_speedup_ge_2p5"] and head["gate_fsyncs_per_verb_lt_0p2"]
+          and head["chaos_completed"] and head["chaos_zero_lost_dup"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="2 arms, small shape (CI smoke)")
+    args = ap.parse_args()
+    raise SystemExit(main(fast=args.fast))

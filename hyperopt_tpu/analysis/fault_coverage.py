@@ -8,10 +8,13 @@ RD004 already reconcile hook *names* against the ``FAULT_POINTS``
 catalog; this rule closes the other direction — the *sites* that must
 carry a hook at all.
 
-FP001  A function that performs wire I/O (calls ``urlopen``) or the
-       durable WAL append (an ``append`` method in a module naming the
+FP001  A function that performs wire I/O (calls ``urlopen`` or checks
+       out the pooled transport via ``_rpc_pool``) or the durable WAL
+       append (an ``append`` method in a module naming the
        ``wal.jsonl`` log) contains no ``maybe_fail(...)`` hook — fault
-       injection cannot reach this network/durability edge.
+       injection cannot reach this network/durability edge.  The pool's
+       own internals are exempt: the *call sites* carry the hooks, so
+       one hook guards every transport however many sockets it cycles.
 """
 
 from __future__ import annotations
@@ -48,6 +51,9 @@ def check(project) -> list:
                 tail = (call_func_name(node) or "").rsplit(".", 1)[-1]
                 if tail == "urlopen" and not does_io_line:
                     does_io_line, kind = node.lineno, "wire I/O (urlopen)"
+                elif tail == "_rpc_pool" and not does_io_line:
+                    does_io_line, kind = node.lineno, \
+                        "wire I/O (pooled transport)"
                 elif tail == "maybe_fail" and node.args \
                         and str_const(node.args[0]):
                     has_hook = True

@@ -29,6 +29,12 @@ WP005  A ``*_WAL_VERBS`` catalog disagrees with the set of dispatcher
 WP006  Catalog hygiene: a verb in both ``*_MUTATING_VERBS`` and
        ``*_IDEMPOTENT_VERBS`` (contradiction), or declared idempotent
        without being a mutating verb at all (stale declaration).
+WP007  A verb declared in a ``*_READONLY_VERBS`` catalog (the server
+       serves these on the lock-free read path, off the write lock and
+       ahead of any fsync queue) mutates durable store state, appears
+       in a mutating/WAL/idempotent catalog, or names no dispatcher
+       arm at all — any of which lets a "read" race the writers the
+       dispatch lock exists to serialize.
 
 Conventions honored (all structural, none import-time): client call
 sites are calls whose callee name ends in ``rpc`` (``self._rpc``,
@@ -47,10 +53,12 @@ import re
 
 from .core import Finding, call_func_name, qualified_functions, str_const
 
-RULES = ("WP001", "WP002", "WP003", "WP004", "WP005", "WP006")
+RULES = ("WP001", "WP002", "WP003", "WP004", "WP005", "WP006", "WP007")
 
-#: Fields _Rpc.__call__ injects into every request on the client side.
-_IMPLICIT_FIELDS = frozenset({"verb", "exp_key", "idem", "ctx"})
+#: Fields _Rpc.__call__ injects into every request on the client side
+#: (``wait_s`` rides along only on long-poll reserve, popped by the
+#: dispatcher before the verb arm ever sees the request).
+_IMPLICIT_FIELDS = frozenset({"verb", "exp_key", "idem", "ctx", "wait_s"})
 
 #: Container methods that mutate their receiver in place.
 _MUTATORS = frozenset({
@@ -136,6 +144,7 @@ class _Extract:
         self.mutating: dict[str, tuple] = {}
         self.idempotent: dict[str, tuple] = {}
         self.wal: dict[str, tuple] = {}
+        self.readonly: dict[str, tuple] = {}
         self.other_catalog_verbs: set[str] = set()
         self.idem_attach_proven = False
         self.funcs: dict[tuple, ast.AST] = {}     # (rel, name) -> node
@@ -181,6 +190,8 @@ class _Extract:
                     self.idempotent[tname] = entry
                 elif tname.endswith("_WAL_VERBS"):
                     self.wal[tname] = entry
+                elif tname.endswith("_READONLY_VERBS"):
+                    self.readonly[tname] = entry
                 else:
                     self.other_catalog_verbs.update(verbs)
 
@@ -459,7 +470,7 @@ def check(project) -> list:
     findings: list = []
 
     catalog_verbs = set(ext.other_catalog_verbs)
-    for table in (ext.mutating, ext.idempotent, ext.wal):
+    for table in (ext.mutating, ext.idempotent, ext.wal, ext.readonly):
         for _rel, _line, verbs in table.values():
             catalog_verbs.update(verbs)
 
@@ -581,4 +592,28 @@ def check(project) -> list:
                         "WP006", rel, line, f"{name}:{verb}",
                         f"'{verb}' is declared retry-convergent in {name} "
                         f"but is not a mutating verb — stale declaration"))
+
+    # WP007: the lock-free read path serves exactly verbs that touch no
+    # durable state and answer to no other catalog's contract.
+    if ext.readonly:
+        conflicting = wal_verbs | mutating_verbs | idempotent_verbs
+        for name, (rel, line, verbs) in sorted(ext.readonly.items()):
+            for verb in sorted(verbs):
+                if verb in server_mutating:
+                    findings.append(Finding(
+                        "WP007", rel, line, f"{name}:{verb}",
+                        f"'{verb}' is declared read-only ({name}) but its "
+                        f"dispatcher arm mutates durable store state — "
+                        f"served off the write lock it races every writer"))
+                elif verb in conflicting:
+                    findings.append(Finding(
+                        "WP007", rel, line, f"{name}:{verb}",
+                        f"'{verb}' is declared read-only ({name}) and also "
+                        f"mutating/WAL-logged/retry-convergent in another "
+                        f"catalog — the declarations contradict"))
+                elif verb not in ext.arms:
+                    findings.append(Finding(
+                        "WP007", rel, line, f"{name}:{verb}",
+                        f"read-only verb '{verb}' has no dispatcher arm — "
+                        f"stale catalog entry"))
     return findings

@@ -62,6 +62,13 @@ def coarse_utcnow() -> float:
     return float(int(time.time()))
 
 
+#: Granularity of :func:`coarse_utcnow`.  Staleness checks that compare a
+#: coarse ``book_time``/``refresh_time`` against a clock must allow this
+#: much slop, or a doc booked late in a wall second looks up to a full
+#: second older than it is and a sub-second timeout requeues it instantly.
+COARSE_CLOCK_SLOP_S = 1.0
+
+
 def validate_trial_docs(docs):
     for doc in docs:
         for k in _TRIAL_KEYS:

@@ -18,6 +18,7 @@ Fault points wired into the core::
     objective.call    at the top of Domain.evaluate (every execution path)
     pipeline.dispatch before PipelinedExecutor dispatches a suggest slot
     wal.write         before a service-server WAL record is appended
+    wal.fsync         before a group-commit leader fsyncs a WAL batch
     wal.replay        per record during WAL replay at server recovery
     flight.dump       inside a flight-recorder bundle dump
     replica.ship      before a WAL batch/snapshot ships to a warm replica
@@ -84,6 +85,7 @@ FAULT_POINTS = frozenset(
         "objective.call",
         "pipeline.dispatch",
         "wal.write",
+        "wal.fsync",
         "wal.replay",
         "flight.dump",
         "replica.ship",

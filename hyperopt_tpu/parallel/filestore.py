@@ -52,6 +52,7 @@ from ..obs import context as _context
 from ..obs import metrics as _metrics
 from ..obs.events import EVENTS
 from ..base import (
+    COARSE_CLOCK_SLOP_S,
     JOB_STATE_DONE,
     JOB_STATE_ERROR,
     JOB_STATE_NEW,
@@ -351,6 +352,13 @@ class FileTrials(Trials):
             cur["refresh_time"] = coarse_utcnow()
             self._write_doc(cur)
             doc["refresh_time"] = cur["refresh_time"]
+            # The claim file's mtime is the fine-grained freshness
+            # authority (refresh_time is whole-second): touch it so the
+            # janitor's staleness math sees the beat at full resolution.
+            try:
+                os.utime(self._claim_path(doc["tid"]))
+            except OSError:
+                pass
         return True
 
     def owns(self, doc, owner: str) -> bool:
@@ -395,47 +403,67 @@ class FileTrials(Trials):
         again."""
         now = time.time()
         n = 0
-        self.refresh()
-        for doc in self._trials:
-            claim = self._claim_path(doc["tid"])
-            if doc["state"] == JOB_STATE_RUNNING:
-                last = doc.get("refresh_time") or doc.get("book_time") or 0
-                if now - last > timeout:
-                    # Capture the abandoned owner BEFORE clearing it: the
-                    # janitor's event log must name who went silent, or a
-                    # chaos run's requeues are unattributable.
-                    owner = doc.get("owner")
+        # The whole sweep holds the store lock (RLock: refresh/_write_doc
+        # re-enter fine) so a concurrent reader can never observe a
+        # requeued doc before the ``store.requeued`` counter reflects it —
+        # the StoreServer's lock-free read path refreshes this instance
+        # without taking the dispatch lock.
+        with self._lock:
+            self.refresh()
+            for doc in self._trials:
+                claim = self._claim_path(doc["tid"])
+                if doc["state"] == JOB_STATE_RUNNING:
+                    last = doc.get("refresh_time") or doc.get("book_time") or 0
+                    # ``last`` is coarse (whole seconds) while ``now`` is
+                    # not, so on its own it needs a full tick of slop or a
+                    # doc booked late in a wall second is "stale" the
+                    # instant it is reserved.  The claim file's mtime
+                    # (stamped by reserve and every heartbeat) restores
+                    # full resolution: prefer it when present.
+                    slop = COARSE_CLOCK_SLOP_S
                     try:
-                        os.unlink(claim)
-                    except FileNotFoundError:
+                        mtime = os.stat(claim).st_mtime
+                        if mtime >= last:
+                            # mtime kept up with the beats: exact, no slop.
+                            last, slop = mtime, 0.0
+                    except OSError:
                         pass
-                    doc["state"] = JOB_STATE_NEW
-                    doc["owner"] = None
-                    self._write_doc(doc)
-                    n += 1
-                    EVENTS.emit("store_requeue", trial=doc["tid"],
-                                owner=owner, reason="stale_heartbeat")
-            elif doc["state"] == JOB_STATE_NEW:
-                try:
-                    if now - os.stat(claim).st_mtime > timeout:
-                        # Orphan claim (worker died between winning the
-                        # claim and persisting RUNNING): the claim file
-                        # itself is the only record of the owner — read
-                        # it before the unlink destroys it.
+                    if now - last > timeout + slop:
+                        # Capture the abandoned owner BEFORE clearing it: the
+                        # janitor's event log must name who went silent, or a
+                        # chaos run's requeues are unattributable.
+                        owner = doc.get("owner")
                         try:
-                            with open(claim) as f:
-                                owner = f.read()
-                        except OSError:
-                            owner = None
-                        os.unlink(claim)
+                            os.unlink(claim)
+                        except FileNotFoundError:
+                            pass
+                        doc["state"] = JOB_STATE_NEW
+                        doc["owner"] = None
+                        self._write_doc(doc)
                         n += 1
                         EVENTS.emit("store_requeue", trial=doc["tid"],
-                                    owner=owner, reason="orphan_claim")
-                except (FileNotFoundError, OSError):
-                    pass
-        if n:
-            _metrics.registry().counter("store.requeued").inc(n)
-            self.refresh()
+                                    owner=owner, reason="stale_heartbeat")
+                elif doc["state"] == JOB_STATE_NEW:
+                    try:
+                        if now - os.stat(claim).st_mtime > timeout:
+                            # Orphan claim (worker died between winning the
+                            # claim and persisting RUNNING): the claim file
+                            # itself is the only record of the owner — read
+                            # it before the unlink destroys it.
+                            try:
+                                with open(claim) as f:
+                                    owner = f.read()
+                            except OSError:
+                                owner = None
+                            os.unlink(claim)
+                            n += 1
+                            EVENTS.emit("store_requeue", trial=doc["tid"],
+                                        owner=owner, reason="orphan_claim")
+                    except (FileNotFoundError, OSError):
+                        pass
+            if n:
+                _metrics.registry().counter("store.requeued").inc(n)
+                self.refresh()
         return n
 
 

@@ -23,7 +23,10 @@ Design — serialize, don't re-implement:
   — the reserve→evaluate→heartbeat→write loop is inherited unchanged.
 
 Wire format: JSON verbs over HTTP POST (stdlib only — the environment has no
-third-party RPC deps).  Trial documents are already JSON (the filestore
+third-party RPC deps).  Transport is pooled keep-alive HTTP/1.1
+(:class:`_ConnectionPool`): sockets are reused across verbs instead of
+re-dialed per call, with the inherent stale-keep-alive race retried once
+transparently.  Trial documents are already JSON (the filestore
 persists them as such).  The Domain and attachments travel as base64
 cloudpickle, like the reference ships objectives through GridFS — which
 means the SAME trust model as the reference: only run a StoreServer for
@@ -55,15 +58,17 @@ import logging
 import os
 import pickle
 import random
+import socket
 import threading
 import time
 import uuid
 import zlib
 from collections import OrderedDict
 from collections.abc import MutableMapping
+from http import client as _http_client
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.error import HTTPError, URLError
-from urllib.request import Request, urlopen
+from urllib.error import URLError
+from urllib.parse import urlsplit
 
 from .filestore import FileTrials, FileWorker, _pickler
 from ..base import JOB_STATE_RUNNING, Trials, docs_from_samples
@@ -104,6 +109,185 @@ def _resolve_token(token: str | None) -> str | None:
 # ---------------------------------------------------------------------------
 
 
+class _KeepAliveHTTPServer(ThreadingHTTPServer):
+    """:class:`ThreadingHTTPServer` that severs live keep-alive
+    connections on close.  With HTTP/1.1 reuse, daemon handler threads
+    would otherwise keep serving established sockets after the listener
+    dies — a closed server must go dark, not half-alive (failover
+    promotion and graceful SIGTERM both rely on it)."""
+
+    def __init__(self, *args, **kwargs):
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def server_close(self):
+        super().server_close()
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+class _LeanHeaders:
+    """Just-enough stand-in for ``email.message.Message`` on the
+    server's request hot path: the verb handlers only ever ``.get`` a
+    handful of plain headers."""
+
+    __slots__ = ("_d",)
+
+    def __init__(self, d: dict):
+        self._d = d
+
+    def get(self, name, default=None):
+        return self._d.get(name.lower(), default)
+
+    def __contains__(self, name):
+        return name.lower() in self._d
+
+
+class _LeanRequestHandler(BaseHTTPRequestHandler):
+    """``BaseHTTPRequestHandler`` with a fast request-parse path.
+
+    The stock ``parse_request`` routes every request's header block
+    through ``email.parser`` — ~100 µs per verb, comparable to a whole
+    cached-read dispatch.  Verb traffic is uniform ("POST /path
+    HTTP/1.1" plus a few plain headers), so the common case is parsed
+    with a handful of ``partition`` calls; anything unusual (HTTP/1.0,
+    other versions, oversized lines) falls back to the stock parser
+    for strictness."""
+
+    def handle_one_request(self):
+        try:
+            self.raw_requestline = self.rfile.readline(65537)
+            if len(self.raw_requestline) > 65536:
+                self.requestline = ""
+                self.request_version = ""
+                self.command = ""
+                self.send_error(414)
+                return
+            if not self.raw_requestline:
+                self.close_connection = True
+                return
+            words = self.raw_requestline.split()
+            if len(words) == 3 and words[2] == b"HTTP/1.1":
+                self.command = words[0].decode("latin-1")
+                self.path = words[1].decode("latin-1")
+                self.request_version = "HTTP/1.1"
+                self.requestline = self.raw_requestline.decode(
+                    "latin-1").rstrip("\r\n")
+                hdrs: dict = {}
+                while True:
+                    line = self.rfile.readline(65537)
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    if len(line) >= 65536 or len(hdrs) >= 100:
+                        self.send_error(431)
+                        return
+                    key, sep, val = line.partition(b":")
+                    if not sep or key != key.strip():
+                        # Folded (obs-fold) or malformed header — no
+                        # client of ours emits these, and the lines are
+                        # already consumed, so reject rather than guess.
+                        self.send_error(400, "Bad header line")
+                        return
+                    hdrs[key.lower().decode("latin-1")] = (
+                        val.strip().decode("latin-1"))
+                self.headers = _LeanHeaders(hdrs)
+                self.close_connection = (
+                    hdrs.get("connection", "").lower() == "close")
+            elif not self.parse_request():
+                return
+            mname = "do_" + self.command
+            if not hasattr(self, mname):
+                self.send_error(
+                    501, "Unsupported method (%r)" % self.command)
+                return
+            getattr(self, mname)()
+            self.wfile.flush()
+        except TimeoutError as e:
+            self.log_error("Request timed out: %r", e)
+            self.close_connection = True
+
+
+class _ClaimGate:
+    """Wake-up channel for long-poll ``reserve``: one condition variable
+    plus a generation counter per ``(tenant, exp_key)``.  A reserver
+    snapshots the generation, attempts the claim, and parks only if the
+    generation is unchanged — :meth:`signal`'s bump-then-notify makes a
+    wakeup that lands between attempt and park impossible to lose."""
+
+    __slots__ = ("_cv", "_gen")
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._gen = 0
+
+    def snapshot(self) -> int:
+        with self._cv:
+            return self._gen
+
+    def wait(self, gen0: int, timeout: float) -> bool:
+        """Park until a signal newer than ``gen0`` (or ``timeout``);
+        True iff (possibly) signaled."""
+        with self._cv:
+            if self._gen != gen0:
+                return True
+            return self._cv.wait(timeout)
+
+    def signal(self) -> None:
+        with self._cv:
+            self._gen += 1
+            self._cv.notify_all()
+
+
+def _is_plain_json(x) -> bool:
+    """True iff ``x`` is already canonical plain-JSON data: exactly the
+    builtin container/scalar types (subclasses like ``np.float64`` fail
+    the ``type`` check and force the normalizing roundtrip)."""
+    t = type(x)
+    if t is dict:
+        return all(type(k) is str and _is_plain_json(v)
+                   for k, v in x.items())
+    if t is list:
+        return all(_is_plain_json(v) for v in x)
+    return t in (str, int, float, bool) or x is None
+
+
+def _canon_docs(docs: list) -> list:
+    """Canonical plain-JSON form of proposal docs.
+
+    The suggest hot path used to pay ``json.loads(json.dumps(docs))``
+    on EVERY call — a third full JSON pass per suggest on top of the
+    WAL record's and the reply's own encodes — although
+    ``docs_from_samples`` already emits plain ``int``/``float``/``str``
+    containers.  Skip the roundtrip when the tree is verifiably
+    canonical; fall back to it when an algorithm hands back numpy
+    scalars or tuples, so stored state stays byte-identical to what a
+    WAL replay would re-insert."""
+    if _is_plain_json(docs):
+        return docs
+    return json.loads(json.dumps(docs))
+
+
 class StoreServer:
     """Serve a local store directory to remote drivers/workers.
 
@@ -121,6 +305,25 @@ class StoreServer:
     #: a long-running fleet's cache cannot grow without limit.
     _IDEM_CAP = 4096
     _IDEM_TTL_S = 900.0
+
+    #: Server-side ceiling on one long-poll ``reserve`` park (seconds);
+    #: clients asking for more are clamped — a parked claim must not
+    #: outlive intermediary idle timeouts by much.
+    _LONGPOLL_CAP_S = 30.0
+
+    #: Verbs read-only by construction: no WAL append, no write lock —
+    #: served by ``_dispatch_read`` so a poll-heavy fleet never queues
+    #: behind a mutating verb's fsync.  The wire-protocol analyzer's
+    #: WP007 pins this catalog against the computed mutation ground
+    #: truth of the dispatcher arms, so drift is impossible silently.
+    _READONLY_VERBS = frozenset({
+        "metrics", "health", "bundle", "docs", "get_domain",
+        "att_get", "att_keys"})
+
+    #: Verbs whose success may make a claim (or a claims-quota slot)
+    #: available: each wakes the exp_key's parked long-poll reserves.
+    _LONGPOLL_WAKE = frozenset({
+        "insert_docs", "suggest", "requeue_stale", "write_result"})
 
     def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
                  token: str | None = None,
@@ -182,6 +385,16 @@ class StoreServer:
         # Bounded per-tenant label set (LRU): tenant churn would
         # otherwise grow the netstore.tenant.<name>.* families forever.
         self._tenant_labels = _metrics.LabelLru()
+        # Read-path concurrency (A/B knob): when on — the default —
+        # verbs in _READONLY_VERBS bypass the write lock entirely and
+        # rely on each store's own internal lock.
+        self._read_dispatch = os.environ.get(
+            "HYPEROPT_TPU_READ_DISPATCH", "1").lower() not in (
+                "0", "off", "false")
+        # Long-poll claim gates: (tenant, exp_key) -> _ClaimGate.  Grows
+        # with the store table (same key space), never shrinks.
+        self._claim_gates: dict = {}
+        self._claim_gates_lock = threading.Lock()
         # Flight-bundle sections owned by this server: the time-series
         # window, SLO alert states and cached health verdicts travel in
         # every postmortem dump while the server lives.
@@ -194,7 +407,19 @@ class StoreServer:
         self._lifecycle_lock = threading.Lock()
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(_LeanRequestHandler):
+            # HTTP/1.1 so the client pool's sockets stay open between
+            # verbs (the 1.0 default would close after every reply);
+            # every response path sets Content-Length, which keep-alive
+            # requires.
+            protocol_version = "HTTP/1.1"
+            # Nagle off: on a persistent connection a small reply would
+            # otherwise sit in the kernel waiting for the client's
+            # delayed ACK (~40 ms per verb — the classic small-write
+            # stall; one-shot urlopen never saw it because close()
+            # flushed).
+            disable_nagle_algorithm = True
+
             def log_message(self, fmt, *args):   # quiet by default
                 logger.debug("netstore: " + fmt, *args)
 
@@ -286,7 +511,7 @@ class StoreServer:
                 self._send_json(404, json.dumps(
                     {"error": f"NotFound: {self.path}"}).encode())
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = _KeepAliveHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
 
     # -- lifecycle -----------------------------------------------------------
@@ -395,13 +620,16 @@ class StoreServer:
         # Overridable: the WAL-backed ServiceServer routes these requeues
         # through its log so replay reproduces the janitor's decisions.
         with self._lock:
-            stores = list(self._trials.values())
-        for ft in stores:
+            stores = list(self._trials.items())
+        for (tname, exp_key), ft in stores:
             with self._lock:
                 n = ft.requeue_stale(self.stale_timeout)
             if n:
                 logger.info("netstore janitor: requeued %d stale "
                             "trial(s) in %r", n, ft._exp_key)
+                # Requeued claims are claimable again: wake this
+                # store's parked long-poll reserves.
+                self._signal_claims(tname, exp_key)
 
     @property
     def url(self) -> str:
@@ -496,20 +724,39 @@ class StoreServer:
             with _context.adopt(ctx):
                 EVENTS.emit("rpc", name=verb)
                 idem = req.pop("idem", None)
+                wait_s = req.pop("wait_s", None)
+                if verb == "reserve" and wait_s:
+                    # Long-poll claim: the park/retry loop runs INSIDE
+                    # the idempotent execution below, so only the final
+                    # answer is cached for client retries.
+                    def run():
+                        return self._reserve_longpoll(
+                            req, tenant=tenant, wait_s=float(wait_s),
+                            idem=idem)
+                else:
+                    def run():
+                        return self._dispatch_verb(verb, req,
+                                                   tenant=tenant,
+                                                   idem=idem)
                 if idem is None:
-                    return self._dispatch_verb(verb, req, tenant=tenant)
-                # Mutating verb with an idempotency key: a retry of a call
-                # the server already executed must return the original
-                # reply, not run the verb twice (the client retries blind
-                # — it cannot know whether the loss was on the way in or
-                # out).
-                key = (tname, req.get("exp_key", "default"), idem)
-                out, replayed = self._idem_execute(
-                    key, lambda: self._dispatch_verb(verb, req,
-                                                     tenant=tenant,
-                                                     idem=idem))
-                if replayed:
-                    reg.counter("netstore.idem.hits").inc()
+                    out = run()
+                else:
+                    # Mutating verb with an idempotency key: a retry of
+                    # a call the server already executed must return the
+                    # original reply, not run the verb twice (the client
+                    # retries blind — it cannot know whether the loss
+                    # was on the way in or out).
+                    key = (tname, req.get("exp_key", "default"), idem)
+                    out, replayed = self._idem_execute(key, run)
+                    if replayed:
+                        reg.counter("netstore.idem.hits").inc()
+                if verb in self._LONGPOLL_WAKE:
+                    # Outside every lock: this verb may have made a
+                    # claim (or a quota slot) available — wake parked
+                    # long-poll reserves for the store.
+                    self._signal_claims(tname,
+                                        req.get("exp_key", "default"),
+                                        verb=verb, out=out)
                 return out
         except Exception as e:
             # Black-box the failing dispatch before the error surfaces
@@ -690,33 +937,10 @@ class StoreServer:
 
     def _dispatch_verb(self, verb: str, req: dict, tenant=None,
                        idem=None) -> dict:
-        if verb == "metrics":
-            # Same payload as GET /metrics so RPC clients
-            # (NetTrials.metrics) don't need a second transport.
-            return {"metrics": self.metrics_payload()}
-        if verb == "health":
-            # Read-only interpretation verb: per-(tenant, exp_key)
-            # optimizer-health verdicts.  Never WAL-logged (not in
-            # ServiceServer._WAL_VERBS) and never mutates a store.
-            return {"health": self._health_verb(req, tenant=tenant)}
-        if verb == "bundle":
-            # Read-only flight pull: the full postmortem payload (events
-            # ring + meta anchor, metrics, provider sections, redacted
-            # env) so an operator lands a remote shard's black box on
-            # local disk (bundle.write_payload) without shelling in.
-            # Never WAL-logged, never touches a store, token-gated like
-            # every verb.
-            return {"bundle": _obs_bundle.collect_payload(
-                "verb", extra={"trigger": "verb",
-                               "tenant": getattr(tenant, "name", None)})}
+        if verb in self._READONLY_VERBS:
+            return self._dispatch_read(verb, req, tenant=tenant)
         with self._lock:
             ft = self._store(req.get("exp_key", "default"), tenant=tenant)
-            if verb == "docs":
-                export = getattr(ft, "export_docs", None)
-                if export is not None:
-                    return {"docs": export()}
-                ft.refresh()
-                return {"docs": ft._dynamic_trials}
             if verb == "insert_docs":
                 self._charge_admission(tenant, len(req["docs"]))
                 return {"tids": ft._insert_trial_docs(req["docs"])}
@@ -756,31 +980,157 @@ class StoreServer:
             if verb == "put_domain":
                 ft.put_domain_blob(base64.b64decode(req["blob"]))
                 return {"ok": True}
-            if verb == "get_domain":
-                blob = ft.get_domain_blob()
-                if blob is None:
-                    return {"blob": None}
-                return {"blob": base64.b64encode(blob).decode()}
             if verb == "att_set":
                 ft.attachments[req["key"]] = pickle.loads(
                     base64.b64decode(req["blob"]))
                 return {"ok": True}
-            if verb == "att_get":
-                try:
-                    val = ft.attachments[req["key"]]
-                except KeyError:
-                    return {"blob": None}
-                return {"blob": base64.b64encode(
-                    _pickler.dumps(val)).decode()}
             if verb == "att_del":
                 try:
                     del ft.attachments[req["key"]]
                     return {"ok": True}
                 except KeyError:
                     return {"ok": False}
-            if verb == "att_keys":
-                return {"keys": list(ft.attachments)}
             raise ValueError(f"unknown verb {verb!r}")
+
+    # -- read dispatch (no write lock) ---------------------------------------
+
+    def _dispatch_read(self, verb: str, req: dict, tenant=None) -> dict:
+        """Read-only verbs (the ``_READONLY_VERBS`` catalog), served
+        WITHOUT queuing on the write lock: a poll-heavy fleet's ``docs``
+        calls never wait behind a mutating verb's fsync.  Safe because
+        every store serializes its own state behind an internal lock
+        (``FileTrials``/``MemTrials``) and the store table is only
+        probed, never mutated, on this path (:meth:`_store_ro`).
+        ``HYPEROPT_TPU_READ_DISPATCH=0`` restores the classic
+        reads-queue-on-the-write-lock behavior for A/B attribution."""
+        if verb == "metrics":
+            # Same payload as GET /metrics so RPC clients
+            # (NetTrials.metrics) don't need a second transport.
+            return {"metrics": self.metrics_payload()}
+        if verb == "health":
+            # Read-only interpretation verb: per-(tenant, exp_key)
+            # optimizer-health verdicts.  Never WAL-logged (not in
+            # ServiceServer._WAL_VERBS) and never mutates a store.
+            return {"health": self._health_verb(req, tenant=tenant)}
+        if verb == "bundle":
+            # Read-only flight pull: the full postmortem payload (events
+            # ring + meta anchor, metrics, provider sections, redacted
+            # env) so an operator lands a remote shard's black box on
+            # local disk (bundle.write_payload) without shelling in.
+            # Never WAL-logged, never touches a store, token-gated like
+            # every verb.
+            return {"bundle": _obs_bundle.collect_payload(
+                "verb", extra={"trigger": "verb",
+                               "tenant": getattr(tenant, "name", None)})}
+        exp_key = req.get("exp_key", "default")
+        if not self._read_dispatch:
+            with self._lock:
+                return self._dispatch_read_store(
+                    verb, req, self._store(exp_key, tenant=tenant))
+        return self._dispatch_read_store(
+            verb, req, self._store_ro(exp_key, tenant=tenant))
+
+    def _dispatch_read_store(self, verb: str, req: dict, ft) -> dict:
+        """Store-backed read arms; ``ft`` resolves concurrency above
+        (lock-free probe, or under the write lock in the A/B-off arm).
+        """
+        if verb == "docs":
+            export = getattr(ft, "export_docs", None)
+            if export is not None:
+                return {"docs": export()}
+            ft.refresh()
+            return {"docs": ft._dynamic_trials}
+        if verb == "get_domain":
+            blob = ft.get_domain_blob()
+            if blob is None:
+                return {"blob": None}
+            return {"blob": base64.b64encode(blob).decode()}
+        if verb == "att_get":
+            try:
+                val = ft.attachments[req["key"]]
+            except KeyError:
+                return {"blob": None}
+            return {"blob": base64.b64encode(
+                _pickler.dumps(val)).decode()}
+        if verb == "att_keys":
+            return {"keys": list(ft.attachments)}
+        raise ValueError(f"unknown verb {verb!r}")
+
+    def _store_ro(self, exp_key: str, tenant=None):
+        """Store lookup for the read path: a lock-free probe of the
+        table (dict reads are atomic under the GIL; stores are created
+        once and never replaced), taking the write lock only to create
+        a store that does not exist yet — ``_store`` re-probes under
+        the lock, so the race is benign."""
+        tname = getattr(tenant, "name", tenant)
+        ft = self._trials.get((tname, exp_key))
+        if ft is not None:
+            return ft
+        with self._lock:
+            return self._store(exp_key, tenant=tenant)
+
+    # -- long-poll claims ----------------------------------------------------
+
+    def _claim_gate(self, tname, exp_key) -> _ClaimGate:
+        key = (tname, exp_key)
+        with self._claim_gates_lock:
+            gate = self._claim_gates.get(key)
+            if gate is None:
+                gate = self._claim_gates[key] = _ClaimGate()
+            return gate
+
+    def _signal_claims(self, tname, exp_key, verb=None, out=None):
+        """Wake the store's parked long-poll reserves.  With ``verb``/
+        ``out`` the wake is gated on the verb actually having produced
+        something claimable (inserted docs, requeued claims, a freed
+        claims-quota slot); the janitor calls with no verb
+        (unconditional).  Never creates a gate — nobody parked means
+        nothing to wake."""
+        if verb is not None:
+            if verb == "suggest" and not (out or {}).get("inserted"):
+                return
+            key = {"insert_docs": "tids", "suggest": "tids",
+                   "requeue_stale": "n", "write_result": "ok"}[verb]
+            if not (out or {}).get(key):
+                return
+        with self._claim_gates_lock:
+            gate = self._claim_gates.get((tname, exp_key))
+        if gate is not None:
+            gate.signal()
+
+    def _reserve_longpoll(self, req: dict, tenant=None,
+                          wait_s: float = 0.0, idem=None) -> dict:
+        """Server-side parked claim: retry ``reserve`` on every gate
+        signal until a doc lands or the wait budget expires, replacing
+        the workers' client-side 100 ms poll loop.  Each attempt is a
+        full ``_dispatch_verb`` pass, so quota checks (and, in the
+        WAL-backed service, append-before-execute) re-run at every wake
+        exactly as a fresh client poll would."""
+        reg = _metrics.registry()
+        tname = getattr(tenant, "name", tenant)
+        gate = self._claim_gate(tname, req.get("exp_key", "default"))
+        deadline = time.monotonic() + min(float(wait_s),
+                                          self._LONGPOLL_CAP_S)
+        parked = False
+        while True:
+            # Generation snapshot BEFORE the attempt: a signal that
+            # lands between attempt and park bumps it, so the wait
+            # below returns immediately instead of losing the wakeup.
+            gen0 = gate.snapshot()
+            out = self._dispatch_verb("reserve", req, tenant=tenant,
+                                      idem=idem)
+            if out.get("doc") is not None:
+                if parked:
+                    reg.counter("store.longpoll.woken").inc()
+                return out
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                reg.counter("store.longpoll.timeouts").inc()
+                return out
+            if not parked:
+                parked = True
+                reg.counter("store.longpoll.parked").inc()
+            gate.wait(gen0, remaining)
 
     # -- server-side suggest -------------------------------------------------
 
@@ -892,10 +1242,12 @@ class StoreServer:
                                      exp_key=getattr(ft, "exp_key", None))
         else:
             docs = algo(new_ids, domain, ft, int(req["seed"]), **kw)
-        # JSON roundtrip now, inside the lock: the reply the client sees
+        # Canonicalize now, inside the lock: the reply the client sees
         # is exactly what a WAL replay would re-insert, and the docs the
-        # server stores are plain JSON types like every other doc.
-        docs = json.loads(json.dumps(docs))
+        # server stores are plain JSON types like every other doc.  The
+        # common case (docs_from_samples output) is already canonical
+        # and skips the encode/decode deep-copy entirely.
+        docs = _canon_docs(docs)
         tids = list(new_ids)
         if insert and docs:
             tids = ft._insert_trial_docs(docs)
@@ -925,10 +1277,224 @@ _IDEMPOTENT_VERBS = frozenset(
 
 _BACKOFF_CAP_S = 2.0
 
+#: Env knob: per-host cap on idle keep-alive connections held by the
+#: process-global pool (0 disables pooling — every call dials and
+#: closes a fresh socket, the pre-pool behavior).
+_POOL_ENV = "HYPEROPT_TPU_RPC_POOL"
+
+
+class _ConnectionPool:
+    """Bounded per-host pool of keep-alive ``http.client`` connections.
+
+    Every RPC used to pay a fresh TCP handshake (``urlopen`` closes its
+    socket after one reply); at fleet scale connection setup dominated
+    per-verb latency.  :meth:`request` checks a connection out of the
+    per-``(host, port)`` idle list (``rpc.pool.hits``; a miss dials a
+    new socket — ``rpc.pool.misses``), runs one HTTP round-trip, and
+    checks it back in for the next call; returns beyond the per-host
+    cap close the socket (``rpc.pool.evicted``).
+
+    A reused socket may have died between calls (the server closed an
+    idle keep-alive connection — a race inherent to HTTP/1.1).  That
+    failure is retried ONCE, transparently, on a freshly dialed
+    connection (``rpc.pool.stale_reconnects``): it is a pool artifact,
+    not a server fault, so it burns neither the caller's retry budget
+    nor a second ``rpc.send`` fault-point draw.  A failure on a fresh
+    connection is a real transport error and propagates as
+    ``URLError``/``OSError`` into :class:`_Rpc`'s retry loop."""
+
+    # Distinct (host, port) entries allowed to hold idle sockets at
+    # once, LRU-evicted.  Long-lived deployments talk to a handful of
+    # endpoints and never feel this; without it, anything cycling many
+    # short-lived servers (the test suite spawns hundreds, each on a
+    # fresh port) accumulates one dead socket fd per server forever.
+    _HOST_CAP = 32
+
+    def __init__(self, size: int):
+        self.size = max(0, int(size))
+        self._lock = threading.Lock()
+        # (host, port) -> [HTTPConnection]; dict order is the LRU order
+        # (entries are re-inserted on every check-in, dropped when
+        # their last idle socket is checked out).
+        self._idle: dict = {}
+
+    def request(self, url: str, data, headers: dict, timeout: float):
+        """One HTTP round-trip → ``(status, body_bytes)``.  ``data`` is
+        the POST body; ``None`` sends a GET (the router's upstream
+        metrics scrape)."""
+        parts = urlsplit(url)
+        host = parts.hostname or "127.0.0.1"
+        port = parts.port or 80
+        path = parts.path or "/"
+        if parts.query:
+            path = f"{path}?{parts.query}"
+        key = (host, port)
+        reg = _metrics.registry()
+        conn = None
+        if self.size:
+            with self._lock:
+                idle = self._idle.get(key)
+                if idle:
+                    conn = idle.pop()
+                    if not idle:
+                        del self._idle[key]
+        reused = conn is not None
+        if reused:
+            reg.counter("rpc.pool.hits").inc()
+        else:
+            reg.counter("rpc.pool.misses").inc()
+        if conn is None:
+            conn = _http_client.HTTPConnection(host, port, timeout=timeout)
+        else:
+            conn.timeout = timeout
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout)
+        try:
+            status, body, keep = self._roundtrip(conn, path, data, headers)
+        except (OSError, _http_client.HTTPException) as e:
+            conn.close()
+            if not reused:
+                raise self._transport_error(e) from e
+            # Stale keep-alive socket: one transparent redial.
+            reg.counter("rpc.pool.stale_reconnects").inc()
+            conn = _http_client.HTTPConnection(host, port, timeout=timeout)
+            try:
+                status, body, keep = self._roundtrip(conn, path, data,
+                                                     headers)
+            except (OSError, _http_client.HTTPException) as e2:
+                conn.close()
+                raise self._transport_error(e2) from e2
+        if keep:
+            self._checkin(key, conn)
+        else:
+            conn.close()
+        return status, body
+
+    @staticmethod
+    def _roundtrip(conn, path, data, headers):
+        """One hand-rolled HTTP/1.1 exchange over ``conn``'s socket.
+
+        ``http.client``'s request/response machinery costs ~200 µs per
+        call on this path: headers and body go out as two separate
+        small ``sendall``s (two GIL handoffs — and, with Nagle on, a
+        ~40 ms delayed-ACK stall), and the reply headers are parsed
+        through ``email.parser``.  Both servers guarantee a
+        ``Content-Length`` on every response path (a keep-alive
+        invariant), so one coalesced write plus a line-oriented reply
+        reader is sufficient — and roughly halves the per-verb
+        client-side cost."""
+        if conn.sock is None:
+            conn.connect()
+            # Nagle off before the first byte, else each small write
+            # waits out the peer's delayed ACK.
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn._ht_rfile = conn.sock.makefile("rb")
+        method = "POST" if data is not None else "GET"
+        req = [f"{method} {path} HTTP/1.1",
+               f"Host: {conn.host}:{conn.port}"]
+        req += [f"{k}: {v}" for k, v in headers.items()]
+        if data is not None:
+            req.append(f"Content-Length: {len(data)}")
+        buf = ("\r\n".join(req) + "\r\n\r\n").encode("latin-1")
+        if data:
+            buf += data
+        conn.sock.sendall(buf)
+
+        rfile = conn._ht_rfile
+        status_line = rfile.readline(65537)
+        if not status_line:
+            raise _http_client.RemoteDisconnected(
+                "Remote end closed connection without response")
+        parts = status_line.split(None, 2)
+        if len(parts) < 2 or not parts[0].startswith(b"HTTP/"):
+            raise _http_client.BadStatusLine(
+                status_line.decode("latin-1", "replace"))
+        status = int(parts[1])
+        keep = parts[0] == b"HTTP/1.1"
+        length = None
+        while True:
+            line = rfile.readline(65537)
+            if line in (b"\r\n", b"\n", b""):
+                break
+            k, _, v = line.partition(b":")
+            k = k.strip().lower()
+            if k == b"content-length":
+                length = int(v.strip())
+            elif k == b"connection":
+                keep = keep and v.strip().lower() != b"close"
+        if length is None:
+            # Both servers always frame with Content-Length; anything
+            # else is a foreign endpoint we cannot safely keep alive.
+            raise _http_client.BadStatusLine("response without Content-Length")
+        body = rfile.read(length) if length else b""
+        if length and len(body) < length:
+            raise _http_client.IncompleteRead(body, length - len(body))
+        return status, body, keep
+
+    @staticmethod
+    def _transport_error(e):
+        # http.client's protocol errors (BadStatusLine,
+        # CannotSendRequest, RemoteDisconnected-as-HTTPException
+        # shapes) are not all OSError; fold them into URLError so the
+        # caller's ``except (URLError, OSError, ...)`` clause sees one
+        # shape, exactly like urlopen reported them.
+        if isinstance(e, OSError):
+            return e
+        return URLError(e)
+
+    def _checkin(self, key, conn):
+        evicted = []
+        if self.size:
+            with self._lock:
+                idle = self._idle.pop(key, [])
+                self._idle[key] = idle      # re-insert: LRU touch
+                if len(idle) < self.size:
+                    idle.append(conn)
+                    conn = None
+                    while len(self._idle) > self._HOST_CAP:
+                        oldest = next(iter(self._idle))
+                        evicted.extend(self._idle.pop(oldest))
+            if conn is not None:
+                _metrics.registry().counter("rpc.pool.evicted").inc()
+        for c in evicted:
+            _metrics.registry().counter("rpc.pool.evicted").inc()
+            c.close()
+        if conn is not None:
+            conn.close()
+
+    def close_all(self):
+        with self._lock:
+            idle_lists, self._idle = list(self._idle.values()), {}
+        for conns in idle_lists:
+            for c in conns:
+                c.close()
+
+
+_POOL: _ConnectionPool | None = None
+_POOL_LOCK = threading.Lock()
+
+
+def _rpc_pool() -> _ConnectionPool:
+    """Process-global pool, rebuilt when the env knob changes (the A/B
+    bench toggles ``HYPEROPT_TPU_RPC_POOL`` between arms; the replaced
+    pool's idle sockets are closed)."""
+    global _POOL
+    size = max(0, int(os.environ.get(_POOL_ENV, "8") or "8"))
+    pool = _POOL
+    if pool is not None and pool.size == size:
+        return pool
+    with _POOL_LOCK:
+        pool = _POOL
+        if pool is None or pool.size != size:
+            if pool is not None:
+                pool.close_all()
+            pool = _POOL = _ConnectionPool(size)
+    return pool
+
 
 class _Rpc:
-    """One-POST-per-call JSON client (stdlib urllib; connection reuse is not
-    worth a dependency at this call volume).
+    """Pooled keep-alive JSON client (one logical POST per call; the
+    socket persists across calls via :class:`_ConnectionPool`).
 
     Transport failures (socket refused/reset/timeout, i.e. ``URLError``
     without an HTTP reply) are retried up to ``retries`` times with
@@ -959,7 +1525,8 @@ class _Rpc:
         self._jitter = random.Random(
             zlib.crc32(f"{self.url}|{exp_key}".encode()))
 
-    def __call__(self, verb: str, **kw) -> dict:
+    def __call__(self, verb: str, _timeout: float | None = None,
+                 **kw) -> dict:
         kw.update(verb=verb, exp_key=self.exp_key)
         if verb in _MUTATING_VERBS and "idem" not in kw:
             # One key per logical call, shared by every retry of it.
@@ -980,25 +1547,29 @@ class _Rpc:
         if self.token is not None:
             headers["X-Netstore-Token"] = self.token
         data = json.dumps(kw).encode()
+        timeout = self.timeout
+        if _timeout is not None:
+            # Long-poll verbs park server-side for their wait budget;
+            # the HTTP read timeout must outlive it.
+            timeout = max(timeout, float(_timeout))
         attempts = 0
         t_start = time.perf_counter()
         while True:
             try:
                 _faults.maybe_fail("rpc.send", verb=verb)
-                req = Request(self.url, data=data, headers=headers)
-                with urlopen(req, timeout=self.timeout) as resp:
-                    raw = resp.read()
-                _faults.maybe_fail("rpc.recv", verb=verb)
-                out = json.loads(raw)
-                break
-            except HTTPError as e:
+                status, raw = _rpc_pool().request(self.url, data,
+                                                  headers, timeout)
+                if status == 200:
+                    _faults.maybe_fail("rpc.recv", verb=verb)
+                    out = json.loads(raw)
+                    break
                 # Non-2xx (500 server fault, 401 auth) carries the JSON
                 # error body; surface it as the RuntimeError the callers
                 # expect.  The server DID answer — no retry.
                 try:
-                    out = json.loads(e.read())
+                    out = json.loads(raw)
                 except Exception:
-                    out = {"error": f"HTTP {e.code}"}
+                    out = {"error": f"HTTP {status}"}
                 break
             except (URLError, OSError, InjectedFault) as e:
                 attempts += 1
@@ -1101,7 +1672,22 @@ class NetTrials(Trials):
 
     # -- worker/claim surface (server-side atomicity) ------------------------
 
-    def reserve(self, owner: str):
+    def reserve(self, owner: str, wait_s: float | None = None):
+        """Claim one NEW trial; ``None`` if none is claimable.
+
+        ``wait_s`` > 0 long-polls: the server parks the call on its
+        claim condition variable and answers the moment an insert or
+        requeue makes a doc claimable (or the wait expires), replacing
+        the client-side 100 ms poll loop — one idle RPC per wait budget
+        instead of ten per second.  Default from
+        ``HYPEROPT_TPU_RESERVE_WAIT_S`` (unset/0 = classic immediate
+        answer); the server clamps the park to its own ceiling."""
+        if wait_s is None:
+            wait_s = float(os.environ.get(
+                "HYPEROPT_TPU_RESERVE_WAIT_S", "0") or "0")
+        if wait_s and wait_s > 0:
+            return self._rpc("reserve", owner=owner, wait_s=float(wait_s),
+                             _timeout=float(wait_s) + 10.0)["doc"]
         return self._rpc("reserve", owner=owner)["doc"]
 
     def heartbeat(self, doc, owner=None) -> bool:

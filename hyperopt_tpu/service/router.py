@@ -44,9 +44,7 @@ import os
 import threading
 import time
 
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from urllib.error import HTTPError, URLError
-from urllib.request import Request, urlopen
+from urllib.error import URLError
 
 from .. import faults as _faults
 from ..exceptions import InjectedFault, NetstoreUnavailable
@@ -54,6 +52,7 @@ from ..obs import export as _obs_export
 from ..obs import flight as _flight
 from ..obs import metrics as _metrics
 from ..obs.events import EVENTS
+from ..parallel.netstore import _KeepAliveHTTPServer, _LeanRequestHandler
 from .cluster import ShardMap
 
 logger = logging.getLogger(__name__)
@@ -122,7 +121,14 @@ class Router:
         self._lifecycle_lock = threading.Lock()
         server = self
 
-        class Handler(BaseHTTPRequestHandler):
+        class Handler(_LeanRequestHandler):
+            # Keep-alive edge: the same HTTP/1.1 + Content-Length +
+            # lean-parse contract as the netstore handler, so client
+            # pools hold their router sockets open across verbs; Nagle
+            # off for the same small-reply delayed-ACK stall.
+            protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True
+
             def log_message(self, fmt, *args):      # quiet by default
                 logger.debug("router: " + fmt, *args)
 
@@ -216,7 +222,7 @@ class Router:
                 self._send_json(404, json.dumps(
                     {"error": f"NotFound: {self.path}"}).encode())
 
-        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd = _KeepAliveHTTPServer((host, port), Handler)
         self.host, self.port = self._httpd.server_address[:2]
 
     # -- lifecycle (mirrors StoreServer's idempotent shutdown) ---------------
@@ -299,6 +305,7 @@ class Router:
                     token: str | None):
         """One shard POST with the router's transport-retry budget.
         Counts every attempt; observes per-shard forward latency."""
+        from ..parallel.netstore import _rpc_pool
         reg = _metrics.registry()
         headers = {"Content-Type": "application/json"}
         if token:
@@ -308,14 +315,11 @@ class Router:
             t0 = time.perf_counter()
             try:
                 _faults.maybe_fail("router.forward", verb=verb)
-                request = Request(url, data=raw, headers=headers)
-                try:
-                    with urlopen(request, timeout=self.timeout) as resp:
-                        code, body = resp.status, resp.read()
-                except HTTPError as e:
-                    # The shard answered (auth refusal, verb fault):
-                    # application-level — pass through, never retry.
-                    code, body = e.code, e.read()
+                # Pooled keep-alive upstream: non-2xx means the shard
+                # DID answer (auth refusal, verb fault) — application-
+                # level, passed through un-retried like _Rpc does.
+                code, body = _rpc_pool().request(url, raw, headers,
+                                                 self.timeout)
                 dt = time.perf_counter() - t0
                 reg.counter("router.forwarded").inc()
                 reg.histogram("router.forward.s").observe(dt)
@@ -451,12 +455,14 @@ class Router:
     # -- fleet-merged metrics -------------------------------------------------
 
     def _fetch_shard_metrics(self, url: str) -> dict:
+        from ..parallel.netstore import _rpc_pool
         _faults.maybe_fail("rpc.send", verb="metrics", url=url)
-        request = Request(f"{url}/metrics",
-                          headers=({"X-Netstore-Token": self._token}
-                                   if self._token else {}))
-        with urlopen(request, timeout=min(self.timeout, 5.0)) as resp:
-            return json.loads(resp.read())
+        headers = ({"X-Netstore-Token": self._token}
+                   if self._token else {})
+        _status, body = _rpc_pool().request(f"{url}/metrics", None,
+                                            headers,
+                                            min(self.timeout, 5.0))
+        return json.loads(body)
 
     def metrics_payload(self) -> dict:
         """``GET /metrics``: the router's own snapshot plus a ``router``

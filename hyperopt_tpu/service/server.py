@@ -306,21 +306,30 @@ class ServiceServer(StoreServer):
         exp_key = req.get("exp_key", "default")
         with self._lock:
             t = coarse_utcnow()
+            seq0 = self._wal.seq
             if verb == "suggest":
-                return self._suggest_walled(req, tenant, tname, exp_key,
-                                            idem, t)
-            # Quota gates mirror the base dispatch but run BEFORE the
-            # append — a refused verb must leave no durable trace.
-            if verb == "insert_docs":
-                self._charge_admission(tenant, len(req["docs"]))
-            if verb == "reserve" and self._claims_quota_hit(tenant):
-                return {"doc": None, "quota": "max_claims"}
-            self._wal.append({"t": t, "verb": verb, "tenant": tname,
-                              "exp_key": exp_key, "req": _strip_req(req),
-                              "idem": idem})
-            out = self._execute(verb, req, tenant, t)
-            self._maybe_snapshot()
-            return out
+                out = self._suggest_walled(req, tenant, tname, exp_key,
+                                           idem, t)
+            else:
+                # Quota gates mirror the base dispatch but run BEFORE the
+                # append — a refused verb must leave no durable trace.
+                if verb == "insert_docs":
+                    self._charge_admission(tenant, len(req["docs"]))
+                if verb == "reserve" and self._claims_quota_hit(tenant):
+                    return {"doc": None, "quota": "max_claims"}
+                self._wal.append({"t": t, "verb": verb, "tenant": tname,
+                                  "exp_key": exp_key, "req": _strip_req(req),
+                                  "idem": idem})
+                out = self._execute(verb, req, tenant, t)
+                self._maybe_snapshot()
+            seq = self._wal.seq
+        # Group commit: the ack gate.  Outside the dispatch lock so other
+        # verbs append while the leader's fsync covers this record; a
+        # no-op when group commit is off or nothing was appended
+        # (proposal-only suggest, quota refusals).
+        if seq > seq0:
+            self._wal.wait_durable(seq)
+        return out
 
     def _execute(self, verb: str, req: dict, tenant, t: float) -> dict:
         """Run the verb with the WAL record's clock.  The tenant is
@@ -381,6 +390,7 @@ class ServiceServer(StoreServer):
         """Requeue stale claims *through the WAL dispatch* so replay
         reproduces the janitor's decisions (a peek avoids logging no-op
         passes every period)."""
+        wakes = []
         with self._lock:
             for (tname, exp_key), ft in list(self._trials.items()):
                 now = coarse_utcnow()
@@ -400,6 +410,12 @@ class ServiceServer(StoreServer):
                     logger.info("service janitor: requeued %d stale "
                                 "trial(s) in %s/%r", out["n"],
                                 tname or "-", exp_key)
+                    wakes.append((tname, exp_key))
+        for tname, exp_key in wakes:
+            # Outside the dispatch lock: a woken long-poll reserve
+            # re-dispatches immediately and must not contend with the
+            # janitor still holding it.
+            self._signal_claims(tname, exp_key)
 
     # -- snapshot / recovery -------------------------------------------------
 

@@ -23,6 +23,7 @@ import json
 from typing import List, Optional
 
 from ..base import (
+    COARSE_CLOCK_SLOP_S,
     JOB_STATE_DONE,
     JOB_STATE_ERROR,
     JOB_STATE_NEW,
@@ -202,7 +203,10 @@ class MemTrials(Trials):
                 if doc["state"] != JOB_STATE_RUNNING:
                     continue
                 last = doc.get("refresh_time") or doc.get("book_time") or 0
-                if now - last > timeout:
+                # Both clocks are coarse here, but a beat at second S
+                # and a sweep at S+1 still differ by a full tick after
+                # milliseconds of real silence — same slop as filestore.
+                if now - last > timeout + COARSE_CLOCK_SLOP_S:
                     owner = doc.get("owner")
                     self._claims.pop(doc["tid"], None)
                     doc["state"] = JOB_STATE_NEW

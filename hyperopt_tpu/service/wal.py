@@ -31,6 +31,19 @@ Fsync policy (the throughput knob, DESIGN.md §7):
   death (the OS has the bytes) but a machine crash can lose the tail.
 * ``never``   — leave flushing to the OS; benchmark mode.
 
+Group commit (``HYPEROPT_TPU_WAL_GROUP_COMMIT``, default on; only
+meaningful at ``fsync=always``): append still writes + flushes the
+record under the dispatch lock — log order IS execution order — but the
+per-record ``os.fsync`` moves out of ``append`` into
+:meth:`Wal.wait_durable`, which the server calls *after* releasing the
+dispatch lock and *before* acking the client.  Concurrent waiters elect
+one leader; the leader snapshots the flushed high-water mark, fsyncs
+once, and wakes every waiter whose record the fsync covered.  No verb
+is acked before a covering fsync, so the durability bar is identical to
+inline fsync=always — the cost is amortized N-fold under concurrency
+(``wal.group_size`` histogram).  The leader is always a calling waiter
+thread holding no other lock; no thread is ever spawned.
+
 Snapshot + compaction: ``snapshot()`` atomically writes the full server
 state (every store's ``state_dict`` + the idem cache) tagged with the
 last applied ``seq``, then truncates ``wal.jsonl`` — recovery loads the
@@ -42,6 +55,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import threading
 import time
 
 from .. import faults as _faults
@@ -54,17 +68,22 @@ __all__ = ["Wal", "read_wal", "inspect"]
 _WAL_FILE = "wal.jsonl"
 _SNAP_FILE = "snapshot.json"
 
-#: When set to ``kill``, an injected ``wal.write`` fault escalates to
-#: SIGKILL of the current process — the chaos harness's way of dying
-#: *exactly* at the append boundary, with no Python teardown running.
+#: When set to ``kill``, an injected ``wal.write`` / ``wal.fsync`` fault
+#: escalates to SIGKILL of the current process — the chaos harness's way
+#: of dying *exactly* at the append (or group-commit fsync) boundary,
+#: with no Python teardown running.
 _CRASH_ENV = "HYPEROPT_TPU_WAL_CRASH"
+
+#: ``0``/``off``/``false`` disables group commit (restores the inline
+#: per-append fsync under fsync=always); anything else keeps it on.
+_GROUP_ENV = "HYPEROPT_TPU_WAL_GROUP_COMMIT"
 
 
 class Wal:
     """Appender half: owns the open ``wal.jsonl`` of one server."""
 
     def __init__(self, root: str, fsync: str = "always",
-                 batch_every: int = 64):
+                 batch_every: int = 64, group_commit: bool | None = None):
         if fsync not in ("always", "batch", "never"):
             raise ValueError(f"fsync policy {fsync!r}: "
                              "want always|batch|never")
@@ -72,6 +91,16 @@ class Wal:
         os.makedirs(self.root, exist_ok=True)
         self.fsync = fsync
         self.batch_every = max(1, int(batch_every))
+        if group_commit is None:
+            group_commit = os.environ.get(_GROUP_ENV, "1").lower() \
+                not in ("0", "off", "false")
+        #: Effective only at fsync=always; other policies never block acks
+        #: on an fsync, so there is no commit to group.
+        self.group_commit = bool(group_commit) and fsync == "always"
+        self._sync_cv = threading.Condition()
+        self._flushed_seq = 0    # last seq written+flushed (under _sync_cv)
+        self._synced_seq = 0     # last seq covered by an fsync
+        self._sync_leader = False
         self.path = os.path.join(self.root, _WAL_FILE)
         self.snap_path = os.path.join(self.root, _SNAP_FILE)
         self._fh = open(self.path, "a", encoding="utf-8")
@@ -122,9 +151,15 @@ class Wal:
         self._fh.write(line)
         self._fh.flush()
         self._since_sync += 1
-        if self.fsync == "always" or (self.fsync == "batch"
-                                      and self._since_sync
-                                      >= self.batch_every):
+        if self.group_commit:
+            # fsync=always with group commit: the covering fsync happens
+            # in wait_durable (leader-elected, after the dispatch lock is
+            # released) — the verb is not acked until it runs.
+            with self._sync_cv:
+                self._flushed_seq = self.seq
+        elif self.fsync == "always" or (self.fsync == "batch"
+                                        and self._since_sync
+                                        >= self.batch_every):
             os.fsync(self._fh.fileno())
             self._since_sync = 0
             self._last_fsync_mono = time.monotonic()
@@ -140,24 +175,104 @@ class Wal:
             self.listener(rec)
         return self.seq
 
+    def wait_durable(self, seq: int) -> None:
+        """Block until every record at or below ``seq`` is covered by an
+        fsync (group-commit mode; a no-op otherwise).  Exactly one
+        concurrent waiter at a time is elected leader and fsyncs once
+        for the whole flushed batch; everyone whose record the fsync
+        covered returns.  Call with NO other lock held — the leader's
+        fsync would otherwise serialize the very verbs it amortizes."""
+        if not self.group_commit:
+            return
+        while True:
+            with self._sync_cv:
+                while self._synced_seq < seq and self._sync_leader:
+                    self._sync_cv.wait()
+                if self._synced_seq >= seq:
+                    return
+                self._sync_leader = True
+                hwm = self._flushed_seq
+            self._leader_fsync(hwm)
+
+    def _leader_fsync(self, hwm: int) -> None:
+        """One covering fsync for every record flushed at or below
+        ``hwm``; wakes all waiters.  Runs outside ``_sync_cv`` so
+        followers can enqueue while the disk syncs.  On an injected
+        ``wal.fsync`` fault, leadership is handed back (a later waiter
+        re-elects and fsyncs the still-flushed batch) and the fault
+        propagates to the waiter being acked."""
+        try:
+            try:
+                _faults.maybe_fail("wal.fsync")
+            except InjectedFault:
+                if os.environ.get(_CRASH_ENV) == "kill":
+                    # Die at the group-commit boundary: records are
+                    # flushed but no covering fsync ran, and no waiter
+                    # has been acked — the chaos suite's probe that an
+                    # un-acked batch never half-applies.
+                    if self.crash_hook is not None:
+                        try:
+                            self.crash_hook()
+                        except Exception:  # noqa: BLE001 - dying anyway
+                            pass
+                    _flight.dump("wal-crash", force=True,
+                                 extra={"trigger": "wal_fsync_crash"})
+                    os.kill(os.getpid(), signal.SIGKILL)
+                raise
+            os.fsync(self._fh.fileno())
+        except BaseException:
+            with self._sync_cv:
+                self._sync_leader = False
+                self._sync_cv.notify_all()
+            raise
+        now = time.monotonic()
+        reg = _metrics.registry()
+        with self._sync_cv:
+            covered = hwm - self._synced_seq
+            self._synced_seq = max(self._synced_seq, hwm)
+            self._sync_leader = False
+            self._since_sync = 0
+            self._last_fsync_mono = now
+            self._sync_cv.notify_all()
+        reg.counter("wal.fsyncs").inc()
+        reg.histogram("wal.group_size").observe(max(covered, 0))
+        reg.gauge("wal.fsync_lag_s").set(0.0)
+
     def snapshot(self, payload: dict) -> None:
         """Atomically persist ``payload`` (stamped with the current seq)
         and truncate the log — records at or below ``seq`` are folded in.
         """
-        payload = dict(payload, seq=self.seq, t_wall=time.time())
-        tmp = f"{self.snap_path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(payload, f, sort_keys=True)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.snap_path)
-        # Compaction: everything the snapshot covers leaves the log.
-        self._fh.close()
-        self._fh = open(self.path, "w", encoding="utf-8")
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
-        self._since_sync = 0
-        _metrics.registry().counter("wal.snapshots").inc()
+        # Take group-commit leadership for the truncation window so an
+        # in-flight leader never fsyncs a file handle we are replacing.
+        if self.group_commit:
+            with self._sync_cv:
+                while self._sync_leader:
+                    self._sync_cv.wait()
+                self._sync_leader = True
+        try:
+            payload = dict(payload, seq=self.seq, t_wall=time.time())
+            tmp = f"{self.snap_path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f, sort_keys=True)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.snap_path)
+            # Compaction: everything the snapshot covers leaves the log.
+            self._fh.close()
+            self._fh = open(self.path, "w", encoding="utf-8")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._since_sync = 0
+            _metrics.registry().counter("wal.snapshots").inc()
+        finally:
+            if self.group_commit:
+                # The snapshot durably covers every record it folded in.
+                with self._sync_cv:
+                    self._flushed_seq = max(self._flushed_seq, self.seq)
+                    self._synced_seq = max(self._synced_seq, self.seq)
+                    self._sync_leader = False
+                    self._last_fsync_mono = time.monotonic()
+                    self._sync_cv.notify_all()
 
     def close(self) -> None:
         try:

@@ -419,6 +419,21 @@ def render_live(snap: dict, out=None, prev=None) -> dict:
               + counters.get("store.heartbeat.fenced", 0))
     print(f"faults injected {faults}   requeued {requeued}   "
           f"fenced {fenced}", file=out)
+    pool_hits = counters.get("rpc.pool.hits", 0)
+    pool_misses = counters.get("rpc.pool.misses", 0)
+    if pool_hits or pool_misses:
+        total = pool_hits + pool_misses
+        print(f"pool: {int(pool_hits)}/{int(total)} reused "
+              f"({pool_hits / total:.0%})   stale reconnects "
+              f"{int(counters.get('rpc.pool.stale_reconnects', 0))}   "
+              f"evicted {int(counters.get('rpc.pool.evicted', 0))}",
+              file=out)
+    parked = counters.get("store.longpoll.parked", 0)
+    if parked:
+        print(f"longpoll: parked {int(parked)}   woken "
+              f"{int(counters.get('store.longpoll.woken', 0))}   timeouts "
+              f"{int(counters.get('store.longpoll.timeouts', 0))}",
+              file=out)
 
     # Per-verb server-side latency tails (+ merged client-side RPC time).
     hists = dict(snap.get("histograms", {}))

@@ -364,6 +364,63 @@ def test_lk003_check_then_act_fires_locked_and_caller_holds_silent():
     assert not rules_fired(run_checker("lock-order", documented), "LK003")
 
 
+def test_lk001_dispatch_then_gate_registry_order_pins():
+    # The long-poll claim path nests the gate-registry lock inside the
+    # dispatch lock; a helper taking them in the opposite order is the
+    # classic two-thread deadlock.
+    bad = {"hyperopt_tpu/fx.py": (
+        "import threading\n"
+        "class Srv:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n"
+        "        self._claim_gates_lock = threading.Lock()\n"
+        "    def dispatch(self):\n"
+        "        with self._lock:\n"
+        "            with self._claim_gates_lock:\n"
+        "                pass\n"
+        "    def sweep(self):\n"
+        "        with self._claim_gates_lock:\n"
+        "            with self._lock:\n"
+        "                pass\n")}
+    ok = {"hyperopt_tpu/fx.py": (
+        "import threading\n"
+        "class Srv:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.RLock()\n"
+        "        self._claim_gates_lock = threading.Lock()\n"
+        "    def dispatch(self):\n"
+        "        with self._lock:\n"
+        "            with self._claim_gates_lock:\n"
+        "                pass\n"
+        "    def sweep(self):\n"
+        "        with self._lock:\n"
+        "            with self._claim_gates_lock:\n"
+        "                pass\n")}
+    assert rules_fired(run_checker("lock-order", bad), "LK001")
+    assert not rules_fired(run_checker("lock-order", ok), "LK001")
+
+
+def test_lk002_pool_idle_list_write_needs_checkout_lock():
+    # The connection pool's idle lists are module-shared state: a
+    # check-in that appends without the checkout lock races concurrent
+    # checkouts.
+    bad = {"hyperopt_tpu/fx.py": (
+        "import threading\n"
+        "_POOL_LOCK = threading.Lock()\n"
+        "_IDLE = {}\n"
+        "def checkin(key, conn):\n"
+        "    _IDLE[key] = conn\n")}
+    ok = {"hyperopt_tpu/fx.py": (
+        "import threading\n"
+        "_POOL_LOCK = threading.Lock()\n"
+        "_IDLE = {}\n"
+        "def checkin(key, conn):\n"
+        "    with _POOL_LOCK:\n"
+        "        _IDLE[key] = conn\n")}
+    assert rules_fired(run_checker("lock-order", bad), "LK002")
+    assert not rules_fired(run_checker("lock-order", ok), "LK002")
+
+
 # ---------------------------------------------------------------------------
 # RD — registry drift
 # ---------------------------------------------------------------------------
@@ -771,6 +828,58 @@ def test_wp006_contradiction_and_stale_declaration_fire():
     assert not rules_fired(run_checker("wire-protocol", ok), "WP006")
 
 
+def test_wp007_mutating_readonly_verb_fires_and_pure_read_silent():
+    # "peek" reads; "zap" mutates durable state.  Declaring the mutator
+    # read-only puts it on the lock-free path — that must fire.
+    bad = _wp(
+        _WP_SRV_PREAMBLE +
+        "_READONLY_VERBS = frozenset({\"zap\"})\n"
+        "def _dispatch_verb(verb, req, ft):\n"
+        "    if verb == \"zap\":\n"
+        "        ft.zap()\n"
+        "        return {}\n",
+        _WP_IDEM_PROOF + "_IDEMPOTENT_VERBS = frozenset({\"zap\"})\n")
+    ok = _wp(
+        _WP_SRV_PREAMBLE +
+        "_READONLY_VERBS = frozenset({\"peek\"})\n"
+        "def _dispatch_verb(verb, req, ft):\n"
+        "    if verb == \"zap\":\n"
+        "        ft.zap()\n"
+        "        return {}\n"
+        "    if verb == \"peek\":\n"
+        "        return {\"n\": len(ft._docs)}\n",
+        _WP_IDEM_PROOF + "_IDEMPOTENT_VERBS = frozenset({\"zap\"})\n")
+    fired = rules_fired(run_checker("wire-protocol", bad), "WP007")
+    assert fired and "mutates durable store state" in fired[0].message
+    assert not rules_fired(run_checker("wire-protocol", ok), "WP007")
+    # catalog membership also exempts the pure-read arm from WP002
+    assert not rules_fired(run_checker("wire-protocol", ok), "WP002")
+
+
+def test_wp007_contradictory_catalog_and_stale_entry_fire():
+    srv = (_WP_SRV_PREAMBLE +
+           "def _dispatch_verb(verb, req, ft):\n"
+           "    if verb == \"zap\":\n"
+           "        ft.zap()\n"
+           "        return {}\n"
+           "    if verb == \"peek\":\n"
+           "        return {\"n\": len(ft._docs)}\n")
+    proof = _WP_IDEM_PROOF + "_IDEMPOTENT_VERBS = frozenset({\"zap\"})\n"
+    # "peek" is declared retry-convergent AND read-only: contradictory
+    # even though the arm itself is a pure read.
+    contradiction = _wp(
+        srv + "_READONLY_VERBS = frozenset({\"peek\"})\n",
+        _WP_IDEM_PROOF
+        + "_IDEMPOTENT_VERBS = frozenset({\"zap\", \"peek\"})\n")
+    stale = _wp(
+        srv + "_READONLY_VERBS = frozenset({\"peek\", \"ghost\"})\n", proof)
+    fired = rules_fired(run_checker("wire-protocol", contradiction),
+                        "WP007")
+    assert any("contradict" in f.message for f in fired)
+    fired = rules_fired(run_checker("wire-protocol", stale), "WP007")
+    assert any("stale catalog entry" in f.message for f in fired)
+
+
 # ---------------------------------------------------------------------------
 # RT — replay determinism
 # ---------------------------------------------------------------------------
@@ -969,6 +1078,30 @@ def test_es003_thread_starting_ctor_under_lock_fires():
     assert not rules_fired(run_checker("exception-safety", ok), "ES003")
 
 
+def test_es003_group_commit_leader_runs_in_waiter_not_new_thread():
+    # Group commit elects a *calling* waiter as fsync leader precisely
+    # so no thread is ever spawned under the sync condvar; the rejected
+    # design (dedicated flusher started under the cv) is the fixture's
+    # bad half.
+    bad = _es("import threading\n"
+              "class Wal:\n"
+              "    def __init__(self):\n"
+              "        self._sync_cv = threading.Condition()\n"
+              "    def wait_durable(self, seq):\n"
+              "        with self._sync_cv:\n"
+              "            threading.Thread(target=self._flush).start()\n")
+    ok = _es("import threading\n"
+             "class Wal:\n"
+             "    def __init__(self):\n"
+             "        self._sync_cv = threading.Condition()\n"
+             "    def wait_durable(self, seq):\n"
+             "        with self._sync_cv:\n"
+             "            hwm = self._flushed_seq\n"
+             "        self._leader_fsync(hwm)\n")
+    assert rules_fired(run_checker("exception-safety", bad), "ES003")
+    assert not rules_fired(run_checker("exception-safety", ok), "ES003")
+
+
 # ---------------------------------------------------------------------------
 # FP — fault-point coverage
 # ---------------------------------------------------------------------------
@@ -988,6 +1121,28 @@ def test_fp001_bare_urlopen_fires_and_hooked_silent():
         "        return r.read()\n")}
     assert rules_fired(run_checker("fault-coverage", bad), "FP001")
     assert not rules_fired(run_checker("fault-coverage", ok), "FP001")
+
+
+def test_fp001_bare_pooled_transport_fires_and_hooked_silent():
+    # The pooled keep-alive transport replaced urlopen on the hot path:
+    # a call site checking a connection out of the pool is wire I/O and
+    # needs the same hook.  The pool's own internals never call
+    # ``_rpc_pool`` so they stay exempt — the hooks live at call sites.
+    bad = {"hyperopt_tpu/net.py": (
+        "def send(url, data):\n"
+        "    return _rpc_pool().request(url, data, {}, 10.0)\n")}
+    ok = {"hyperopt_tpu/net.py": (
+        "def send(url, data):\n"
+        "    maybe_fail(\"rpc.send\", url=url)\n"
+        "    return _rpc_pool().request(url, data, {}, 10.0)\n")}
+    internals = {"hyperopt_tpu/net.py": (
+        "class _ConnectionPool:\n"
+        "    def request(self, url, data, headers, timeout):\n"
+        "        return self._roundtrip(url, data, headers, timeout)\n")}
+    assert rules_fired(run_checker("fault-coverage", bad), "FP001")
+    assert not rules_fired(run_checker("fault-coverage", ok), "FP001")
+    assert not rules_fired(run_checker("fault-coverage", internals),
+                           "FP001")
 
 
 def test_fp001_wal_append_without_hook_fires_and_hooked_silent():

@@ -538,6 +538,69 @@ class TestBenchmarkArtifacts:
                 f"{name}: lost or duplicated trials across failover")
             assert head["zero_leakage"] is True, name
 
+    def test_service_hotpath_ab_artifact_schema(self):
+        """ISSUE 18 acceptance artifact: interleaved A/B arms over a
+        multi-tenant service shape at fsync=always — pooled keep-alive
+        RPC, WAL group commit, parallel read dispatch and long-poll
+        claims — with a ≥2.5x aggregate-throughput headline, a
+        fsyncs-per-verb amortization gate, and a chaos arm auditing
+        exactly-once claim/result semantics — written by
+        benchmarks/service_hotpath_ab.py."""
+        paths = sorted(glob.glob(os.path.join(
+            _BENCH_DIR, "service_hotpath_ab_*.json")))
+        assert paths, \
+            "no benchmarks/service_hotpath_ab_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "service_hotpath_ab", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            # the ablation matters: the all-off baseline and the all-on
+            # hotpath arm must both be present, and every arm records
+            # its knob settings plus a per-tenant exactly-once audit
+            arms = {a["arm"]: a for a in doc["arms"]}
+            assert {"baseline", "hotpath"} <= set(arms), name
+            for a in doc["arms"]:
+                assert {"knobs", "wall_s", "verbs_total", "verbs_per_sec",
+                        "fsyncs_per_verb", "connects_per_verb",
+                        "rows"} <= set(a), f"{name}: {sorted(a)}"
+                assert a["verbs_per_sec"] > 0, f"{name}: {a['arm']}"
+                assert a["zero_lost_dup"] is True, f"{name}: {a['arm']}"
+                verbs = {r["verb"] for r in a["rows"]}
+                assert {"reserve", "write_result", "att_keys"} <= verbs, \
+                    f"{name}: {a['arm']}: {sorted(verbs)}"
+                for r in a["rows"]:
+                    assert {"verb", "count", "p50_ms", "p95_ms",
+                            "p99_ms"} <= set(r), f"{name}: {r}"
+                    assert r["count"] > 0, f"{name}: {r}"
+                    assert 0 <= r["p50_ms"] <= r["p95_ms"] <= r["p99_ms"], \
+                        f"{name}: {r}"
+            # the hotpath arm really pooled (connection churn gone) and
+            # really amortized (covering fsyncs, not one per verb)
+            hot = arms["hotpath"]
+            assert hot["connects_per_verb"] < 0.05, name
+            assert hot["fsyncs_per_verb"] < 0.2, name
+            assert hot.get("wal_group_mean", 0) > 1.0, (
+                f"{name}: group commit never batched")
+            # copy-elision probe (suggest hot path) at both cohort sizes
+            cohorts = {p["cohort"] for p in doc["suggest_copy_probe"]}
+            assert {16, 64} <= cohorts, name
+            # chaos arm: heavy injected loss, exactly-once preserved
+            chaos = doc["chaos"]
+            assert chaos["completed"] is True, name
+            assert chaos["zero_lost_dup"] is True, (
+                f"{name}: chaos arm lost or duplicated a tid")
+            assert doc["config"]["chaos_rpc_loss"]["combined"] >= 0.30, (
+                f"{name}: chaos too gentle — "
+                f"{doc['config']['chaos_rpc_loss']} < 0.30 combined RPC loss")
+            head = doc["headline"]
+            assert head["speedup"] >= 2.5, (
+                f"{name}: hotpath speedup {head['speedup']} < 2.5x")
+            assert head["gate_speedup_ge_2p5"] is True, name
+            assert head["gate_fsyncs_per_verb_lt_0p2"] is True, name
+
     def test_algo_zoo_ab_artifact_schema(self):
         """ISSUE 10 acceptance artifact: per-head best-loss sweep over the
         5-domain zoo x 20 seeds through the backend registry, with

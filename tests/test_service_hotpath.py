@@ -1,0 +1,379 @@
+"""Service hot path: pooled RPC, WAL group commit, read dispatch, long-poll.
+
+The four layers the hot-path overhaul touched, each tested at its
+sharpest edge:
+
+* **Group commit** — a real server process SIGKILLed at the covering
+  ``wal.fsync`` boundary (records flushed, batch un-acked) replays with
+  zero lost and zero duplicated tids.
+* **Connection pool** — a keep-alive socket severed by a server restart
+  is redialed transparently: the verb succeeds with ``retries=0`` (the
+  reconnect burns no retry budget) and ``rpc.pool.stale_reconnects``
+  counts it.
+* **Long-poll claims** — ``reserve(wait_s=...)`` parks server-side and
+  wakes on insert, on a janitor requeue, and on a freed claims-quota
+  slot (quota re-runs at wake); an empty store times out with the
+  ``store.longpoll.*`` counters telling the story.
+* **Read dispatch** — read verbs answer while the write lock is held
+  (a mutating verb's fsync in progress), and the
+  ``HYPEROPT_TPU_READ_DISPATCH=0`` arm stays correct.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from hyperopt_tpu import base
+from hyperopt_tpu.base import JOB_STATE_DONE, JOB_STATE_NEW, \
+    JOB_STATE_RUNNING, STATUS_OK
+from hyperopt_tpu.exceptions import NetstoreUnavailable
+from hyperopt_tpu.obs import metrics as _metrics
+from hyperopt_tpu.parallel.netstore import NetTrials, StoreServer
+from hyperopt_tpu.service import Tenant, TenantTable
+from hyperopt_tpu.service.server import ServiceServer
+
+
+def _counter(name: str) -> float:
+    return _metrics.registry().snapshot().get("counters", {}).get(name, 0)
+
+
+def _mk_docs(tids, exp_key, xs):
+    docs = []
+    for tid, x in zip(tids, xs):
+        d = base.new_trial_doc(tid, exp_key, None)
+        d["misc"]["idxs"] = {"x": [tid]}
+        d["misc"]["vals"] = {"x": [float(x)]}
+        docs.append(d)
+    return docs
+
+
+def _complete(doc, loss):
+    doc["state"] = JOB_STATE_DONE
+    doc["result"] = {"status": STATUS_OK, "loss": float(loss)}
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# group commit: SIGKILL at the covering fsync, replay loses nothing
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestGroupCommitKillDurability:
+    def test_sigkill_at_group_fsync_zero_lost_or_duplicated(
+            self, tmp_path, monkeypatch):
+        """Kill a real server process at the group-commit ``wal.fsync``
+        boundary (records written + flushed, covering fsync never ran,
+        NO waiter acked — the exact window group commit introduces).  A
+        fresh server on the same WAL dir must replay to a store with
+        zero lost and zero duplicated tids, and the run completes."""
+        monkeypatch.setenv("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.01")
+        wal_dir = str(tmp_path / "wal")
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu",
+                   HYPEROPT_TPU_WAL_CRASH="kill",
+                   HYPEROPT_TPU_WAL_GROUP_COMMIT="1",
+                   # Leader-fsync draws, one per sequential verb:
+                   # 1 new_trial_ids, 2 insert_docs, then (reserve,
+                   # write) pairs -> the 8th draw is the covering fsync
+                   # of the third write_result.  @7 = fire there.
+                   HYPEROPT_TPU_FAULTS="wal.fsync=1.0:1@7")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "hyperopt_tpu.service.server",
+             "--serve", "--wal-dir", wal_dir, "--token", "tok",
+             "--fsync", "always"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            url = None
+            deadline = time.time() + 45
+            while time.time() < deadline:
+                line = proc.stdout.readline()
+                if "service: serving" in line:
+                    url = line.rsplit(" at ", 1)[1].strip()
+                    break
+                if proc.poll() is not None:
+                    pytest.fail(f"server died on startup: "
+                                f"{proc.stdout.read()}")
+            assert url, "server never printed its URL"
+
+            nt = NetTrials(url, exp_key="e1", token="tok", retries=2,
+                           refresh=False)
+            tids = nt.new_trial_ids(4)
+            assert tids == [0, 1, 2, 3]
+            nt._insert_trial_docs(_mk_docs(tids, "e1",
+                                           [0.1, 0.2, 0.3, 0.4]))
+            crashed = False
+            completed = []
+            try:
+                for _ in range(4):
+                    doc = nt.reserve("w0")
+                    assert nt.write_result(_complete(doc, 1.0),
+                                           owner="w0")
+                    completed.append(doc["tid"])
+            except NetstoreUnavailable:
+                crashed = True
+            assert crashed, "fault schedule never killed the server"
+            assert proc.wait(timeout=20) == -signal.SIGKILL
+            assert len(completed) == 2    # third ack cut at its fsync
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            proc.stdout.close()
+
+        # replay on the same WAL dir (this process has no faults armed)
+        srv = ServiceServer(wal_dir, token="tok")
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="tok")
+            nt.refresh()
+            seen = [d["tid"] for d in nt._dynamic_trials]
+            assert sorted(seen) == [0, 1, 2, 3]          # zero lost
+            assert len(seen) == len(set(seen))           # zero duplicated
+            by_tid = {d["tid"]: d for d in nt._dynamic_trials}
+            # Every ACKED write survived the kill: group commit must
+            # never acknowledge a record its covering fsync did not run
+            # for... unless the record was flushed anyway — losing an
+            # *acked* one is the only durability violation.
+            for t in completed:
+                assert by_tid[t]["state"] == JOB_STATE_DONE
+            # Finish the run: un-acked writes may or may not have
+            # reached the log (both are legal at a kill) — drain
+            # whatever replay left RUNNING or NEW.
+            for d in nt._dynamic_trials:
+                if d["state"] == JOB_STATE_RUNNING:
+                    assert nt.write_result(_complete(dict(d), 1.0),
+                                           owner=d["owner"])
+            while True:
+                doc = nt.reserve("w1")
+                if doc is None:
+                    break
+                assert nt.write_result(_complete(doc, 1.0), owner="w1")
+            nt.refresh()
+            assert all(d["state"] == JOB_STATE_DONE
+                       for d in nt._dynamic_trials)
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# connection pool: stale keep-alive socket redialed transparently
+# ---------------------------------------------------------------------------
+
+
+class TestPoolStaleReconnect:
+    def test_severed_keepalive_redials_without_burning_retries(
+            self, tmp_path, monkeypatch):
+        """Restarting the server severs every pooled keep-alive socket.
+        The next verb checks out the dead connection, hits the stale
+        path, and must succeed on ONE transparent redial: ``retries=0``
+        proves the reconnect consumed none of the caller's budget, and
+        ``rpc.pool.stale_reconnects`` counts exactly one."""
+        monkeypatch.setenv("HYPEROPT_TPU_RPC_POOL", "8")
+        root = str(tmp_path / "store")
+        srv = StoreServer(root)
+        host, port = srv.start()
+        nt = NetTrials(srv.url, exp_key="e", retries=0, refresh=False)
+        assert nt.new_trial_ids(1) == [0]    # socket now idles in pool
+        r0 = _counter("rpc.pool.stale_reconnects")
+        h0 = _counter("rpc.pool.hits")
+        srv.shutdown()
+
+        srv2 = StoreServer(root, host=host, port=port)
+        srv2.start()
+        try:
+            assert nt.new_trial_ids(1) == [1]
+            assert _counter("rpc.pool.stale_reconnects") == r0 + 1
+            # The dead socket WAS a pool hit — reuse was attempted,
+            # then repaired, invisibly to the retry loop above.
+            assert _counter("rpc.pool.hits") == h0 + 1
+            # The repaired connection pooled: the next verb reuses it
+            # with no further reconnects.
+            assert nt.new_trial_ids(1) == [2]
+            assert _counter("rpc.pool.stale_reconnects") == r0 + 1
+        finally:
+            srv2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# long-poll claims
+# ---------------------------------------------------------------------------
+
+
+class TestLongPollClaims:
+    def test_parked_reserve_wakes_on_insert(self, tmp_path):
+        srv = StoreServer(str(tmp_path / "store"))
+        srv.start()
+        try:
+            nt_w = NetTrials(srv.url, exp_key="e", refresh=False)
+            nt_d = NetTrials(srv.url, exp_key="e", refresh=False)
+            p0 = _counter("store.longpoll.parked")
+            w0 = _counter("store.longpoll.woken")
+            got = {}
+
+            def worker():
+                got["doc"] = nt_w.reserve("w0", wait_s=10.0)
+                got["t"] = time.monotonic()
+
+            t = threading.Thread(target=worker)
+            t.start()
+            # Wait until the reserve is parked server-side, then feed it.
+            deadline = time.monotonic() + 5
+            while (_counter("store.longpoll.parked") < p0 + 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert _counter("store.longpoll.parked") == p0 + 1
+            t_ins = time.monotonic()
+            nt_d._insert_trial_docs(_mk_docs([0], "e", [0.5]))
+            t.join(timeout=10)
+            assert not t.is_alive()
+            assert got["doc"] is not None and got["doc"]["tid"] == 0
+            # Woken by the insert's signal, not by poll cadence or the
+            # wait deadline: the claim lands promptly after the insert.
+            assert got["t"] - t_ins < 5.0
+            assert _counter("store.longpoll.woken") == w0 + 1
+        finally:
+            srv.shutdown()
+
+    def test_empty_store_times_out_with_counter(self, tmp_path,
+                                                monkeypatch):
+        """No claimable work in the window -> None after ~wait_s, and
+        the env default (``HYPEROPT_TPU_RESERVE_WAIT_S``) arms the
+        long poll without a per-call opt-in."""
+        monkeypatch.setenv("HYPEROPT_TPU_RESERVE_WAIT_S", "0.4")
+        srv = StoreServer(str(tmp_path / "store"))
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e", refresh=False)
+            x0 = _counter("store.longpoll.timeouts")
+            t0 = time.monotonic()
+            assert nt.reserve("w0") is None      # wait_s from the env
+            elapsed = time.monotonic() - t0
+            assert 0.35 <= elapsed < 5.0
+            assert _counter("store.longpoll.timeouts") == x0 + 1
+        finally:
+            srv.shutdown()
+
+    def test_janitor_requeue_wakes_parked_reserve(self, tmp_path):
+        """A worker dies holding the only claim; a parked long-poll
+        reserve from its replacement wakes when the janitor sweep
+        requeues the stale claim — no client-side polling anywhere."""
+        srv = StoreServer(str(tmp_path / "store"),
+                          requeue_stale_every=0.05, stale_timeout=0.25)
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e", refresh=False)
+            nt._insert_trial_docs(_mk_docs([0], "e", [0.5]))
+            dead = nt.reserve("w-dead")
+            assert dead is not None and dead["tid"] == 0
+            t0 = time.monotonic()
+            doc = nt.reserve("w-live", wait_s=15.0)
+            elapsed = time.monotonic() - t0
+            assert doc is not None and doc["tid"] == 0
+            assert doc["owner"] == "w-live"
+            assert elapsed < 10.0
+        finally:
+            srv.shutdown()
+
+    def test_quota_slot_freed_rechecks_at_wake(self, tmp_path):
+        """Claims-quota is re-evaluated at every wake: a tenant at
+        ``max_claims`` parks (not fails), and the ``write_result``
+        that frees the slot hands the parked reserve the next doc."""
+        tt = TenantTable([Tenant("acme", "tok-a", max_claims=1)])
+        srv = StoreServer(str(tmp_path / "store"), tenants=tt)
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e", token="tok-a",
+                           refresh=False)
+            nt._insert_trial_docs(_mk_docs([0, 1], "e", [0.1, 0.2]))
+            d0 = nt.reserve("w0")
+            assert d0 is not None            # tenant now AT max_claims
+            got = {}
+
+            def worker():
+                nt2 = NetTrials(srv.url, exp_key="e", token="tok-a",
+                                refresh=False)
+                got["doc"] = nt2.reserve("w1", wait_s=15.0)
+
+            p0 = _counter("store.longpoll.parked")
+            t = threading.Thread(target=worker)
+            t.start()
+            deadline = time.monotonic() + 5
+            while (_counter("store.longpoll.parked") < p0 + 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert _counter("store.longpoll.parked") == p0 + 1
+            assert nt.write_result(_complete(d0, 1.0), owner="w0")
+            t.join(timeout=10)
+            assert not t.is_alive()
+            assert got["doc"] is not None and got["doc"]["tid"] == 1
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# read dispatch: reads answer while the write lock is held
+# ---------------------------------------------------------------------------
+
+
+class TestReadDispatchUnderWriteStall:
+    def test_docs_answers_while_write_lock_held(self, tmp_path):
+        """Hold the dispatch write lock (a mutating verb's fsync in
+        flight, from the read path's point of view) and prove a
+        ``docs`` read still answers — while a mutating verb stays
+        correctly stuck behind the lock."""
+        srv = StoreServer(str(tmp_path / "store"))
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e", refresh=False)
+            nt._insert_trial_docs(_mk_docs([0], "e", [0.5]))
+
+            done = threading.Event()
+
+            def mutator():
+                nt.new_trial_ids(1)
+                done.set()
+
+            srv._lock.acquire()
+            try:
+                t = threading.Thread(target=mutator)
+                t.start()
+                time.sleep(0.1)
+                t0 = time.monotonic()
+                nt.refresh()                      # the "docs" read verb
+                read_s = time.monotonic() - t0
+                assert [d["tid"] for d in nt._dynamic_trials] == [0]
+                assert read_s < 5.0
+                # The mutating verb is still parked on the lock the
+                # read never touched.
+                assert not done.is_set()
+            finally:
+                srv._lock.release()
+            t.join(timeout=10)
+            assert done.is_set()
+        finally:
+            srv.shutdown()
+
+    def test_read_dispatch_off_arm_stays_correct(self, tmp_path,
+                                                 monkeypatch):
+        """``HYPEROPT_TPU_READ_DISPATCH=0`` (the A/B attribution arm)
+        restores reads-queue-on-the-write-lock and must agree with the
+        lock-free path verb for verb."""
+        monkeypatch.setenv("HYPEROPT_TPU_READ_DISPATCH", "0")
+        srv = StoreServer(str(tmp_path / "store"))
+        srv.start()
+        try:
+            assert srv._read_dispatch is False
+            nt = NetTrials(srv.url, exp_key="e", refresh=False)
+            nt._insert_trial_docs(_mk_docs([0, 1], "e", [0.1, 0.2]))
+            nt.refresh()
+            assert [d["tid"] for d in nt._dynamic_trials] == [0, 1]
+            assert all(d["state"] == JOB_STATE_NEW
+                       for d in nt._dynamic_trials)
+        finally:
+            srv.shutdown()

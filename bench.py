@@ -71,6 +71,7 @@ PHASE_DEADLINES = {
     "obs": 300.0,
     "multichip": 600.0,
     "service_hotpath": 600.0,
+    "wire": 600.0,
     "result": 60.0,
 }
 
@@ -755,6 +756,24 @@ def child():
         _say("partial", partial)
     except Exception as e:
         partial["service_hotpath_error"] = f"{type(e).__name__}: {e}"
+        _say("partial", partial)
+
+    # Wire-plane A/B (r19): columnar binary frames + delta fetch vs
+    # JSON — per-verb bytes amortization, interleaved suggest rounds
+    # (proposals must stay bit-identical between arms), and a chaos
+    # arm on the binary frame.  Host-only — no device work.
+    _say("phase", {"name": "wire"})
+    try:
+        from benchmarks.wire_ab import collect as _wire_collect
+
+        wab = _wire_collect(fast=fast)
+        assert wab["suggest"]["proposals_bit_identical"], \
+            "wire arms diverged — proposals not bit-identical"
+        assert wab["chaos"]["zero_lost_dup"], "chaos arm lost/duped a tid"
+        partial["wire"] = wab
+        _say("partial", partial)
+    except Exception as e:
+        partial["wire_error"] = f"{type(e).__name__}: {e}"
         _say("partial", partial)
 
     _say("phase", {"name": "result"})

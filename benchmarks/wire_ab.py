@@ -1,0 +1,403 @@
+"""Wire-plane A/B: columnar binary frames + delta fetch vs JSON.
+
+The ISSUE 19 acceptance harness, in three phases:
+
+**bytes** — the codec measured at the payload shapes the framed verbs
+actually carry (a bulk ``insert_docs`` request, a full-history ``docs``
+reply, a ``fetch_since`` delta, a replica ``wal_ship`` batch), each at
+several batch sizes: JSON bytes vs frame bytes, per-trial.  The frame's
+fixed header amortizes across rows — per-trial bytes FALL with batch
+size (the DESIGN.md §7 amortization entry reads this table), and the
+bulk shapes must shrink ≥ 3×.
+
+**suggest** — the hosted serving loop at a 10k-doc history: each round
+lands a batch of completed results, asks the server-side ``suggest``
+verb for proposals, and refreshes the driver's view — exactly one
+suggest round of ``fmin`` against the service.  Two identically-driven
+servers, rounds interleaved arm-by-arm so drift hits both equally:
+
+===========  =========================================================
+json         ``HYPEROPT_TPU_WIRE=json``, columns off — full-doc JSON
+             refresh + the base O(n) history walk per suggest
+binary       ``HYPEROPT_TPU_WIRE=binary`` + hot columns — fetch_since
+             delta refresh + O(Δ) columnar feed into the resident ring
+===========  =========================================================
+
+Same seeds, same churn, same tid schedule: the arms' proposals must be
+**bit-identical** every round, and the binary arm's round p95 must be
+≥ 1.5× better.
+
+**chaos** — the binary frame under the PR 18 loss schedule (25 % send
+× 10 % recv ≈ 32.5 % combined): bulk framed inserts with retries, then
+an exactly-once audit — every tid present exactly once, zero
+``wire.json_fallbacks`` (loss is a transport error, never a frame
+refusal).
+
+Run::
+
+    env JAX_PLATFORMS=cpu python benchmarks/wire_ab.py
+
+Writes ``benchmarks/wire_ab_cpu_<stamp>.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+HISTORY_DOCS = 10_000
+SEED_BATCH = 1_000            # bulk-insert batch while seeding history
+ROUNDS = 14                   # interleaved timed suggest rounds per arm
+CHURN = 8                     # completed results landing per round
+SUGGEST_N = 4                 # proposals per round
+BYTES_BATCHES = (1, 16, 256, 2048, 10_000)
+CHAOS_TRIALS = 192
+SEED = 0
+SEND_P, RECV_P = 0.25, 0.10   # combined loss 1-(.75*.90) = 0.325
+
+ARMS = (
+    {"arm": "json", "wire": "json", "columns": "0"},
+    {"arm": "binary", "wire": "binary", "columns": "1"},
+)
+
+_KNOB_ENVS = ("HYPEROPT_TPU_WIRE", "HYPEROPT_TPU_SERVICE_COLUMNS")
+
+
+def _mk_doc(tid, rng, exp_key="e1"):
+    from hyperopt_tpu import base
+    from hyperopt_tpu.base import JOB_STATE_DONE, STATUS_OK
+
+    d = base.new_trial_doc(tid, exp_key, None)
+    d["misc"]["idxs"] = {"x": [tid]}
+    d["misc"]["vals"] = {"x": [float(rng.uniform(-5, 5))]}
+    d["state"] = JOB_STATE_DONE
+    d["result"] = {"status": STATUS_OK,
+                   "loss": float(rng.uniform(0.0, 25.0))}
+    return d
+
+
+def _mk_domain():
+    from hyperopt_tpu import base, hp
+
+    space = {"x": hp.uniform("x", -5, 5)}
+    return base.Domain(lambda a: a["x"] ** 2, space)
+
+
+def _arm_env(arm):
+    os.environ["HYPEROPT_TPU_WIRE"] = arm["wire"]
+    os.environ["HYPEROPT_TPU_SERVICE_COLUMNS"] = arm["columns"]
+
+
+def _pct(sorted_s, q):
+    if not sorted_s:
+        return None
+    i = min(len(sorted_s) - 1, int(round(q * (len(sorted_s) - 1))))
+    return sorted_s[i]
+
+
+# ---------------------------------------------------------------------------
+# phase 1: codec bytes per trial, amortization over batch size
+# ---------------------------------------------------------------------------
+
+
+def _bytes_phase(batches):
+    """JSON vs frame bytes for each framed verb's real payload shape."""
+    import numpy as np
+
+    from hyperopt_tpu import wire
+
+    rng = np.random.default_rng(SEED)
+    docs = [_mk_doc(tid, rng) for tid in range(max(batches))]
+
+    def shapes(n):
+        batch = docs[:n]
+        return {
+            "insert_docs": {"verb": "insert_docs", "exp_key": "e1",
+                            "idem": "k" * 16, "docs": batch},
+            "docs": {"docs": batch},
+            "fetch_since": {"docs": batch, "cursor": [7, n], "full": False},
+            "wal_ship": {"verb": "wal_ship", "records": [
+                {"seq": i, "verb": "write_result", "store": "e1",
+                 "req": {"doc": d}} for i, d in enumerate(batch)]},
+        }
+
+    rows = []
+    for n in batches:
+        for verb, payload in shapes(n).items():
+            jb = len(json.dumps(payload, separators=(",", ":")).encode())
+            fb = len(wire.encode(payload))
+            rows.append({
+                "verb": verb, "batch": n,
+                "json_bytes": jb, "frame_bytes": fb,
+                "json_bytes_per_trial": round(jb / n, 1),
+                "frame_bytes_per_trial": round(fb / n, 1),
+                "ratio": round(jb / fb, 2),
+            })
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# phase 2: interleaved suggest rounds at a 10k-doc history
+# ---------------------------------------------------------------------------
+
+
+class _Arm:
+    """One server + driver pair, fed the same schedule as its twin."""
+
+    def __init__(self, arm, history, fast):
+        import numpy as np
+
+        from hyperopt_tpu.parallel.netstore import NetTrials
+        from hyperopt_tpu.service.server import ServiceServer
+
+        self.cfg = arm
+        _arm_env(arm)
+        self.rng = np.random.default_rng(SEED)
+        self.wal_dir = tempfile.mkdtemp(prefix=f"wire_{arm['arm']}_")
+        self.srv = ServiceServer(self.wal_dir, token="t", fsync="never")
+        self.srv.start()
+        self.nt = NetTrials(self.srv.url, exp_key="e1", token="t",
+                            refresh=False)
+        self.nt.save_domain(_mk_domain())
+        for start in range(0, history, SEED_BATCH):
+            stop = min(start + SEED_BATCH, history)
+            self.nt._insert_trial_docs(
+                [_mk_doc(t, self.rng) for t in range(start, stop)])
+        self.tid0 = 10 * history
+        self.times = []
+        # one warm-up round per arm: compiles the kernel outside the
+        # timed region (both arms share the cached compile anyway)
+        self._round(warm=True)
+
+    def _round(self, warm=False):
+        _arm_env(self.cfg)
+        churn = [_mk_doc(t, self.rng)
+                 for t in range(self.tid0, self.tid0 + CHURN)]
+        self.tid0 += CHURN
+        new_ids = list(range(self.tid0, self.tid0 + SUGGEST_N))
+        self.tid0 += SUGGEST_N
+        seed = int(self.rng.integers(2 ** 31 - 1))
+        t0 = time.perf_counter()
+        self.nt._insert_trial_docs(churn)
+        docs = self.nt.suggest(seed, new_ids=new_ids, insert=False,
+                               n_startup_jobs=4)
+        self.nt.refresh()
+        dt = time.perf_counter() - t0
+        if not warm:
+            self.times.append(dt)
+        # proposals ride the churned rng too so later rounds stay aligned
+        done = []
+        for d in json.loads(json.dumps(docs)):
+            d["state"] = 2
+            d["result"] = {"status": "ok",
+                           "loss": float(d["misc"]["vals"]["x"][0] ** 2)}
+            done.append(d)
+        self.nt._insert_trial_docs(done)
+        return docs
+
+    def row(self):
+        ts = sorted(self.times)
+        return {
+            "arm": self.cfg["arm"],
+            "knobs": {"wire": self.cfg["wire"],
+                      "columns": self.cfg["columns"]},
+            "rounds": len(ts),
+            "round_p50_ms": round(1e3 * _pct(ts, 0.50), 2),
+            "round_p95_ms": round(1e3 * _pct(ts, 0.95), 2),
+            "round_mean_ms": round(1e3 * sum(ts) / len(ts), 2),
+        }
+
+    def shutdown(self):
+        self.srv.shutdown()
+
+
+def _suggest_phase(history, rounds):
+    from hyperopt_tpu.obs import metrics as _metrics
+
+    _metrics.registry().snapshot(reset=True)
+    arms = [_Arm(a, history, fast=history < HISTORY_DOCS) for a in ARMS]
+    identical = True
+    try:
+        for _ in range(rounds):
+            proposals = [a._round() for a in arms]
+            if json.dumps(proposals[0], sort_keys=True) != \
+                    json.dumps(proposals[1], sort_keys=True):
+                identical = False
+        counters = _metrics.registry().snapshot().get("counters", {})
+        rows = [a.row() for a in arms]
+    finally:
+        for a in arms:
+            a.shutdown()
+    by = {r["arm"]: r for r in rows}
+    return {
+        "history_docs": history,
+        "rounds": rounds,
+        "churn_per_round": CHURN,
+        "arms": rows,
+        "proposals_bit_identical": identical,
+        "p95_speedup": round(by["json"]["round_p95_ms"]
+                             / by["binary"]["round_p95_ms"], 2),
+        "p50_speedup": round(by["json"]["round_p50_ms"]
+                             / by["binary"]["round_p50_ms"], 2),
+        "counters": {
+            "wire.frames": int(counters.get("wire.frames", 0)),
+            "wire.bytes_tx": int(counters.get("wire.bytes_tx", 0)),
+            "wire.bytes_rx": int(counters.get("wire.bytes_rx", 0)),
+            "wire.json_fallbacks": int(
+                counters.get("wire.json_fallbacks", 0)),
+            "store.delta.rows": int(counters.get("store.delta.rows", 0)),
+            "store.columns.rows": int(
+                counters.get("store.columns.rows", 0)),
+            "store.columns.rebuilds": int(
+                counters.get("store.columns.rebuilds", 0)),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# phase 3: chaos — framed verbs under 32.5 % RPC loss, exactly once
+# ---------------------------------------------------------------------------
+
+
+def _chaos_phase(trials):
+    import numpy as np
+
+    from hyperopt_tpu import faults
+    from hyperopt_tpu.obs import metrics as _metrics
+    from hyperopt_tpu.parallel.netstore import NetTrials
+    from hyperopt_tpu.service.server import ServiceServer
+
+    _arm_env({"wire": "binary", "columns": "1"})
+    _metrics.registry().snapshot(reset=True)
+    rng = np.random.default_rng(SEED)
+    srv = ServiceServer(tempfile.mkdtemp(prefix="wire_chaos_"), token="t")
+    srv.start()
+    nt = NetTrials(srv.url, exp_key="e1", token="t", refresh=False)
+    t0 = time.perf_counter()
+    faults.configure({"rpc.send": SEND_P, "rpc.recv": RECV_P}, seed=SEED)
+    try:
+        for start in range(0, trials, 16):
+            nt._insert_trial_docs(
+                [_mk_doc(t, rng) for t in range(start, start + 16)])
+            nt.refresh()                 # framed fetch_since under loss
+    finally:
+        faults.clear()
+    wall_s = time.perf_counter() - t0
+
+    nt2 = NetTrials(srv.url, exp_key="e1", token="t")
+    nt2.refresh()
+    tids = sorted(d["tid"] for d in nt2._dynamic_trials)
+    counters = _metrics.registry().snapshot().get("counters", {})
+    srv.shutdown()
+    dups = len(tids) - len(set(tids))
+    return {
+        "trials": trials,
+        "wall_s": round(wall_s, 3),
+        "rpc_loss": {"send_p": SEND_P, "recv_p": RECV_P,
+                     "combined": round(1 - (1 - SEND_P) * (1 - RECV_P), 4)},
+        "tid_range_ok": tids == list(range(trials)),
+        "dups": dups,
+        "zero_lost_dup": tids == list(range(trials)) and dups == 0,
+        "rpc_retries": int(counters.get("netstore.rpc.retry", 0)),
+        "idem_hits": int(counters.get("netstore.idem.hits", 0)),
+        "faults_injected": int(counters.get("faults.injected", 0)),
+        "wire_frames": int(counters.get("wire.frames", 0)),
+        "json_fallbacks": int(counters.get("wire.json_fallbacks", 0)),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def collect(fast=False):
+    os.environ.setdefault("HYPEROPT_TPU_NETSTORE_RETRIES", "30")
+    os.environ.setdefault("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.002")
+    saved = {k: os.environ.get(k) for k in _KNOB_ENVS}
+
+    # History sizes are chosen so timed rounds never cross a pow2 history
+    # bucket (tpe._bucket) nor its 0.75·cap prewarm trigger — a crossing
+    # would land a multi-second kernel compile inside a timed round.
+    history = 1_200 if fast else HISTORY_DOCS
+    rounds = 6 if fast else ROUNDS
+    batches = (1, 16, 256) if fast else BYTES_BATCHES
+    try:
+        bytes_rows = _bytes_phase(batches)
+        suggest = _suggest_phase(history, rounds)
+        chaos = _chaos_phase(48 if fast else CHAOS_TRIALS)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    bulk = [r for r in bytes_rows if r["batch"] >= max(
+        b for b in batches if b <= 256)]
+    worst_bulk = min(r["ratio"] for r in bulk)
+    return {
+        "metric": "wire_ab",
+        "backend": "cpu",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "config": {
+            "history_docs": history,
+            "rounds": rounds,
+            "churn_per_round": CHURN,
+            "suggest_n": SUGGEST_N,
+            "bytes_batches": list(batches),
+            "fast": bool(fast),
+            "chaos_rpc_loss": {"send_p": SEND_P, "recv_p": RECV_P,
+                               "combined": round(
+                                   1 - (1 - SEND_P) * (1 - RECV_P), 4)},
+        },
+        "bytes": bytes_rows,
+        "suggest": suggest,
+        "chaos": chaos,
+        "headline": {
+            "bytes_ratio_bulk_worst": worst_bulk,
+            "gate_bytes_ratio_ge_3": worst_bulk >= 3.0,
+            "suggest_round_p95_json_ms":
+                suggest["arms"][0]["round_p95_ms"],
+            "suggest_round_p95_binary_ms":
+                suggest["arms"][1]["round_p95_ms"],
+            "p95_speedup": suggest["p95_speedup"],
+            "gate_p95_speedup_ge_1p5": suggest["p95_speedup"] >= 1.5,
+            "proposals_bit_identical": suggest["proposals_bit_identical"],
+            "chaos_zero_lost_dup": chaos["zero_lost_dup"],
+            "chaos_json_fallbacks": chaos["json_fallbacks"],
+            "chaos_rpc_loss_combined": round(
+                1 - (1 - SEND_P) * (1 - RECV_P), 4),
+        },
+    }
+
+
+def main(fast=False):
+    doc = collect(fast=fast)
+    stamp = time.strftime("%Y%m%d")
+    out_path = os.path.join(_ROOT, "benchmarks",
+                            f"wire_ab_cpu_{stamp}.json")
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc["headline"], indent=1))
+    print(f"wrote {out_path}")
+    head = doc["headline"]
+    ok = (head["gate_bytes_ratio_ge_3"] and head["gate_p95_speedup_ge_1p5"]
+          and head["proposals_bit_identical"]
+          and head["chaos_zero_lost_dup"]
+          and head["chaos_json_fallbacks"] == 0)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="small history + fewer rounds (CI smoke)")
+    args = ap.parse_args()
+    raise SystemExit(main(fast=args.fast))

@@ -35,6 +35,14 @@ WP007  A verb declared in a ``*_READONLY_VERBS`` catalog (the server
        in a mutating/WAL/idempotent catalog, or names no dispatcher
        arm at all — any of which lets a "read" race the writers the
        dispatch lock exists to serialize.
+WP008  Binary-frame coverage: every verb in a ``*FRAMED_VERBS``
+       catalog (its request/reply bodies ride the columnar binary
+       frame) must have a dispatcher arm AND a ``CODEC_FIXTURES``
+       entry carrying BOTH directions (``req`` and ``reply`` bodies —
+       the shared fixtures ``test_wire.py`` round-trips through client
+       encode ↔ server decode), and every fixture key must still be a
+       framed verb.  Keeps the WP001–WP006 ground truth honest when a
+       verb's bytes stop being JSON.
 
 Conventions honored (all structural, none import-time): client call
 sites are calls whose callee name ends in ``rpc`` (``self._rpc``,
@@ -53,7 +61,8 @@ import re
 
 from .core import Finding, call_func_name, qualified_functions, str_const
 
-RULES = ("WP001", "WP002", "WP003", "WP004", "WP005", "WP006", "WP007")
+RULES = ("WP001", "WP002", "WP003", "WP004", "WP005", "WP006", "WP007",
+         "WP008")
 
 #: Fields _Rpc.__call__ injects into every request on the client side
 #: (``wait_s`` rides along only on long-poll reserve, popped by the
@@ -145,6 +154,9 @@ class _Extract:
         self.idempotent: dict[str, tuple] = {}
         self.wal: dict[str, tuple] = {}
         self.readonly: dict[str, tuple] = {}
+        self.framed: dict[str, tuple] = {}
+        # CODEC_FIXTURES: verb -> (rel, line, has_req, has_reply)
+        self.codec_fixtures: dict[str, tuple] = {}
         self.other_catalog_verbs: set[str] = set()
         self.idem_attach_proven = False
         self.funcs: dict[tuple, ast.AST] = {}     # (rel, name) -> node
@@ -192,8 +204,24 @@ class _Extract:
                     self.wal[tname] = entry
                 elif tname.endswith("_READONLY_VERBS"):
                     self.readonly[tname] = entry
+                elif tname.endswith("FRAMED_VERBS"):
+                    self.framed[tname] = entry
                 else:
                     self.other_catalog_verbs.update(verbs)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == "CODEC_FIXTURES" \
+                    and isinstance(node.value, ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    verb = str_const(k)
+                    if verb is None:
+                        continue
+                    dirs = set()
+                    if isinstance(v, ast.Dict):
+                        dirs = {str_const(dk) for dk in v.keys}
+                    self.codec_fixtures[verb] = (
+                        rel, node.lineno, "req" in dirs, "reply" in dirs)
 
     def _scan_arms(self, rel, qualname, func):
         for node in ast.walk(func):
@@ -470,7 +498,8 @@ def check(project) -> list:
     findings: list = []
 
     catalog_verbs = set(ext.other_catalog_verbs)
-    for table in (ext.mutating, ext.idempotent, ext.wal, ext.readonly):
+    for table in (ext.mutating, ext.idempotent, ext.wal, ext.readonly,
+                  ext.framed):
         for _rel, _line, verbs in table.values():
             catalog_verbs.update(verbs)
 
@@ -616,4 +645,39 @@ def check(project) -> list:
                         "WP007", rel, line, f"{name}:{verb}",
                         f"read-only verb '{verb}' has no dispatcher arm — "
                         f"stale catalog entry"))
+
+    # WP008: binary-framed verbs round-trip through the shared codec
+    # fixtures in both directions, and the fixture set never goes stale.
+    if ext.framed:
+        framed_all = set()
+        for name, (rel, line, verbs) in sorted(ext.framed.items()):
+            framed_all |= verbs
+            for verb in sorted(verbs):
+                if verb not in ext.arms:
+                    findings.append(Finding(
+                        "WP008", rel, line, f"{name}:{verb}",
+                        f"framed verb '{verb}' has no dispatcher arm — a "
+                        f"frame-encoded request has nowhere to decode"))
+                fx = ext.codec_fixtures.get(verb)
+                if fx is None:
+                    findings.append(Finding(
+                        "WP008", rel, line, f"{name}:{verb}",
+                        f"framed verb '{verb}' has no CODEC_FIXTURES "
+                        f"entry — nothing pins its encode↔decode "
+                        f"round-trip"))
+                elif not (fx[2] and fx[3]):
+                    missing = [d for d, got in (("req", fx[2]),
+                                                ("reply", fx[3])) if not got]
+                    findings.append(Finding(
+                        "WP008", fx[0], fx[1], f"CODEC_FIXTURES:{verb}",
+                        f"fixture for framed verb '{verb}' lacks "
+                        f"{missing} — both directions (client encode ↔ "
+                        f"server decode) must round-trip"))
+        for verb, (rel, line, _rq, _rp) in sorted(
+                ext.codec_fixtures.items()):
+            if verb not in framed_all:
+                findings.append(Finding(
+                    "WP008", rel, line, f"CODEC_FIXTURES:{verb}",
+                    f"fixture '{verb}' names a verb no *FRAMED_VERBS "
+                    f"catalog declares — stale fixture"))
     return findings

@@ -53,6 +53,7 @@ from __future__ import annotations
 
 import base64
 import hmac
+import io
 import json
 import logging
 import os
@@ -85,8 +86,55 @@ from ..obs import slo as _obs_slo
 from ..obs import timeseries as _obs_ts
 from ..obs.events import EVENTS
 from .. import faults as _faults
+from .. import wire as _wire
 
 logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# restricted attachment codec
+# ---------------------------------------------------------------------------
+
+#: Globals the attachment unpickler will resolve — stdlib scalar/container
+#: constructors plus the numpy ndarray/scalar reconstruction machinery
+#: (both the pre-2.x ``numpy.core`` and 2.x ``numpy._core`` module paths).
+#: Everything else — os.system reduce payloads, arbitrary class
+#: construction — is refused before any object is built.
+_SAFE_GLOBALS = frozenset({
+    ("builtins", "complex"), ("builtins", "set"), ("builtins", "frozenset"),
+    ("builtins", "bytearray"), ("builtins", "range"), ("builtins", "slice"),
+    ("numpy", "ndarray"), ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+    ("numpy._core.numeric", "_frombuffer"),
+})
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    """Allowlist unpickler for wire-crossing attachment blobs.
+
+    ``pickle.loads`` on bytes a network peer controls is arbitrary code
+    execution; attachments only need plain data (numbers, strings,
+    containers, numpy arrays), so anything outside :data:`_SAFE_GLOBALS`
+    is rejected with ``UnpicklingError``.  Scalars, strings, dicts,
+    lists and tuples never hit ``find_class`` at all — they decode from
+    dedicated opcodes."""
+
+    def find_class(self, module, name):
+        if (module, name) in _SAFE_GLOBALS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"attachment blob requested forbidden global "
+            f"{module}.{name} — only plain data and numpy arrays "
+            f"cross this boundary")
+
+
+def safe_loads(blob: bytes):
+    """Decode an attachment blob through the restricted unpickler."""
+    return _RestrictedUnpickler(io.BytesIO(blob)).load()
 
 
 # ---------------------------------------------------------------------------
@@ -317,8 +365,8 @@ class StoreServer:
     #: WP007 pins this catalog against the computed mutation ground
     #: truth of the dispatcher arms, so drift is impossible silently.
     _READONLY_VERBS = frozenset({
-        "metrics", "health", "bundle", "docs", "get_domain",
-        "att_get", "att_keys"})
+        "metrics", "health", "bundle", "docs", "fetch_since",
+        "get_domain", "att_get", "att_keys"})
 
     #: Verbs whose success may make a claim (or a claims-quota slot)
     #: available: each wakes the exp_key's parked long-poll reserves.
@@ -471,10 +519,34 @@ class StoreServer:
             def do_POST(self):
                 if not self._authed():
                     return
+                framed = False
                 try:
                     n = int(self.headers.get("Content-Length", "0"))
-                    req = json.loads(self.rfile.read(n) or b"{}")
+                    raw = self.rfile.read(n) or b"{}"
+                    # Content negotiation by magic sniff (not by header):
+                    # the shard router forwards opaque bodies with its
+                    # own Content-Type, so the bytes themselves are the
+                    # only trustworthy signal.  The reply is framed iff
+                    # the request was — JSON peers never see a frame.
+                    if _wire.is_frame(raw):
+                        if _wire.mode() == "json":
+                            raise _wire.WireError(
+                                "binary frame refused "
+                                "(HYPEROPT_TPU_WIRE=json)")
+                        framed = True
+                        reg = _metrics.registry()
+                        reg.counter("wire.frames").inc()
+                        reg.counter("wire.bytes_rx").inc(len(raw))
+                        req = _wire.decode(bytes(raw))
+                    else:
+                        req = json.loads(raw)
                     out = server._dispatch(req, tenant=self._tenant)
+                    if framed:
+                        body = _wire.encode(out)
+                        _metrics.registry().counter(
+                            "wire.bytes_tx").inc(len(body))
+                        self._send(200, body, _wire.CONTENT_TYPE)
+                        return
                     body = json.dumps(out).encode()
                     code = 200
                 except Exception as e:  # surface server faults to the client
@@ -981,7 +1053,7 @@ class StoreServer:
                 ft.put_domain_blob(base64.b64decode(req["blob"]))
                 return {"ok": True}
             if verb == "att_set":
-                ft.attachments[req["key"]] = pickle.loads(
+                ft.attachments[req["key"]] = safe_loads(
                     base64.b64decode(req["blob"]))
                 return {"ok": True}
             if verb == "att_del":
@@ -1040,6 +1112,22 @@ class StoreServer:
                 return {"docs": export()}
             ft.refresh()
             return {"docs": ft._dynamic_trials}
+        if verb == "fetch_since":
+            # Delta history pull: only rows touched since the client's
+            # cursor.  Stores without delta bookkeeping (FileTrials)
+            # answer with the full list and a null cursor — the client
+            # then keeps using classic full fetches against this peer.
+            fn = getattr(ft, "docs_since", None)
+            if fn is None:
+                export = getattr(ft, "export_docs", None)
+                if export is not None:
+                    docs = export()
+                else:
+                    ft.refresh()
+                    docs = ft._dynamic_trials
+                return {"docs": docs, "cursor": None, "full": True}
+            docs, cursor, full = fn(req.get("cursor"))
+            return {"docs": docs, "cursor": cursor, "full": full}
         if verb == "get_domain":
             blob = ft.get_domain_blob()
             if blob is None:
@@ -1276,6 +1364,19 @@ _IDEMPOTENT_VERBS = frozenset(
      "att_set", "att_del"})
 
 _BACKOFF_CAP_S = 2.0
+
+#: Peers (by URL) that refused a binary frame in ``auto`` wire mode:
+#: pinned to JSON for the rest of the process so every later call skips
+#: the doomed framed attempt.  ``binary`` mode never pins (strict).
+_JSON_ONLY_PEERS: set = set()
+_JSON_ONLY_LOCK = threading.Lock()
+
+#: Error-name prefixes in a non-200 reply that mean "the peer could not
+#: parse the frame" (old server: json.loads on magic bytes; new server
+#: in json mode: explicit WireError refusal) — the only failures that
+#: should trigger the JSON fallback.  Anything else (quota, auth, a
+#: verb-level fault) is a real answer and must surface unchanged.
+_FRAME_REFUSED = ("WireError", "JSONDecodeError", "UnicodeDecodeError")
 
 #: Env knob: per-host cap on idle keep-alive connections held by the
 #: process-global pool (0 disables pooling — every call dials and
@@ -1543,10 +1644,21 @@ class _Rpc:
             ctx = _context.wire_current()
             if ctx is not None:
                 kw["ctx"] = ctx
-        headers = {"Content-Type": "application/json"}
+        wmode = _wire.mode()
+        use_frames = (wmode != "json" and verb in _wire.FRAMED_VERBS
+                      and (wmode == "binary"
+                           or self.url not in _JSON_ONLY_PEERS))
+        headers = {"Content-Type": (_wire.CONTENT_TYPE if use_frames
+                                    else "application/json")}
         if self.token is not None:
             headers["X-Netstore-Token"] = self.token
-        data = json.dumps(kw).encode()
+        if use_frames:
+            data = _wire.encode(kw)
+            reg = _metrics.registry()
+            reg.counter("wire.frames").inc()
+            reg.counter("wire.bytes_tx").inc(len(data))
+        else:
+            data = json.dumps(kw).encode()
         timeout = self.timeout
         if _timeout is not None:
             # Long-poll verbs park server-side for their wait budget;
@@ -1561,7 +1673,12 @@ class _Rpc:
                                                   headers, timeout)
                 if status == 200:
                     _faults.maybe_fail("rpc.recv", verb=verb)
-                    out = json.loads(raw)
+                    if _wire.is_frame(raw):
+                        _metrics.registry().counter(
+                            "wire.bytes_rx").inc(len(raw))
+                        out = _wire.decode(bytes(raw))
+                    else:
+                        out = json.loads(raw)
                     break
                 # Non-2xx (500 server fault, 401 auth) carries the JSON
                 # error body; surface it as the RuntimeError the callers
@@ -1570,6 +1687,21 @@ class _Rpc:
                     out = json.loads(raw)
                 except Exception:
                     out = {"error": f"HTTP {status}"}
+                if (use_frames and wmode == "auto"
+                        and str(out.get("error", "")).startswith(
+                            _FRAME_REFUSED)):
+                    # Old peer (or json-pinned server) could not parse
+                    # the frame: pin this URL to JSON and re-send the
+                    # SAME request (same idem key) as JSON — the
+                    # fallback costs one extra round trip, once.
+                    with _JSON_ONLY_LOCK:
+                        _JSON_ONLY_PEERS.add(self.url)
+                    _metrics.registry().counter(
+                        "wire.json_fallbacks").inc()
+                    use_frames = False
+                    headers["Content-Type"] = "application/json"
+                    data = json.dumps(kw).encode()
+                    continue
                 break
             except (URLError, OSError, InjectedFault) as e:
                 attempts += 1
@@ -1613,7 +1745,7 @@ class _NetAttachments(MutableMapping):
         blob = self._rpc("att_get", key=str(key))["blob"]
         if blob is None:
             raise KeyError(key)
-        return pickle.loads(base64.b64decode(blob))
+        return safe_loads(base64.b64decode(blob))
 
     def __delitem__(self, key):
         if not self._rpc("att_del", key=str(key))["ok"]:
@@ -1645,6 +1777,13 @@ class NetTrials(Trials):
         self._rpc = _Rpc(url, exp_key, timeout=timeout, token=token,
                          retries=retries)
         self._last_metrics_push = float("-inf")
+        # Delta-refresh state: server-issued [epoch, seq] cursor plus a
+        # tid -> index map into _dynamic_trials so fetch_since rows merge
+        # in place.  _delta_ok flips off permanently against peers that
+        # don't speak the verb (the RuntimeError answer pins it).
+        self._cursor = None
+        self._net_pos: dict = {}
+        self._delta_ok = True
         super().__init__(exp_key=exp_key, refresh=refresh)
         self.attachments = _NetAttachments(self._rpc)
 
@@ -1652,12 +1791,57 @@ class NetTrials(Trials):
 
     def refresh(self):
         with self._lock:
-            docs = self._rpc("docs")["docs"]
+            docs = None
+            if self._delta_ok and _wire.mode() != "json":
+                try:
+                    out = self._rpc("fetch_since", cursor=self._cursor)
+                except (NetstoreUnavailable, QuotaExceeded):
+                    raise
+                except RuntimeError:
+                    # Old peer without the verb: classic full fetches
+                    # from here on (one failed probe per process).
+                    self._delta_ok = False
+                else:
+                    self._cursor = out.get("cursor")
+                    if out.get("full", True) or self._cursor is None:
+                        docs = out.get("docs", [])
+                    else:
+                        self._merge_delta(out.get("docs", []))
+                        return
+            if docs is None:
+                docs = self._rpc("docs")["docs"]
             docs.sort(key=lambda d: d["tid"])
             self._dynamic_trials = docs
+            self._net_pos = {d["tid"]: i for i, d in enumerate(docs)}
             self._ids = {d["tid"] for d in docs}
             self._trials = [d for d in docs
                             if self._exp_key in (None, d.get("exp_key"))]
+
+    def _merge_delta(self, delta: list) -> None:
+        """Apply a fetch_since row set: replace known tids in place,
+        append unknown ones (re-sorting only if an append lands out of
+        tid order — servers allocate tids monotonically, so appends are
+        ordered in practice)."""
+        if not delta:
+            return
+        resort = False
+        for d in sorted(delta, key=lambda d: d["tid"]):
+            i = self._net_pos.get(d["tid"])
+            if i is not None:
+                self._dynamic_trials[i] = d
+            else:
+                if (self._dynamic_trials
+                        and d["tid"] < self._dynamic_trials[-1]["tid"]):
+                    resort = True
+                self._net_pos[d["tid"]] = len(self._dynamic_trials)
+                self._dynamic_trials.append(d)
+                self._ids.add(d["tid"])
+        if resort:
+            self._dynamic_trials.sort(key=lambda d: d["tid"])
+            self._net_pos = {d["tid"]: i
+                             for i, d in enumerate(self._dynamic_trials)}
+        self._trials = [d for d in self._dynamic_trials
+                        if self._exp_key in (None, d.get("exp_key"))]
 
     def _insert_trial_docs(self, docs):
         return self._rpc("insert_docs", docs=list(docs))["tids"]
@@ -1667,6 +1851,8 @@ class NetTrials(Trials):
 
     def delete_all(self):
         self._rpc("delete_all")
+        self._cursor = None
+        self._net_pos = {}
         super().delete_all()
         self.attachments = _NetAttachments(self._rpc)
 
@@ -1947,6 +2133,13 @@ class RouterTrials(NetTrials):
                                token=token, retries=retries,
                                map_refresh_s=map_refresh_s)
         self._last_metrics_push = float("-inf")
+        # Delta-refresh state (see NetTrials.__init__).  Safe across
+        # failover/rebalance: the promoted/receiving shard mints a fresh
+        # store epoch, so a cursor from the old placement is rejected by
+        # docs_since and answered with a full resend.
+        self._cursor = None
+        self._net_pos = {}
+        self._delta_ok = True
         Trials.__init__(self, exp_key=exp_key, refresh=refresh)
         self.attachments = _NetAttachments(self._rpc)
 

@@ -19,8 +19,13 @@ atomically under one lock, so a claim can never outlive its doc state.
 from __future__ import annotations
 
 import base64
+import itertools
 import json
+import os
+import time
 from typing import List, Optional
+
+import numpy as np
 
 from ..base import (
     COARSE_CLOCK_SLOP_S,
@@ -28,7 +33,9 @@ from ..base import (
     JOB_STATE_ERROR,
     JOB_STATE_NEW,
     JOB_STATE_RUNNING,
+    STATUS_OK,
     Trials,
+    _parse_doc_row,
     coarse_utcnow,
 )
 from ..exceptions import InvalidTrial
@@ -36,6 +43,14 @@ from ..obs import metrics as _metrics
 from ..obs.events import EVENTS
 
 __all__ = ["MemTrials"]
+
+#: Delta-cursor epoch source: a per-process boot salt (stamped once at
+#: import — never on the WAL replay path, which must stay entropy-free)
+#: plus a monotone counter.  Epochs need uniqueness across restarts and
+#: delete_all generations, not secrecy or determinism: a stale cursor
+#: whose epoch no longer matches just gets one full resend.
+_EPOCH_SALT = int(time.time() * 1000) % (1 << 32)
+_EPOCH_SEQ = itertools.count(1)
 
 
 class MemTrials(Trials):
@@ -61,7 +76,81 @@ class MemTrials(Trials):
         # instead of the wall clock.  The service server points it at the
         # WAL record's logged timestamp around every mutating verb.
         self.now_override: float | None = None
+        # -- delta-fetch bookkeeping (fetch_since verb) ----------------------
+        # Epoch token: any event that could reset mutation-seq monotonicity
+        # (fresh store, restart+replay, delete_all) mints a new random epoch,
+        # so a stale client cursor can never silently skip rows — an epoch
+        # mismatch just costs one full resend.  Never replayed, never in
+        # state_dict(), so WAL byte-identity is untouched.
+        self._epoch: int = self._new_epoch()
+        self._seq_mut: int = 0
+        # tid -> mutation seq, *insertion-ordered ascending by seq* (touch
+        # pops + reinserts), so reversed() iteration yields the delta in
+        # O(changed rows) instead of O(all rows).
+        self._revs: dict = {}
+        # -- hot-column bookkeeping (columnar history/inflight) --------------
+        self._live: set = set()        # NEW/RUNNING tids (exp_key-matching)
+        self._done_tids: list = []     # DONE tids mirrored into columns
+        self._done_set: set = set()
+        self._done_pending: list = []  # docs awaiting column append
+        self._col: dict | None = None  # capacity-doubled column buffers
+        self._col_dirty: bool = True
+        # -- list-view maintenance -------------------------------------------
+        self._pos: dict = {}           # tid -> index in _dynamic_trials
+        self._tpos: dict = {}          # tid -> index in _trials
+        self._list_dirty: bool = True
+        self._export_cache: tuple | None = None
         super().__init__(exp_key=exp_key, refresh=refresh)
+
+    @staticmethod
+    def _new_epoch() -> int:
+        # 48-bit salt field + counter: fits i64 on any framed wire path.
+        return (_EPOCH_SALT << 16) + next(_EPOCH_SEQ)
+
+    @staticmethod
+    def _cols_enabled() -> bool:
+        """Columnar history/inflight gate — HYPEROPT_TPU_SERVICE_COLUMNS=0
+        restores the base doc-walk paths (the JSON A/B arm)."""
+        return os.environ.get(
+            "HYPEROPT_TPU_SERVICE_COLUMNS", "1").strip().lower() not in (
+                "0", "off", "false", "no")
+
+    def _match_key(self, doc) -> bool:
+        return self._exp_key is None or doc.get("exp_key") == self._exp_key
+
+    def _touch(self, tid) -> None:
+        """Record a row mutation for delta fetch.  Callers already hold
+        the store lock (RLock) or run under the server dispatch lock."""
+        self._seq_mut += 1
+        self._revs.pop(tid, None)
+        self._revs[tid] = self._seq_mut
+        self._export_cache = None
+
+    def _note_state(self, doc) -> None:
+        """Maintain the live set and the append-only DONE column feed for
+        one (possibly replaced) stored doc."""
+        if not self._match_key(doc):
+            self._col_dirty = True
+            return
+        tid, state = doc["tid"], doc["state"]
+        if state in (JOB_STATE_NEW, JOB_STATE_RUNNING):
+            self._live.add(tid)
+        else:
+            self._live.discard(tid)
+        if state == JOB_STATE_DONE:
+            if tid in self._done_set:
+                # result rewritten after completion: full rebuild
+                self._col_dirty = True
+            elif self._done_tids and tid < self._done_tids[-1]:
+                # out-of-order completion: same cost the base prefix
+                # cache pays (its tid-prefix check also forces a reparse)
+                self._col_dirty = True
+            else:
+                self._done_tids.append(tid)
+                self._done_set.add(tid)
+                self._done_pending.append(doc)
+        elif tid in self._done_set:
+            self._col_dirty = True
 
     def _now(self) -> float:
         return (self.now_override if self.now_override is not None
@@ -77,26 +166,59 @@ class MemTrials(Trials):
         for d in docs:
             if d["tid"] in self._by_tid:
                 raise InvalidTrial(f"duplicate tid {d['tid']}")
-        for d in docs:
+        in_order = sorted(docs, key=lambda d: d["tid"])
+        append = (not self._list_dirty
+                  and (not self._dynamic_trials
+                       or in_order[0]["tid"] > self._dynamic_trials[-1]["tid"]))
+        for d in in_order:
             self._by_tid[d["tid"]] = d
             self._allocated.add(d["tid"])
             self._ids.add(d["tid"])
-        self._dynamic_trials = sorted(self._by_tid.values(),
-                                      key=lambda d: d["tid"])
+            self._touch(d["tid"])
+            self._note_state(d)
+            if append:
+                # steady-state path: monotone tids extend the sorted views
+                # in place instead of resorting O(n log n) per insert
+                self._pos[d["tid"]] = len(self._dynamic_trials)
+                self._dynamic_trials.append(d)
+                if self._match_key(d):
+                    self._tpos[d["tid"]] = len(self._trials)
+                    self._trials.append(d)
+        if not append:
+            self._list_dirty = True
+            self.refresh()
+        self._best_cache = None
         return [d["tid"] for d in docs]
 
     def refresh(self):
         with self._lock:
+            # State flips mutate docs in place (same object in every
+            # view), so a clean store only needs the best-trial cache
+            # invalidated — the filtered list is already current.
+            if not self._list_dirty:
+                self._best_cache = None
+                return
             self._dynamic_trials = sorted(self._by_tid.values(),
                                           key=lambda d: d["tid"])
+            self._pos = {d["tid"]: i
+                         for i, d in enumerate(self._dynamic_trials)}
             super().refresh()
+            self._tpos = {d["tid"]: i for i, d in enumerate(self._trials)}
+            self._list_dirty = False
 
     def export_docs(self) -> list:
         """Reply-safe snapshot: per-doc shallow copies, so the server can
         serialize the reply outside the store lock while later verbs
-        mutate top-level keys of the live docs."""
-        self.refresh()
-        return [dict(d) for d in self._dynamic_trials]
+        mutate top-level keys of the live docs.  Cached until the next
+        row mutation (cold read verbs materialize docs lazily)."""
+        with self._lock:
+            cached = self._export_cache
+            if cached is not None and cached[0] == self._seq_mut:
+                return cached[1]
+            self.refresh()
+            docs = [dict(d) for d in self._dynamic_trials]
+            self._export_cache = (self._seq_mut, docs)
+            return docs
 
     def new_trial_ids(self, n):
         with self._lock:
@@ -112,6 +234,19 @@ class MemTrials(Trials):
             self._allocated = set()
             self._by_tid = {}
             self._domain_blob = None
+            self._epoch = self._new_epoch()
+            self._seq_mut = 0
+            self._revs = {}
+            self._live = set()
+            self._done_tids = []
+            self._done_set = set()
+            self._done_pending = []
+            self._col = None
+            self._col_dirty = True
+            self._pos = {}
+            self._tpos = {}
+            self._list_dirty = False
+            self._export_cache = None
             super().delete_all()
 
     # -- domain shipping -----------------------------------------------------
@@ -152,6 +287,7 @@ class MemTrials(Trials):
                 doc["owner"] = owner
                 doc["book_time"] = self._now()
                 doc["refresh_time"] = doc["book_time"]
+                self._touch(doc["tid"])
                 _metrics.registry().counter("store.claim.won").inc()
                 EVENTS.emit("store_claim", trial=doc["tid"], owner=owner)
                 return dict(doc)
@@ -176,6 +312,7 @@ class MemTrials(Trials):
                 return cur["state"] in (JOB_STATE_DONE, JOB_STATE_ERROR)
             cur["refresh_time"] = self._now()
             doc["refresh_time"] = cur["refresh_time"]
+            self._touch(cur["tid"])
             return True
 
     def write_result(self, doc, owner: Optional[str] = None) -> bool:
@@ -185,9 +322,27 @@ class MemTrials(Trials):
                 return False
             stored = dict(doc)
             stored["refresh_time"] = self._now()
-            self._by_tid[stored["tid"]] = stored
-            self._ids.add(stored["tid"])
-            self._allocated.add(stored["tid"])
+            tid = stored["tid"]
+            prev = self._by_tid.get(tid)
+            self._by_tid[tid] = stored
+            self._ids.add(tid)
+            self._allocated.add(tid)
+            # The replaced doc object must also land in the list views;
+            # patch them in place when they're current (the steady-state
+            # path), fall back to a dirty rebuild otherwise.
+            if (not self._list_dirty and prev is not None
+                    and prev.get("exp_key") == stored.get("exp_key")):
+                i = self._pos.get(tid)
+                if i is not None:
+                    self._dynamic_trials[i] = stored
+                j = self._tpos.get(tid)
+                if j is not None:
+                    self._trials[j] = stored
+                self._best_cache = None
+            else:
+                self._list_dirty = True
+            self._touch(tid)
+            self._note_state(stored)
         _metrics.registry().counter("store.write.ok").inc()
         EVENTS.emit("store_write", trial=stored["tid"],
                     state=stored.get("state"))
@@ -211,6 +366,7 @@ class MemTrials(Trials):
                     self._claims.pop(doc["tid"], None)
                     doc["state"] = JOB_STATE_NEW
                     doc["owner"] = None
+                    self._touch(doc["tid"])
                     n += 1
                     EVENTS.emit("store_requeue", trial=doc["tid"],
                                 owner=owner, reason="stale_heartbeat")
@@ -218,6 +374,134 @@ class MemTrials(Trials):
                 _metrics.registry().counter("store.requeued").inc(n)
                 self.refresh()
         return n
+
+    # -- delta fetch (fetch_since verb) --------------------------------------
+
+    def docs_since(self, cursor=None):
+        """Rows touched since ``cursor`` (``[epoch, seq]``), plus the new
+        cursor and a ``full`` flag.  A missing/stale/foreign-epoch cursor
+        gets the complete doc list — delta correctness never depends on
+        the client's bookkeeping, only its efficiency does."""
+        with self._lock:
+            cur = [self._epoch, self._seq_mut]
+            ok_cursor = (isinstance(cursor, (list, tuple))
+                         and len(cursor) == 2)
+            if ok_cursor:
+                try:
+                    ok_cursor = (int(cursor[0]) == self._epoch
+                                 and 0 <= int(cursor[1]) <= self._seq_mut)
+                except (TypeError, ValueError):
+                    ok_cursor = False
+            if not ok_cursor:
+                self.refresh()
+                return ([dict(d) for d in self._dynamic_trials], cur, True)
+            since = int(cursor[1])
+            touched = []
+            for tid in reversed(self._revs):
+                if self._revs[tid] <= since:
+                    break
+                touched.append(tid)
+            touched.sort()
+            docs = [dict(self._by_tid[t]) for t in touched
+                    if t in self._by_tid]
+            _metrics.registry().counter("store.delta.rows").inc(len(docs))
+            return docs, cur, False
+
+    # -- columnar history (feeds the device-resident ring) -------------------
+
+    def history(self, cs):
+        """O(Δ) dense history at steady state: completed rows are parsed
+        once into capacity-doubled column buffers when their result
+        lands, and each call returns views — no per-call doc walk.  The
+        buffers ARE the slab the device ring uploads from, so a server-
+        side suggest feeds the PR 3 ring straight from columns."""
+        if not self._cols_enabled():
+            return super().history(cs)
+        with self._lock:
+            col = self._col
+            if self._col_dirty or col is None or col["cs"] is not cs:
+                self._rebuild_columns(cs)
+                col = self._col
+            elif self._done_pending:
+                self._append_columns(col)
+            n = col["n"]
+            return dict(vals=col["vals"][:n], active=col["active"][:n],
+                        loss=col["loss"][:n], ok=col["ok"][:n],
+                        tids=col["tids"][:n])
+
+    def inflight(self, cs):
+        """Dense NEW/RUNNING view from the maintained live-tid set —
+        O(in-flight) instead of the base class's O(all trials) scan."""
+        if not self._cols_enabled():
+            return super().inflight(cs)
+        with self._lock:
+            live = [self._by_tid[t] for t in sorted(self._live)
+                    if t in self._by_tid]
+            m, p = len(live), cs.n_params
+            vals = np.zeros((m, p), dtype=np.float32)
+            active = np.zeros((m, p), dtype=bool)
+            for i, t in enumerate(live):
+                _parse_doc_row(t["misc"]["vals"], cs, vals, active, i)
+            return vals, active
+
+    @staticmethod
+    def _col_alloc(cap, p):
+        return {
+            "vals": np.zeros((cap, p), dtype=np.float32),
+            "active": np.zeros((cap, p), dtype=bool),
+            "loss": np.full((cap,), np.inf, dtype=np.float32),
+            "ok": np.zeros((cap,), dtype=bool),
+            "tids": np.zeros((cap,), dtype=np.int64),
+        }
+
+    def _fill_row(self, col, i, doc):
+        r = doc["result"]
+        if (r.get("status") == STATUS_OK and r.get("loss") is not None
+                and np.isfinite(r["loss"])):
+            col["loss"][i] = r["loss"]
+            col["ok"][i] = True
+        else:
+            col["loss"][i] = np.inf
+            col["ok"][i] = False
+        col["vals"][i] = 0.0
+        col["active"][i] = False
+        _parse_doc_row(doc["misc"]["vals"], cs=col["cs"], vals=col["vals"],
+                       active=col["active"], i=i)
+        col["tids"][i] = doc["tid"]
+
+    def _rebuild_columns(self, cs):
+        self.refresh()
+        done = [t for t in self._trials if t["state"] == JOB_STATE_DONE]
+        n, p = len(done), cs.n_params
+        cap = max(64, 2 * n)
+        col = self._col_alloc(cap, p)
+        col["cs"] = cs
+        col["n"] = n
+        for i, t in enumerate(done):
+            self._fill_row(col, i, t)
+        self._col = col
+        self._done_tids = [t["tid"] for t in done]
+        self._done_set = set(self._done_tids)
+        self._done_pending = []
+        self._col_dirty = False
+        _metrics.registry().counter("store.columns.rebuilds").inc()
+
+    def _append_columns(self, col):
+        pending, self._done_pending = self._done_pending, []
+        need = col["n"] + len(pending)
+        if need > len(col["tids"]):
+            cap = max(2 * len(col["tids"]), 2 * need)
+            p = col["vals"].shape[1]
+            grown = self._col_alloc(cap, p)
+            m = col["n"]
+            for k in ("vals", "active", "loss", "ok", "tids"):
+                grown[k][:m] = col[k][:m]
+            grown["cs"], grown["n"] = col["cs"], m
+            col = self._col = grown
+        for doc in pending:
+            self._fill_row(col, col["n"], doc)
+            col["n"] += 1
+        _metrics.registry().counter("store.columns.rows").inc(len(pending))
 
     # -- durable state (snapshot / byte-identity) ----------------------------
 
@@ -249,7 +533,6 @@ class MemTrials(Trials):
         return _pickler.dumps(self.attachments[key])
 
     def load_state(self, state: dict) -> None:
-        import pickle
         with self._lock:
             self._by_tid = {d["tid"]: dict(d) for d in state["docs"]}
             self._claims = {int(t): o
@@ -259,7 +542,25 @@ class MemTrials(Trials):
             blob = state.get("domain_blob")
             self._domain_blob = (None if blob is None
                                  else base64.b64decode(blob))
+            from ..parallel.netstore import safe_loads
             self.attachments = {
-                k: pickle.loads(base64.b64decode(b))
+                k: safe_loads(base64.b64decode(b))
                 for k, b in state.get("attachments", {}).items()}
+            # Bulk state swap: mint a fresh delta epoch (stale client
+            # cursors full-resync) and rebuild every derived view.
+            self._epoch = self._new_epoch()
+            self._seq_mut = 0
+            self._revs = {}
+            for d in self._by_tid.values():
+                self._touch(d["tid"])
+            self._live = set()
+            self._done_tids = []
+            self._done_set = set()
+            self._done_pending = []
+            self._col = None
+            self._col_dirty = True
+            self._export_cache = None
+            self._list_dirty = True
             self.refresh()
+            for d in self._dynamic_trials:
+                self._note_state(d)

@@ -52,6 +52,7 @@ snapshot and replays only records with ``seq`` greater than it.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import signal
@@ -59,6 +60,7 @@ import threading
 import time
 
 from .. import faults as _faults
+from .. import wire as _wire
 from ..exceptions import InjectedFault
 from ..obs import flight as _flight
 from ..obs import metrics as _metrics
@@ -67,6 +69,17 @@ __all__ = ["Wal", "read_wal", "inspect"]
 
 _WAL_FILE = "wal.jsonl"
 _SNAP_FILE = "snapshot.json"
+#: Columnar snapshot sidecar (format 2): ``snapshot.json`` becomes a
+#: small manifest (seq, t_wall, idem cache, sidecar name + sha256) and
+#: the bulk store state goes to ``snapshot-<seq>.slab`` as one binary
+#: wire frame.  Write order makes SIGKILL at any point recoverable: the
+#: slab is fully written + fsynced BEFORE the manifest atomically
+#: replaces ``snapshot.json``, and older slabs are pruned only AFTER
+#: the manifest commit — a manifest on disk always references a
+#: complete slab.  ``HYPEROPT_TPU_WIRE=json`` keeps the classic
+#: single-file JSON snapshot (format 1), and recovery reads both.
+_SLAB_PREFIX = "snapshot-"
+_SLAB_SUFFIX = ".slab"
 
 #: When set to ``kill``, an injected ``wal.write`` / ``wal.fsync`` fault
 #: escalates to SIGKILL of the current process — the chaos harness's way
@@ -251,12 +264,15 @@ class Wal:
                 self._sync_leader = True
         try:
             payload = dict(payload, seq=self.seq, t_wall=time.time())
-            tmp = f"{self.snap_path}.tmp.{os.getpid()}"
-            with open(tmp, "w", encoding="utf-8") as f:
-                json.dump(payload, f, sort_keys=True)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.snap_path)
+            if _wire.mode() != "json":
+                self._write_columnar(payload)
+            else:
+                tmp = f"{self.snap_path}.tmp.{os.getpid()}"
+                with open(tmp, "w", encoding="utf-8") as f:
+                    json.dump(payload, f, sort_keys=True)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.snap_path)
             # Compaction: everything the snapshot covers leaves the log.
             self._fh.close()
             self._fh = open(self.path, "w", encoding="utf-8")
@@ -273,6 +289,40 @@ class Wal:
                     self._sync_leader = False
                     self._last_fsync_mono = time.monotonic()
                     self._sync_cv.notify_all()
+
+    def _write_columnar(self, payload: dict) -> None:
+        """Format-2 snapshot: binary slab sidecar first, manifest commit
+        second, prune third (see the ordering note at ``_SLAB_PREFIX``).
+        """
+        slab_name = f"{_SLAB_PREFIX}{self.seq:016d}{_SLAB_SUFFIX}"
+        slab_path = os.path.join(self.root, slab_name)
+        frame = _wire.encode({"stores": payload.get("stores", [])})
+        tmp = f"{slab_path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, slab_path)
+        manifest = {k: v for k, v in payload.items() if k != "stores"}
+        manifest.update(format=2, sidecar=slab_name,
+                        sha256=hashlib.sha256(frame).hexdigest())
+        tmp = f"{self.snap_path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.snap_path)
+        _metrics.registry().counter("wal.snapshot.slab_bytes").inc(
+            len(frame))
+        # Only now is the previous snapshot's slab unreferenced.
+        for name in os.listdir(self.root):
+            if (name.startswith(_SLAB_PREFIX) and name != slab_name
+                    and (name.endswith(_SLAB_SUFFIX)
+                         or ".tmp." in name)):
+                try:
+                    os.remove(os.path.join(self.root, name))
+                except OSError:
+                    pass
 
     def close(self) -> None:
         try:
@@ -299,6 +349,21 @@ def read_wal(root: str):
     if os.path.exists(snap_path):
         with open(snap_path, encoding="utf-8") as f:
             snap = json.load(f)
+    if snap is not None and snap.get("format") == 2:
+        # Columnar manifest: pull the store state back from the binary
+        # sidecar and present the same dict shape a format-1 snapshot
+        # had — recovery code never sees the difference.
+        slab_path = os.path.join(root, snap["sidecar"])
+        with open(slab_path, "rb") as f:
+            frame = f.read()
+        if hashlib.sha256(frame).hexdigest() != snap.get("sha256"):
+            raise ValueError(
+                f"{slab_path}: snapshot sidecar sha256 mismatch "
+                "(corrupt or partial slab referenced by the manifest)")
+        hot = _wire.decode(frame)
+        snap = {k: v for k, v in snap.items()
+                if k not in ("format", "sidecar", "sha256")}
+        snap["stores"] = hot.get("stores", [])
     min_seq = snap["seq"] if snap else 0
     records, n_torn = [], 0
     wal_path = os.path.join(root, _WAL_FILE)
@@ -336,6 +401,9 @@ def inspect(root: str) -> dict:
         per_store[key] = per_store.get(key, 0) + 1
     wal_path = os.path.join(root, _WAL_FILE)
     snap_path = os.path.join(root, _SNAP_FILE)
+    slab_bytes = sum(
+        os.path.getsize(os.path.join(root, n)) for n in os.listdir(root)
+        if n.startswith(_SLAB_PREFIX) and n.endswith(_SLAB_SUFFIX))
     return {
         "root": os.path.abspath(root),
         "snapshot": None if snap is None else {
@@ -343,7 +411,7 @@ def inspect(root: str) -> dict:
             "stores": len(snap.get("stores", [])),
             "idem_entries": len(snap.get("idem", [])),
             "t_wall": snap.get("t_wall"),
-            "bytes": os.path.getsize(snap_path),
+            "bytes": os.path.getsize(snap_path) + slab_bytes,
         },
         "records": len(records),
         "seq_range": ([records[0]["seq"], records[-1]["seq"]]

@@ -880,6 +880,64 @@ def test_wp007_contradictory_catalog_and_stale_entry_fire():
     assert any("stale catalog entry" in f.message for f in fired)
 
 
+def test_wp008_framed_verb_without_arm_or_fixture_fires():
+    srv = ("_FRAMED_VERBS = frozenset({\"bulk\", \"ghostly\"})\n"
+           "def _dispatch_verb(verb, req):\n"
+           "    if verb == \"bulk\":\n"
+           "        return {}\n")
+    cli = ("class C:\n"
+           "    def go(self):\n"
+           "        return self._rpc(\"bulk\")\n")
+    fired = rules_fired(run_checker("wire-protocol", _wp(srv, cli)),
+                        "WP008")
+    # "ghostly" is framed but has no dispatcher arm; neither verb has a
+    # codec fixture pinning its round-trip
+    assert any("no dispatcher arm" in f.message and "ghostly" in f.symbol
+               for f in fired)
+    assert any("no CODEC_FIXTURES" in f.message and "bulk" in f.symbol
+               for f in fired)
+
+
+def test_wp008_one_sided_and_stale_fixtures_fire_pair_silent():
+    srv = ("_FRAMED_VERBS = frozenset({\"bulk\"})\n"
+           "def _dispatch_verb(verb, req):\n"
+           "    if verb == \"bulk\":\n"
+           "        return {}\n")
+    cli = ("class C:\n"
+           "    def go(self):\n"
+           "        return self._rpc(\"bulk\")\n")
+    one_sided = _wp(
+        srv + "CODEC_FIXTURES = {\"bulk\": {\"req\": {\"n\": 1}}}\n", cli)
+    fired = rules_fired(run_checker("wire-protocol", one_sided), "WP008")
+    assert any("reply" in f.message for f in fired)
+    stale = _wp(
+        srv + "CODEC_FIXTURES = {\n"
+              "    \"bulk\": {\"req\": {\"n\": 1}, \"reply\": {}},\n"
+              "    \"gone\": {\"req\": {}, \"reply\": {}},\n"
+              "}\n", cli)
+    fired = rules_fired(run_checker("wire-protocol", stale), "WP008")
+    assert any("stale fixture" in f.message and "gone" in f.symbol
+               for f in fired)
+    ok = _wp(
+        srv + "CODEC_FIXTURES = {\n"
+              "    \"bulk\": {\"req\": {\"n\": 1}, \"reply\": {}},\n"
+              "}\n", cli)
+    assert not rules_fired(run_checker("wire-protocol", ok), "WP008")
+
+
+def test_wp008_framed_catalog_membership_exempts_wp002():
+    # an arm for a framed verb with no client-side _rpc call is not an
+    # orphan: replication/delta peers reach it through the frame path
+    srv = ("_FRAMED_VERBS = frozenset({\"bulk\"})\n"
+           "CODEC_FIXTURES = {\"bulk\": {\"req\": {}, \"reply\": {}}}\n"
+           "def _dispatch_verb(verb, req):\n"
+           "    if verb == \"bulk\":\n"
+           "        return {}\n")
+    cli = "class C:\n    pass\n"
+    assert not rules_fired(run_checker("wire-protocol", _wp(srv, cli)),
+                           "WP002")
+
+
 # ---------------------------------------------------------------------------
 # RT — replay determinism
 # ---------------------------------------------------------------------------

@@ -601,6 +601,76 @@ class TestBenchmarkArtifacts:
             assert head["gate_speedup_ge_2p5"] is True, name
             assert head["gate_fsyncs_per_verb_lt_0p2"] is True, name
 
+    def test_wire_ab_artifact_schema(self):
+        """ISSUE 19 acceptance artifact: columnar binary wire plane A/B —
+        per-verb bytes amortization over batch sizes (≥3x bulk gate),
+        interleaved JSON-vs-binary suggest rounds at a 10k-doc history
+        (≥1.5x p95 gate, proposals bit-identical), and a 32.5%-RPC-loss
+        chaos arm on the binary frame with an exactly-once audit —
+        written by benchmarks/wire_ab.py."""
+        paths = sorted(glob.glob(os.path.join(_BENCH_DIR,
+                                              "wire_ab_*.json")))
+        assert paths, "no benchmarks/wire_ab_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "wire_ab", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            # bytes phase covers every framed verb at bulk batch sizes,
+            # and per-trial bytes must actually amortize (fall) with n
+            by_verb = {}
+            for r in doc["bytes"]:
+                assert {"verb", "batch", "json_bytes", "frame_bytes",
+                        "ratio"} <= set(r), f"{name}: {r}"
+                by_verb.setdefault(r["verb"], []).append(r)
+            assert {"insert_docs", "docs", "fetch_since",
+                    "wal_ship"} <= set(by_verb), f"{name}: {sorted(by_verb)}"
+            for verb, rows in by_verb.items():
+                rows.sort(key=lambda r: r["batch"])
+                assert rows[-1]["batch"] >= 256, f"{name}: {verb}"
+                per_trial = [r["frame_bytes"] / r["batch"] for r in rows]
+                assert per_trial[-1] < per_trial[0], (
+                    f"{name}: {verb}: frame bytes/trial did not amortize")
+                assert rows[-1]["ratio"] >= 3.0, (
+                    f"{name}: {verb}: bulk ratio {rows[-1]['ratio']} < 3x")
+            # suggest A/B: both arms present, knob settings recorded,
+            # proposals bit-identical between arms
+            sg = doc["suggest"]
+            arms = {a["arm"]: a for a in sg["arms"]}
+            assert {"json", "binary"} <= set(arms), name
+            for a in sg["arms"]:
+                assert {"knobs", "rounds", "round_p50_ms",
+                        "round_p95_ms"} <= set(a), f"{name}: {sorted(a)}"
+                assert 0 < a["round_p50_ms"] <= a["round_p95_ms"], \
+                    f"{name}: {a['arm']}"
+            assert arms["json"]["knobs"]["wire"] == "json", name
+            assert arms["binary"]["knobs"]["wire"] == "binary", name
+            assert sg["proposals_bit_identical"] is True, (
+                f"{name}: arms diverged — proposals not bit-identical")
+            assert sg["counters"]["wire.json_fallbacks"] == 0, name
+            # chaos arm: heavy injected loss on the binary frame,
+            # exactly-once preserved and no fallback-to-JSON creep
+            chaos = doc["chaos"]
+            assert chaos["rpc_loss"]["combined"] >= 0.30, (
+                f"{name}: chaos too gentle — "
+                f"{chaos['rpc_loss']} < 0.30 combined RPC loss")
+            assert chaos["zero_lost_dup"] is True, (
+                f"{name}: chaos arm lost or duplicated a tid")
+            assert chaos["json_fallbacks"] == 0, (
+                f"{name}: loss must never demote the peer to JSON")
+            assert chaos["wire_frames"] > 0, name
+            head = doc["headline"]
+            assert head["gate_bytes_ratio_ge_3"] is True, name
+            assert head["bytes_ratio_bulk_worst"] >= 3.0, name
+            assert head["p95_speedup"] >= 1.5, (
+                f"{name}: suggest p95 speedup {head['p95_speedup']} < 1.5x")
+            assert head["gate_p95_speedup_ge_1p5"] is True, name
+            assert head["proposals_bit_identical"] is True, name
+            assert head["chaos_zero_lost_dup"] is True, name
+            assert head["chaos_json_fallbacks"] == 0, name
+
     def test_algo_zoo_ab_artifact_schema(self):
         """ISSUE 10 acceptance artifact: per-head best-loss sweep over the
         5-domain zoo x 20 seeds through the backend registry, with

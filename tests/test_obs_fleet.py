@@ -483,8 +483,10 @@ class TestFleetMetrics:
             EVENTS.enable()
             with obs_context.bind(trace_id="feedface", tid=123):
                 nt.refresh()  # any verb will do
+            # refresh rides the fetch_since delta verb when the wire
+            # plane allows it (r19), and plain docs otherwise
             rpcs = [e for e in EVENTS.snapshot() if e["type"] == "rpc"
-                    and e.get("name") == "docs"]
+                    and e.get("name") in ("docs", "fetch_since")]
             assert rpcs, "server emitted no rpc event"
             assert rpcs[-1]["trace_id"] == "feedface"
             assert rpcs[-1]["trial"] == 123

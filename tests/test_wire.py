@@ -1,0 +1,690 @@
+"""Columnar binary wire plane (r19): codec, negotiation, deltas, slabs.
+
+What is pinned here
+-------------------
+* **Codec fixtures round-trip both directions** — every verb in
+  ``wire.FRAMED_VERBS`` has a canonical request and reply in
+  ``wire.CODEC_FIXTURES``, and each must survive encode→decode exactly
+  as its JSON twin would (the WP008 analyzer rule enforces the catalog
+  side of this contract; this suite enforces the runtime side).
+* **Float bit-parity across planes** — losses/vals pushed through a
+  JSON WAL line and through a binary frame must land bit-identical as
+  f32 (and f64), including NaN, ±Inf, f32 subnormals, and the
+  2**24 ± 1 integer-lattice edge where f32 rounding starts to bite.
+* **Attachment codec is a restricted unpickler** — a malicious
+  ``__reduce__`` payload is refused with ``UnpicklingError``; plain
+  scalars, containers, and numpy arrays still round-trip.
+* **fetch_since deltas** — the cursor is monotone under concurrent
+  inserts/requeues and never loses a row; a stale/foreign cursor costs
+  one full resend, never a silent gap; a quota-refused insert leaves
+  no delta behind.
+* **Negotiation** — an auto-mode client whose frame a json-pinned peer
+  refuses falls back to JSON once (same idempotency key), pins the
+  peer, and counts ``wire.json_fallbacks``.
+* **Durability** — format-2 columnar snapshots survive a crash at any
+  point of the slab→manifest→prune sequence; an old format-1 snapshot
+  plus WAL tail replays to a ``state_bytes()``-identical store; a
+  corrupted slab fails loudly on its SHA-256, never silently.
+"""
+
+import json
+import math
+import os
+import pickle
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import base, hp, wire
+from hyperopt_tpu.base import JOB_STATE_DONE, JOB_STATE_NEW, STATUS_OK
+from hyperopt_tpu.exceptions import QuotaExceeded
+from hyperopt_tpu.obs import metrics as _metrics
+from hyperopt_tpu.parallel import netstore as netstore_mod
+from hyperopt_tpu.parallel.netstore import NetTrials, safe_loads
+from hyperopt_tpu.service import MemTrials, Tenant, TenantTable
+from hyperopt_tpu.service import wal as wal_mod
+from hyperopt_tpu.service.server import ServiceServer
+
+
+def _counter(name: str) -> float:
+    return _metrics.registry().snapshot().get("counters", {}).get(name, 0)
+
+
+def _mk_docs(tids, exp_key, xs):
+    docs = []
+    for tid, x in zip(tids, xs):
+        d = base.new_trial_doc(tid, exp_key, None)
+        d["misc"]["idxs"] = {"x": [tid]}
+        d["misc"]["vals"] = {"x": [float(x)]}
+        docs.append(d)
+    return docs
+
+
+def _complete(doc, loss):
+    doc["state"] = JOB_STATE_DONE
+    doc["result"] = {"status": STATUS_OK, "loss": float(loss)}
+    return doc
+
+
+def _mk_domain():
+    space = {"x": hp.uniform("x", -5, 5),
+             "c": hp.choice("c", [0, 1, 2])}
+    return base.Domain(lambda a: a["x"] ** 2, space)
+
+
+@pytest.fixture(autouse=True)
+def _clean_peer_pins():
+    """Negotiation pins are process-global by design; tests must not
+    leak them into each other."""
+    netstore_mod._JSON_ONLY_PEERS.clear()
+    yield
+    netstore_mod._JSON_ONLY_PEERS.clear()
+
+
+# ---------------------------------------------------------------------------
+# codec: fixtures, structure, errors
+# ---------------------------------------------------------------------------
+
+
+class TestCodec:
+    def test_every_framed_verb_has_a_fixture(self):
+        assert set(wire.CODEC_FIXTURES) == set(wire.FRAMED_VERBS)
+        for verb, fx in wire.CODEC_FIXTURES.items():
+            assert "req" in fx and "reply" in fx, verb
+
+    def test_fixtures_round_trip_both_directions(self):
+        """encode→decode must equal the lossless JSON twin for every
+        framed verb, request AND reply — the runtime half of WP008."""
+        for verb, fx in wire.CODEC_FIXTURES.items():
+            for direction in ("req", "reply"):
+                payload = fx[direction]
+                buf = wire.encode(payload)
+                assert wire.is_frame(buf), (verb, direction)
+                assert wire.decode(buf) == json.loads(
+                    json.dumps(payload)), (verb, direction)
+
+    def test_columnar_pack_preserves_key_order_and_identity(self):
+        docs = _mk_docs([0, 1, 2, 3], "e", [0.1, 0.2, 0.3, 0.4])
+        docs[2] = _complete(docs[2], 1.5)
+        out = wire.decode(wire.encode({"docs": docs}))
+        assert out == {"docs": docs}
+        # dict key insertion order is part of the contract (state_bytes
+        # hashes serialized docs) — not just set-equality
+        assert list(out["docs"][0]) == list(docs[0])
+
+    def test_marker_keys_in_user_payloads_are_escaped(self):
+        evil = [{"__seg__": 0, "x": 1.0}, {"__recs__": [1], "x": 2.0},
+                {"__lit__": {"a": 1}, "x": 3.0},
+                {"__const__": 5, "__range__": [0, 2], "x": 4.0}]
+        assert wire.decode(wire.encode({"docs": evil})) == {"docs": evil}
+
+    def test_const_container_columns_do_not_alias(self):
+        docs = [{"tid": i, "vals": {}, "row": []} for i in range(4)]
+        out = wire.decode(wire.encode(docs))
+        out[0]["vals"]["k"] = 1
+        out[0]["row"].append(9)
+        assert out[1]["vals"] == {} and out[1]["row"] == []
+
+    def test_non_json_payload_raises_not_corrupts(self):
+        with pytest.raises(TypeError):
+            wire.encode({"x": object()})
+
+    def test_bad_frames_raise_wire_error(self):
+        good = wire.encode({"a": 1})
+        for bad in (b"", b"HTW", b"XXXX" + good[4:],
+                    good[:-1],                      # truncated header tail
+                    good[:4] + b"\xff\xff" + good[6:]):  # future version
+            with pytest.raises(wire.WireError):
+                wire.decode(bad)
+
+    def test_is_frame_rejects_json_bodies(self):
+        assert not wire.is_frame(b'{"verb": "docs"}')
+        assert not wire.is_frame(b"")
+
+
+# ---------------------------------------------------------------------------
+# float bit-parity: JSON WAL line vs binary frame (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+_EDGE_FLOATS = [
+    float("nan"), float("inf"), float("-inf"),
+    0.0, -0.0, 1.0, -1.0,
+    # f32 subnormal territory
+    float(np.float32(2.0 ** -149)), float(np.float32(2.0 ** -126)),
+    1e-45, 5e-324,
+    # the f32 integer lattice edge: 2**24 is the last exactly
+    # representable contiguous integer
+    float(2 ** 24 - 1), float(2 ** 24), float(2 ** 24 + 1),
+    -float(2 ** 24 + 1),
+    # q-lattice style values that famously drift through dtype casts
+    0.1, 0.30000000000000004, 1.0 / 3.0,
+]
+
+
+class TestFloatParity:
+    @pytest.mark.parametrize("v", _EDGE_FLOATS,
+                             ids=[repr(v) for v in _EDGE_FLOATS])
+    def test_wal_json_line_and_frame_land_identical_bits(self, v):
+        """The exact shape both planes carry: a WAL line is
+        ``json.dumps(record)`` and a frame is ``wire.encode(record)``.
+        Both must return the same f64 bit pattern, and the same f32
+        bits after the history-column cast."""
+        doc = {"result": {"loss": v, "status": STATUS_OK},
+               "misc": {"vals": {"x": [v]}}}
+        record = {"verb": "write_result", "doc": doc}
+        via_json = json.loads(json.dumps(record))
+        via_frame = wire.decode(wire.encode(record))
+
+        for out in (via_json, via_frame):
+            got = out["doc"]["result"]["loss"]
+            assert struct.pack("<d", got) == struct.pack("<d", v)
+            gv = out["doc"]["misc"]["vals"]["x"][0]
+            assert (struct.pack("<f", np.float32(gv))
+                    == struct.pack("<f", np.float32(v)))
+
+    def test_random_f32_batch_survives_columnar_segments(self):
+        rng = np.random.default_rng(19)
+        xs = rng.standard_normal(64).astype(np.float32)
+        docs = []
+        for i, x in enumerate(xs):
+            d = _complete(_mk_docs([i], "e", [float(x)])[0],
+                          float(x) ** 2)
+            docs.append(d)
+        out = wire.decode(wire.encode({"docs": docs}))
+        got = np.asarray([d["misc"]["vals"]["x"][0] for d in out["docs"]],
+                         dtype=np.float32)
+        assert got.tobytes() == xs.tobytes()
+
+    def test_nan_survives_columnar_collapse(self):
+        # all-NaN is the constant-column edge: NaN != NaN, so the
+        # collapse must compare bits, not values
+        docs = [{"tid": i, "loss": float("nan")} for i in range(3)]
+        out = wire.decode(wire.encode(docs))
+        assert all(math.isnan(d["loss"]) for d in out)
+
+
+# ---------------------------------------------------------------------------
+# restricted attachment unpickler (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+class _EvilPayload:
+    """A classic pickle RCE gadget: unpickling calls the reduce target."""
+
+    def __reduce__(self):
+        return (os.system, ("echo pwned",))
+
+
+class TestSafeLoads:
+    def test_malicious_reduce_payload_is_refused(self):
+        blob = pickle.dumps(_EvilPayload())
+        with pytest.raises(pickle.UnpicklingError,
+                           match="forbidden global"):
+            safe_loads(blob)
+
+    def test_even_harmless_stdlib_callables_are_refused(self):
+        # the allowlist is positive, not a denylist of known gadgets
+        blob = pickle.dumps(getattr)
+        with pytest.raises(pickle.UnpicklingError):
+            safe_loads(blob)
+
+    def test_benign_attachment_shapes_round_trip(self):
+        payloads = [
+            {"a": [1, 2.5, "s", None, True], "b": (3, 4)},
+            {1, 2, 3}, frozenset([4]), bytearray(b"xy"), range(5),
+            complex(1, 2),
+            np.arange(6, dtype=np.float32).reshape(2, 3),
+            np.float64(0.25), np.int64(-7),
+        ]
+        for p in payloads:
+            got = safe_loads(pickle.dumps(p))
+            if isinstance(p, np.ndarray):
+                assert got.dtype == p.dtype and got.tobytes() == p.tobytes()
+            else:
+                assert got == p
+
+
+# ---------------------------------------------------------------------------
+# fetch_since: delta correctness (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestFetchSince:
+    def test_first_fetch_is_full_then_deltas_are_exact(self):
+        mt = MemTrials(exp_key="e")
+        mt._insert_trial_docs(_mk_docs([0, 1], "e", [0.1, 0.2]))
+        docs, cur, full = mt.docs_since(None)
+        assert full and [d["tid"] for d in docs] == [0, 1]
+        # no mutation -> empty delta, same cursor
+        docs2, cur2, full2 = mt.docs_since(cur)
+        assert docs2 == [] and not full2 and cur2 == cur
+        # one insert + one claim -> exactly the touched rows
+        mt._insert_trial_docs(_mk_docs([2], "e", [0.3]))
+        claimed = mt.reserve("w0")
+        docs3, cur3, full3 = mt.docs_since(cur)
+        assert not full3 and cur3[1] > cur[1]
+        assert sorted(d["tid"] for d in docs3) == [claimed["tid"], 2]
+
+    def test_stale_or_foreign_cursor_costs_full_resend_never_a_gap(self):
+        mt = MemTrials(exp_key="e")
+        mt._insert_trial_docs(_mk_docs([0], "e", [0.1]))
+        _, cur, _ = mt.docs_since(None)
+        for bad in (["nope", 0], [cur[0] + 1, cur[1]], [cur[0], 10 ** 9],
+                    [cur[0]], "cursor", 7):
+            docs, _, full = mt.docs_since(bad)
+            assert full and len(docs) == 1, bad
+        # delete_all mints a fresh epoch: the old cursor must full-resend
+        mt.delete_all()
+        mt._insert_trial_docs(_mk_docs([0], "e", [0.5]))
+        docs, cur2, full = mt.docs_since(cur)
+        assert full and cur2[0] != cur[0]
+
+    def test_monotone_cursor_under_concurrent_inserts_and_requeues(self):
+        """A polling reader must converge on exactly the writer's final
+        state with a strictly monotone cursor — no lost rows, no stale
+        terminal states, under concurrent inserts, claims, completions
+        and requeues."""
+        mt = MemTrials(exp_key="e")
+        mt.now_override = 0.0
+        n_rows, errs = 120, []
+
+        def writer():
+            try:
+                for i in range(n_rows):
+                    mt._insert_trial_docs(_mk_docs([i], "e", [i * 0.01]))
+                    if i % 3 == 0:
+                        doc = mt.reserve(f"w{i}")
+                        if doc is None:
+                            continue
+                        if i % 6 == 0:
+                            mt.write_result(
+                                _complete(dict(doc), float(i)),
+                                owner=f"w{i}")
+                        else:
+                            mt.now_override += 1e6   # age the claim out
+                            mt.requeue_stale(timeout=1.0)
+            except Exception as e:      # surfaced after join
+                errs.append(e)
+
+        shadow, cursor = {}, None
+        t = threading.Thread(target=writer)
+        t.start()
+        while t.is_alive():
+            docs, cur, full = mt.docs_since(cursor)
+            if cursor is not None and not full:
+                assert cur[0] == cursor[0] and cur[1] >= cursor[1]
+            if full:
+                shadow = {d["tid"]: d for d in docs}
+            else:
+                shadow.update((d["tid"], d) for d in docs)
+            cursor = cur
+        t.join()
+        assert not errs
+        # drain the tail, then the shadow must equal the store exactly
+        docs, cursor, _ = mt.docs_since(cursor)
+        shadow.update((d["tid"], d) for d in docs)
+        mt.refresh()
+        truth = {d["tid"]: d for d in mt._dynamic_trials}
+        assert shadow == truth
+        assert mt.docs_since(cursor)[0] == []
+
+    def test_quota_refused_insert_leaves_no_delta(self, tmp_path):
+        tt = TenantTable([Tenant("acme", "tok-a", trials_per_s=0.001,
+                                 burst=1)])
+        srv = ServiceServer(str(tmp_path / "wal"), tenants=tt)
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="tok-a")
+            nt._insert_trial_docs(_mk_docs([0], "e1", [0.1]))  # burst spent
+            out = nt._rpc("fetch_since", cursor=None)
+            cur = out["cursor"]
+            with pytest.raises(QuotaExceeded):
+                nt._insert_trial_docs(_mk_docs([1], "e1", [0.2]))
+            out2 = nt._rpc("fetch_since", cursor=cur)
+            assert out2["docs"] == [] and not out2["full"]
+            assert out2["cursor"] == cur
+        finally:
+            srv.shutdown()
+
+    def test_client_refresh_rides_deltas_end_to_end(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_WIRE", "binary")
+        srv = ServiceServer(str(tmp_path / "wal"), token="t")
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="t")
+            nt._insert_trial_docs(_mk_docs([0, 1, 2], "e1",
+                                           [0.1, 0.2, 0.3]))
+            nt.refresh()
+            assert nt._cursor is not None
+            rows0 = _counter("store.delta.rows")
+            doc = nt.reserve("w0")
+            nt.write_result(_complete(doc, 4.0), owner="w0")
+            nt._insert_trial_docs(_mk_docs([3], "e1", [0.4]))
+            nt.refresh()
+            # only the touched rows crossed the wire
+            assert _counter("store.delta.rows") - rows0 <= 3
+            ft = srv._store("e1", tenant=None)
+            ft.refresh()
+            assert [d["tid"] for d in nt._dynamic_trials] == [0, 1, 2, 3]
+            assert ({d["tid"]: d["state"] for d in nt._dynamic_trials}
+                    == {d["tid"]: d["state"] for d in ft._dynamic_trials})
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# suggest parity across planes (satellite 3) — the tentpole's bit contract
+# ---------------------------------------------------------------------------
+
+
+class TestSuggestParity:
+    def _drive_arm(self, tmp_path, tag, monkeypatch, wire_mode, columns):
+        monkeypatch.setenv("HYPEROPT_TPU_WIRE", wire_mode)
+        monkeypatch.setenv("HYPEROPT_TPU_SERVICE_COLUMNS", columns)
+        srv = ServiceServer(str(tmp_path / f"wal-{tag}"), token="t")
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="t")
+            nt.save_domain(_mk_domain())
+            rng = np.random.default_rng(7)
+            batches, tid0 = [], 0
+            for _ in range(3):
+                seed = int(rng.integers(2 ** 31 - 1))
+                new_ids = list(range(tid0, tid0 + 4))
+                tid0 += 4
+                docs = nt.suggest(seed, new_ids=new_ids, insert=False,
+                                  n_startup_jobs=4)
+                batches.append(docs)
+                done = [_complete(d, d["misc"]["vals"]["x"][0] ** 2)
+                        for d in json.loads(json.dumps(docs))]
+                nt._insert_trial_docs(done)
+            return batches
+        finally:
+            srv.shutdown()
+
+    def test_binary_columnar_arm_matches_json_arm_bitwise(
+            self, tmp_path, monkeypatch):
+        """Three evolving batches (past the startup boundary, so the
+        fitted posterior reads the columnar history) must emit
+        byte-identical proposals on the JSON/base-walk arm and the
+        binary/columnar arm."""
+        a = self._drive_arm(tmp_path, "json", monkeypatch, "json", "0")
+        b = self._drive_arm(tmp_path, "bin", monkeypatch, "binary", "1")
+        assert json.dumps(a, sort_keys=True) == json.dumps(
+            b, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# negotiation: auto-mode fallback against a json-pinned peer
+# ---------------------------------------------------------------------------
+
+
+class TestNegotiation:
+    def test_auto_client_downgrades_once_against_json_server(
+            self, tmp_path, monkeypatch):
+        """Client (main thread) speaks auto; the server's handler
+        threads are pinned json, so the first framed verb is refused
+        with WireError — the client must fall back to JSON with the
+        SAME request, pin the peer, count one fallback, and never
+        attempt a frame against it again."""
+        main = threading.get_ident()
+
+        def split_mode():
+            return "auto" if threading.get_ident() == main else "json"
+
+        monkeypatch.setattr(wire, "mode", split_mode)
+        srv = ServiceServer(str(tmp_path / "wal"), token="t")
+        srv.start()
+        try:
+            fb0 = _counter("wire.json_fallbacks")
+            nt = NetTrials(srv.url, exp_key="e1", token="t")
+            nt._insert_trial_docs(_mk_docs([0, 1], "e1", [0.1, 0.2]))
+            assert _counter("wire.json_fallbacks") - fb0 == 1
+            assert nt._rpc.url in netstore_mod._JSON_ONLY_PEERS
+            nt.refresh()                      # framed verb, now JSON path
+            assert [d["tid"] for d in nt._dynamic_trials] == [0, 1]
+            assert _counter("wire.json_fallbacks") - fb0 == 1
+            # the insert executed exactly once despite the re-send
+            ft = srv._store("e1", tenant=None)
+            ft.refresh()
+            assert len(ft._dynamic_trials) == 2
+        finally:
+            srv.shutdown()
+
+    def test_quota_error_on_framed_verb_never_downgrades(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_WIRE", "auto")
+        tt = TenantTable([Tenant("acme", "tok-a", trials_per_s=0.001,
+                                 burst=1)])
+        srv = ServiceServer(str(tmp_path / "wal"), tenants=tt)
+        srv.start()
+        try:
+            nt = NetTrials(srv.url, exp_key="e1", token="tok-a")
+            nt._insert_trial_docs(_mk_docs([0], "e1", [0.1]))
+            fb0 = _counter("wire.json_fallbacks")
+            with pytest.raises(QuotaExceeded):
+                nt._insert_trial_docs(_mk_docs([1], "e1", [0.2]))
+            assert _counter("wire.json_fallbacks") == fb0
+            assert nt._rpc.url not in netstore_mod._JSON_ONLY_PEERS
+        finally:
+            srv.shutdown()
+
+    def test_binary_frames_actually_flow_and_are_counted(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_WIRE", "binary")
+        srv = ServiceServer(str(tmp_path / "wal"), token="t")
+        srv.start()
+        try:
+            f0, tx0, rx0 = (_counter("wire.frames"),
+                            _counter("wire.bytes_tx"),
+                            _counter("wire.bytes_rx"))
+            nt = NetTrials(srv.url, exp_key="e1", token="t")
+            nt._insert_trial_docs(_mk_docs([0, 1], "e1", [0.1, 0.2]))
+            nt.refresh()
+            assert _counter("wire.frames") > f0
+            assert _counter("wire.bytes_tx") > tx0
+            assert _counter("wire.bytes_rx") > rx0
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# durability: columnar snapshots, crash windows, format compatibility
+# ---------------------------------------------------------------------------
+
+
+class TestColumnarSnapshot:
+    def _drive(self, srv, token="t"):
+        nt = NetTrials(srv.url, exp_key="e1", token=token)
+        nt._insert_trial_docs(_mk_docs([0, 1, 2], "e1", [0.1, 0.2, 0.3]))
+        doc = nt.reserve("w0")
+        nt.write_result(_complete(doc, 7.0), owner="w0")
+        nt.reserve("w1")
+        return nt
+
+    def test_format2_snapshot_tail_replay_byte_identical(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_WIRE", "binary")
+        wal_dir = str(tmp_path / "wal")
+        srv = ServiceServer(wal_dir, token="t")
+        srv.start()
+        nt = self._drive(srv)
+        srv.snapshot()
+        doc = nt.reserve("w2")
+        nt.write_result(_complete(doc, 9.0), owner="w2")
+        state_a = srv.state_bytes()
+        srv.shutdown()
+
+        with open(os.path.join(wal_dir, "snapshot.json")) as f:
+            manifest = json.load(f)
+        assert manifest["format"] == 2
+        slab = os.path.join(wal_dir, manifest["sidecar"])
+        with open(slab, "rb") as f:
+            assert wire.is_frame(f.read())
+
+        srv2 = ServiceServer(wal_dir, token="t")
+        try:
+            assert srv2.state_bytes() == state_a
+        finally:
+            srv2.shutdown()
+
+    def test_crash_windows_mid_snapshot_retain_previous(
+            self, tmp_path, monkeypatch):
+        """A SIGKILL at either window of the second snapshot — after
+        the new slab is written but before the manifest commits, or
+        mid slab-tmp write — must recover from the retained previous
+        snapshot + tail."""
+        monkeypatch.setenv("HYPEROPT_TPU_WIRE", "binary")
+        wal_dir = str(tmp_path / "wal")
+        srv = ServiceServer(wal_dir, token="t")
+        srv.start()
+        nt = self._drive(srv)
+        srv.snapshot()                               # snapshot A commits
+        doc = nt.reserve("w2")
+        nt.write_result(_complete(doc, 9.0), owner="w2")
+        state_a = srv.state_bytes()
+        srv.shutdown()
+
+        # window 1: a newer slab landed, manifest still points at A
+        # (the prune runs only AFTER the manifest commit, so A's slab
+        # is guaranteed present)
+        orphan = os.path.join(wal_dir, "snapshot-99999999999999.slab")
+        with open(orphan, "wb") as f:
+            f.write(wire.encode({"stores": []}))
+        # window 2: a torn slab tmp from the dying writer
+        with open(orphan + ".tmp.12345", "wb") as f:
+            f.write(b"HTW1 torn mid-write")
+        srv2 = ServiceServer(wal_dir, token="t")
+        try:
+            assert srv2.state_bytes() == state_a
+            srv2.snapshot()                          # prunes the debris
+        finally:
+            srv2.shutdown()
+        left = sorted(n for n in os.listdir(wal_dir) if "slab" in n)
+        assert len(left) == 1 and not left[0].endswith(".tmp")
+
+    def test_corrupt_slab_fails_on_sha_not_silently(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_WIRE", "binary")
+        wal_dir = str(tmp_path / "wal")
+        srv = ServiceServer(wal_dir, token="t")
+        srv.start()
+        self._drive(srv)
+        srv.snapshot()
+        srv.shutdown()
+        with open(os.path.join(wal_dir, "snapshot.json")) as f:
+            slab = os.path.join(wal_dir, json.load(f)["sidecar"])
+        blob = bytearray(open(slab, "rb").read())
+        blob[-1] ^= 0xFF
+        with open(slab, "wb") as f:
+            f.write(blob)
+        with pytest.raises(ValueError, match="sha256"):
+            wal_mod.read_wal(wal_dir)
+
+    def test_old_format1_snapshot_replays_under_binary_mode(
+            self, tmp_path, monkeypatch):
+        """Upgrade path: a store snapshotted by a JSON-mode (or pre-r19)
+        server, plus its WAL tail, must replay byte-identically when
+        reopened with the binary plane on."""
+        wal_dir = str(tmp_path / "wal")
+        monkeypatch.setenv("HYPEROPT_TPU_WIRE", "json")
+        srv = ServiceServer(wal_dir, token="t")
+        srv.start()
+        nt = self._drive(srv)
+        srv.snapshot()
+        doc = nt.reserve("w2")
+        nt.write_result(_complete(doc, 9.0), owner="w2")
+        state_a = srv.state_bytes()
+        srv.shutdown()
+        with open(os.path.join(wal_dir, "snapshot.json")) as f:
+            assert json.load(f).get("format", 1) == 1
+
+        monkeypatch.setenv("HYPEROPT_TPU_WIRE", "binary")
+        srv2 = ServiceServer(wal_dir, token="t")
+        try:
+            assert srv2.state_bytes() == state_a
+        finally:
+            srv2.shutdown()
+
+    def test_inspect_reports_slab_bytes(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HYPEROPT_TPU_WIRE", "binary")
+        wal_dir = str(tmp_path / "wal")
+        srv = ServiceServer(wal_dir, token="t")
+        srv.start()
+        self._drive(srv)
+        srv.snapshot()
+        srv.shutdown()
+        info = wal_mod.inspect(wal_dir)
+        assert info["snapshot"] is not None
+        with open(os.path.join(wal_dir, "snapshot.json")) as f:
+            manifest = json.load(f)
+        slab_sz = os.path.getsize(os.path.join(wal_dir,
+                                               manifest["sidecar"]))
+        assert info["snapshot"]["bytes"] >= slab_sz
+
+
+# ---------------------------------------------------------------------------
+# service store hot columns: parity with the base walk
+# ---------------------------------------------------------------------------
+
+
+class TestHotColumns:
+    def _fill(self, mt, n=12):
+        mt._insert_trial_docs(_mk_docs(list(range(n)), "e",
+                                       [i * 0.1 for i in range(n)]))
+        for i in range(0, n, 2):
+            doc = mt.reserve(f"w{i}")
+            mt.write_result(_complete(dict(doc), doc["tid"] * 1.0),
+                            owner=f"w{i}")
+
+    def test_history_matches_base_walk_bitwise(self):
+        from hyperopt_tpu.space import compile_space
+
+        mt = MemTrials(exp_key="e")
+        self._fill(mt)
+        mt.refresh()
+        cs = compile_space({"x": hp.uniform("x", -5, 5)})
+        cols = mt.history(cs)
+        ref = base.Trials.history(mt, cs)
+        for k in ("vals", "active", "loss", "ok", "tids"):
+            assert np.array_equal(np.asarray(cols[k]), np.asarray(ref[k]),
+                                  equal_nan=True), k
+
+    def test_out_of_order_completion_rebuilds_not_corrupts(self):
+        from hyperopt_tpu.space import compile_space
+
+        mt = MemTrials(exp_key="e")
+        mt._insert_trial_docs(_mk_docs([0, 1, 2], "e", [0.1, 0.2, 0.3]))
+        cs = compile_space({"x": hp.uniform("x", -5, 5)})
+        # complete tid 2 first, then tid 0 — an out-of-tid-order landing
+        for tid, w in ((2, "a"), (0, "b")):
+            mt._claims[tid] = w
+            doc = dict(mt._by_tid[tid])
+            doc["owner"] = w
+            mt.history(cs)            # materialize between completions
+            mt.write_result(_complete(doc, float(tid)), owner=w)
+        mt.refresh()
+        cols = mt.history(cs)
+        ref = base.Trials.history(mt, cs)
+        for k in ("vals", "active", "loss", "ok", "tids"):
+            assert np.array_equal(np.asarray(cols[k]), np.asarray(ref[k]),
+                                  equal_nan=True), k
+
+    def test_disabled_gate_falls_back_to_base(self, monkeypatch):
+        from hyperopt_tpu.space import compile_space
+
+        monkeypatch.setenv("HYPEROPT_TPU_SERVICE_COLUMNS", "0")
+        mt = MemTrials(exp_key="e")
+        self._fill(mt, n=4)
+        assert not mt._cols_enabled()
+        mt.refresh()
+        cs = compile_space({"x": hp.uniform("x", -5, 5)})
+        ref = base.Trials.history(mt, cs)
+        cols = mt.history(cs)
+        for k in ("vals", "active", "loss", "ok", "tids"):
+            assert np.array_equal(np.asarray(cols[k]), np.asarray(ref[k]),
+                                  equal_nan=True), k

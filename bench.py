@@ -72,6 +72,7 @@ PHASE_DEADLINES = {
     "multichip": 600.0,
     "service_hotpath": 600.0,
     "wire": 600.0,
+    "elastic": 600.0,
     "result": 60.0,
 }
 
@@ -774,6 +775,25 @@ def child():
         _say("partial", partial)
     except Exception as e:
         partial["wire_error"] = f"{type(e).__name__}: {e}"
+        _say("partial", partial)
+
+    # Elastic fleet (r20): open-loop diurnal + flash-crowd load against
+    # the autoscaler control plane — scale-ups under backlog burn,
+    # socket-kills of both seeded primaries mid-ramp with single-flight
+    # promotion, bounded per-store cutovers, and the WAL decision-log
+    # replay check.  Host-only — no device work.
+    _say("phase", {"name": "elastic"})
+    try:
+        from benchmarks.elastic_load import collect as _el_collect
+
+        el = _el_collect(fast=fast)
+        assert el["headline"]["zero_lost_dup"], "elastic arm lost/duped a tid"
+        assert el["headline"]["decision_log_replays"], \
+            "autoscaler decision log failed to replay"
+        partial["elastic"] = el
+        _say("partial", partial)
+    except Exception as e:
+        partial["elastic_error"] = f"{type(e).__name__}: {e}"
         _say("partial", partial)
 
     _say("phase", {"name": "result"})

@@ -1,0 +1,549 @@
+"""Elastic fleet under open-loop load: autoscaler, kills, zero lost tids.
+
+The acceptance harness for the self-driving elastic fleet: a seed fleet
+of 2 :class:`~hyperopt_tpu.service.replica.ShardServer` primaries (each
+with a warm WAL-shipped replica) behind one
+:class:`~hyperopt_tpu.service.router.Router`, an
+:class:`~hyperopt_tpu.service.autoscaler.Autoscaler` with a
+:class:`~hyperopt_tpu.service.autoscaler.LocalSpawner` allowed to grow
+the fleet to 4 shards, and
+
+* **100 000 worker identities** — one distinct owner per trial, spread
+  over 16 ``exp_key`` stores, each completing one
+  reserve -> evaluate -> write cycle through the router's shard map
+  (placement moves under the clients' feet as the fleet grows and
+  shrinks: the typed ``ShardFenced`` redirect carries them across every
+  bounded cutover);
+* a **diurnal + flash-crowd arrival process** — open loop: a pacer
+  enqueues cycles on a sinusoidal "day" with a 2.5x flash crowd burst
+  mid-stream, regardless of completion, so a struggling fleet shows up
+  as queueing delay in the cycle percentiles, never as silently
+  throttled load.  The autoscaler is driven by the real backlog (burn =
+  seconds of queued arrivals), so the flash crowd is what forces the
+  scale-ups — and, at the 4-shard wall, the shed;
+* a **kill schedule** — both seeded primaries are killed at the socket
+  mid-ramp (the process-SIGKILL torn-tail variant lives in
+  tests/test_service_fleet.py / test_service_elastic.py).  Clients
+  reroute through the router, the router promotes the warm replicas
+  single-flight, and the stream continues across the failovers AND the
+  concurrent topology changes.
+
+The acceptance bar: every store ends with its full contiguous tid range
+(**zero lost, zero duplicated**), every result carries its own store's
+stamp (zero leakage), final placement agrees with the live shard map,
+and the WAL decision log **replays** — a fresh control plane loaded
+from the log agrees with the live one on every topology change it made.
+
+Run::
+
+    env JAX_PLATFORMS=cpu python benchmarks/elastic_load.py
+    env JAX_PLATFORMS=cpu python benchmarks/elastic_load.py --fast \
+        --no-artifact                # scaled-down sanity run
+
+Writes ``benchmarks/elastic_load_cpu_<stamp>.json`` with per-verb
+latencies, per-phase (base / flash) open-loop percentiles, the decision
+log tail, chaos counters and the headline gates.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import queue
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+
+SEED_SHARDS = 2                   # killable: primary + warm replica each
+MAX_SHARDS = 4                    # spawner headroom: 2 elastic shards
+EXP_KEYS = 16
+WORKERS = 100_000                 # identities = trials: one cycle each
+THREADS = 24                      # OS threads draining the arrival queue
+BASE_RATE_CPS = 75.0              # diurnal midline (cycles/s); the flash
+                                  # peak (x2.5) overruns the in-process
+                                  # fleet (~130 cycles/s) on purpose, the
+                                  # diurnal peak (x1.5) must not
+DIURNAL_AMP = 0.5                 # rate swings +-50% over the "day"
+FLASH_WINDOW = (0.45, 0.55)       # arrival-stream span of the flash crowd
+FLASH_MULT = 2.5
+KILL_FRACS = (0.30, 0.62)         # both seeded primaries die mid-ramp
+INSERT_CHUNK = 250
+SEED = 0
+DRAIN_ROUNDS = 10
+SETTLE_TIMEOUT_S = 1500.0
+BACKLOG_TARGET_S = 3.0            # burn 1.0 == 3s of queued arrivals
+
+
+def _mk_docs(tids, exp_key, xs):
+    from hyperopt_tpu import base
+
+    docs = []
+    for tid, x in zip(tids, xs):
+        d = base.new_trial_doc(tid, exp_key, None)
+        d["misc"]["idxs"] = {"x": [tid]}
+        d["misc"]["vals"] = {"x": [float(x)]}
+        docs.append(d)
+    return docs
+
+
+def _rate_at(frac: float, base: float) -> float:
+    """Offered arrival rate at stream position ``frac`` in [0, 1)."""
+    r = base * (1.0 + DIURNAL_AMP * math.sin(2.0 * math.pi * frac))
+    if FLASH_WINDOW[0] <= frac < FLASH_WINDOW[1]:
+        r *= FLASH_MULT
+    return max(r, 1.0)
+
+
+def collect(fast=False, workers=None, base_rate=None):
+    os.environ.setdefault("HYPEROPT_TPU_NETSTORE_RETRIES", "3")
+    os.environ.setdefault("HYPEROPT_TPU_NETSTORE_BACKOFF", "0.005")
+
+    from hyperopt_tpu.base import (
+        JOB_STATE_DONE,
+        JOB_STATE_RUNNING,
+        STATUS_OK,
+    )
+    from hyperopt_tpu.exceptions import (Backpressure,
+                                         NetstoreUnavailable,
+                                         ShardFenced)
+    from hyperopt_tpu.obs import metrics as _metrics
+    from hyperopt_tpu.parallel.netstore import RouterTrials
+    from hyperopt_tpu.service.autoscaler import Autoscaler, LocalSpawner
+    from hyperopt_tpu.service.replica import ShardServer
+    from hyperopt_tpu.service.router import Router
+
+    workers = workers or (4_000 if fast else WORKERS)
+    base_rate = base_rate or (300.0 if fast else BASE_RATE_CPS)
+    threads_n = 12 if fast else THREADS
+    # The short fast stream never accumulates 3s of backlog before it
+    # ends; a tighter target keeps the scale-up story in the sanity arm.
+    backlog_target_s = 0.5 if fast else BACKLOG_TARGET_S
+    _metrics.registry().snapshot(reset=True)
+    root = tempfile.mkdtemp(prefix="elastic_load_")
+    per_key = workers // EXP_KEYS
+    workers = per_key * EXP_KEYS
+    exp_keys = [f"exp-{i:02d}" for i in range(EXP_KEYS)]
+
+    # -- seed fleet: 2 killable primaries, each with a warm replica --------
+    primaries, replicas, shards_spec = [], [], {}
+    for i in range(SEED_SHARDS):
+        prim = ShardServer(wal_dir=os.path.join(root, f"s{i}p"),
+                           role="primary", fsync="batch")
+        prim.start()
+        repl = ShardServer(wal_dir=os.path.join(root, f"s{i}r"),
+                           role="replica", fsync="batch")
+        repl.start()
+        prim.attach_replica(repl.url)
+        primaries.append(prim)
+        replicas.append(repl)
+        shards_spec[f"s{i}"] = {"primary": prim.url, "replica": repl.url}
+    router = Router(shards_spec, retries=2, backoff=0.01)
+    router.start()
+    spawner = LocalSpawner(os.path.join(root, "auto"), fsync="batch")
+    scaler = Autoscaler(router, spawner=spawner,
+                        wal_dir=os.path.join(root, "decisions"),
+                        interval_s=0.25,
+                        cooldown_s=3.0 if fast else 6.0,
+                        min_shards=SEED_SHARDS, max_shards=MAX_SHARDS,
+                        calm_ticks=4 if fast else 8)
+    router.attach_autoscaler(scaler)
+
+    tls = threading.local()
+
+    def _client(ek):
+        cache = getattr(tls, "cache", None)
+        if cache is None:
+            cache = tls.cache = {}
+        rt = cache.get(ek)
+        if rt is None:
+            rt = cache[ek] = RouterTrials(router.url, exp_key=ek,
+                                          retries=2, map_refresh_s=1.0)
+        return rt
+
+    # -- offered work: one doc per identity, inserted up front -------------
+    rng = np.random.default_rng(SEED)
+    t_ins = time.perf_counter()
+    for ek in exp_keys:
+        rt = _client(ek)
+        tids = rt.new_trial_ids(per_key)
+        xs = rng.uniform(-5, 5, size=per_key)
+        for lo in range(0, per_key, INSERT_CHUNK):
+            while True:
+                try:
+                    rt._insert_trial_docs(
+                        _mk_docs(tids[lo:lo + INSERT_CHUNK], ek,
+                                 xs[lo:lo + INSERT_CHUNK]))
+                    break
+                except Backpressure as e:  # pragma: no cover - calm fleet
+                    time.sleep(e.retry_after_s)
+    insert_s = time.perf_counter() - t_ins
+
+    # -- open-loop paced phase with the autoscaler in the loop -------------
+    work: queue.Queue = queue.Queue()
+    paced_done = threading.Event()
+    stop = threading.Event()
+    lock = threading.Lock()
+    stats = {"completed": 0, "retried": 0, "fenced": 0, "empty": 0}
+    latencies: dict = {"base": [], "flash": []}
+    inflight = [0]
+    killed: list = []
+    rate_now = [base_rate]
+
+    def _kill(sid):
+        prim = primaries[int(sid[1:])]
+        prim._httpd.shutdown()
+        prim._httpd.server_close()
+        with lock:
+            killed.append((sid, round(time.perf_counter() - t0, 3)))
+
+    def _cycle(item) -> bool:
+        ek, owner, t_arr, phase = item
+        rt = _client(ek)
+        try:
+            doc = rt.reserve(owner)
+        except (NetstoreUnavailable, ShardFenced, RuntimeError,
+                OSError):
+            return False
+        if doc is None:
+            with lock:
+                stats["empty"] += 1     # a retried item raced a drain
+            return True
+        x = doc["misc"]["vals"]["x"][0]
+        doc["state"] = JOB_STATE_DONE
+        # The store stamp is the bleed probe: a doc surfacing in another
+        # exp_key's namespace carries the wrong stamp.
+        doc["result"] = {"status": STATUS_OK, "loss": float(x) ** 2,
+                         "exp": ek, "owner": owner}
+        try:
+            ok = rt.write_result(doc, owner=owner)
+        except (NetstoreUnavailable, ShardFenced, RuntimeError,
+                OSError):
+            return False
+        if not ok:
+            with lock:
+                stats["fenced"] += 1
+            return False
+        with lock:
+            stats["completed"] += 1
+            latencies[phase].append(time.perf_counter() - t_arr)
+        return True
+
+    def _worker():
+        while not stop.is_set():
+            try:
+                item = work.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            with lock:
+                inflight[0] += 1
+            try:
+                if not _cycle(item):
+                    with lock:
+                        stats["retried"] += 1
+                    time.sleep(0.02)      # failover window: do not spin
+                    work.put(item)
+            finally:
+                with lock:
+                    inflight[0] -= 1
+
+    def _pace():
+        pending_kills = [(f, f"s{j}") for j, f in enumerate(KILL_FRACS)]
+        next_t = time.perf_counter()
+        for n in range(workers):
+            frac = n / workers
+            while pending_kills and frac >= pending_kills[0][0]:
+                _, sid = pending_kills.pop(0)
+                threading.Thread(target=_kill, args=(sid,),
+                                 daemon=True).start()
+            r = _rate_at(frac, base_rate)
+            rate_now[0] = r
+            now = time.perf_counter()
+            if now < next_t:
+                time.sleep(next_t - now)
+            next_t += 1.0 / r
+            ek = exp_keys[n % EXP_KEYS]
+            phase = ("flash" if FLASH_WINDOW[0] <= frac < FLASH_WINDOW[1]
+                     else "base")
+            work.put((ek, f"{ek}-w{n // EXP_KEYS:05d}",
+                      time.perf_counter(), phase))
+        paced_done.set()
+
+    def _drive_scaler():
+        """The control loop, fed the REAL backlog: burn is seconds of
+        queued arrivals against the target, so the flash crowd (and any
+        capacity lost to a kill) is what moves the fleet."""
+        while not stop.is_set():
+            backlog_s = work.qsize() / max(rate_now[0], 1.0)
+            with router._lock:
+                sids = list(router._map.shards)
+                counts = {s: 0 for s in sids}
+                for ek in exp_keys:
+                    counts[router._map.owner(None, ek)[0]] += 1
+            loads = {s: counts.get(s, 0)
+                     + (0 if s.startswith("auto") else 1000)
+                     for s in sids}      # seed shards are never victims
+            try:
+                scaler.tick(signals={
+                    "burn": backlog_s / backlog_target_s,
+                    "n_shards": len(sids), "loads": loads})
+            except Exception:
+                pass                     # a raced topology change: next tick
+            stop.wait(scaler.interval_s)
+
+    t0 = time.perf_counter()
+    pool = [threading.Thread(target=_worker, daemon=True,
+                             name=f"pool-{j}") for j in range(threads_n)]
+    for t in pool:
+        t.start()
+    pacer = threading.Thread(target=_pace, daemon=True, name="pacer")
+    driver = threading.Thread(target=_drive_scaler, daemon=True,
+                              name="autoscale-driver")
+    pacer.start()
+    driver.start()
+
+    deadline = time.monotonic() + SETTLE_TIMEOUT_S
+    while time.monotonic() < deadline:
+        with lock:
+            busy = inflight[0]
+        if paced_done.is_set() and work.qsize() == 0 and busy == 0:
+            break
+        time.sleep(0.1)
+    stop.set()
+    pacer.join(timeout=10)
+    driver.join(timeout=10)
+    for t in pool:
+        t.join(timeout=10)
+    paced_s = time.perf_counter() - t0
+
+    # -- drain: complete anything a kill orphaned --------------------------
+    drain = {ek: RouterTrials(router.url, exp_key=ek, retries=2,
+                              map_refresh_s=0.5) for ek in exp_keys}
+    for _ in range(DRAIN_ROUNDS):
+        pending = 0
+        for ek, rt in drain.items():
+            while True:
+                doc = rt.reserve(f"drain-{ek}")
+                if doc is None:
+                    break
+                x = doc["misc"]["vals"]["x"][0]
+                doc["state"] = JOB_STATE_DONE
+                doc["result"] = {"status": STATUS_OK,
+                                 "loss": float(x) ** 2, "exp": ek,
+                                 "owner": f"drain-{ek}"}
+                rt.write_result(doc, owner=f"drain-{ek}")
+            rt.refresh()
+            for d in rt._dynamic_trials:
+                if d["state"] == JOB_STATE_DONE:
+                    continue
+                pending += 1
+                if d["state"] == JOB_STATE_RUNNING and d.get("owner"):
+                    d["state"] = JOB_STATE_DONE
+                    x = d["misc"]["vals"]["x"][0]
+                    d["result"] = {"status": STATUS_OK,
+                                   "loss": float(x) ** 2, "exp": ek,
+                                   "owner": d["owner"]}
+                    rt.write_result(d, owner=d["owner"])
+        if pending == 0:
+            break
+
+    # -- quiesce: the calm tail of the day shrinks the fleet home ----------
+    for _ in range(40):
+        with router._lock:
+            n = len(router._map.shards)
+        if n <= SEED_SHARDS:
+            break
+        try:
+            scaler.tick(signals={"burn": 0.0, "n_shards": n,
+                                 "loads": {s: (0 if s.startswith("auto")
+                                               else 1000)
+                                           for s in router._map.shards}})
+        except Exception:
+            pass
+        time.sleep(0.5)
+    wall_s = time.perf_counter() - t0
+
+    # -- exactly-once + placement audit (chaos over: clean reads) ----------
+    key_rows, done_total, dups, leaks = [], 0, 0, 0
+    range_ok_all = True
+    with router._lock:
+        final_owner = {ek: router._map.owner(None, ek)[0]
+                       for ek in exp_keys}
+        final_shards = list(router._map.shards)
+    for ek in exp_keys:
+        rt = drain[ek]
+        rt.refresh()
+        docs = rt._dynamic_trials
+        tids = sorted(d["tid"] for d in docs)
+        k_dups = len(tids) - len(set(tids))
+        k_done = sum(1 for d in docs if d["state"] == JOB_STATE_DONE)
+        k_leaks = sum(1 for d in docs
+                      if d["state"] == JOB_STATE_DONE
+                      and d["result"].get("exp") != ek)
+        range_ok = tids == list(range(per_key))
+        dups += k_dups
+        leaks += k_leaks
+        done_total += k_done
+        range_ok_all = range_ok_all and range_ok
+        key_rows.append({
+            "exp_key": ek, "final_shard": final_owner[ek],
+            "trials": len(docs), "done": k_done, "dups": k_dups,
+            "tid_range_ok": range_ok, "stamp_leaks": k_leaks,
+        })
+
+    # -- the decision log must EXPLAIN the run: replay and compare ---------
+    live = scaler.status()
+    scaler.stop()
+    replayed = Autoscaler(router, wal_dir=os.path.join(root, "decisions"))
+    replay_ok = (replayed._seq == scaler._seq
+                 and [d["action"] for d in replayed.status()["decisions"]]
+                 == [d["action"] for d in live["decisions"]])
+    replayed.stop()
+
+    snap = _metrics.registry().snapshot()
+    counters = snap.get("counters", {})
+    verb_rows = []
+    for name, h in sorted(snap.get("histograms", {}).items()):
+        if name.startswith("netstore.verb.") and name.endswith(".s") \
+                and h.get("count"):
+            verb_rows.append({
+                "verb": name[len("netstore.verb."):-len(".s")],
+                "count": h["count"],
+                "p50_ms": round(1e3 * h["p50"], 3),
+                "p95_ms": round(1e3 * h["p95"], 3),
+                "p99_ms": round(1e3 * h["p99"], 3),
+            })
+
+    def _pcts(vals):
+        if not vals:
+            return {"cycles": 0, "p50_ms": None, "p95_ms": None,
+                    "p99_ms": None, "max_ms": None}
+        a = np.asarray(vals) * 1e3
+        return {"cycles": int(a.size),
+                "p50_ms": round(float(np.percentile(a, 50)), 3),
+                "p95_ms": round(float(np.percentile(a, 95)), 3),
+                "p99_ms": round(float(np.percentile(a, 99)), 3),
+                "max_ms": round(float(a.max()), 3)}
+
+    all_lat = latencies["base"] + latencies["flash"]
+    scale_ups = int(counters.get("autoscale.scale_ups", 0))
+    completed = done_total == workers and range_ok_all
+    doc = {
+        "metric": "elastic_load_openloop",
+        "backend": "cpu",
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "config": {
+            "seed_shards": SEED_SHARDS,
+            "max_shards": MAX_SHARDS,
+            "exp_keys": EXP_KEYS,
+            "workers": workers,
+            "threads": threads_n,
+            "base_rate_cps": base_rate,
+            "diurnal_amp": DIURNAL_AMP,
+            "flash_window": list(FLASH_WINDOW),
+            "flash_mult": FLASH_MULT,
+            "kill_fracs": list(KILL_FRACS),
+            "backlog_target_s": backlog_target_s,
+            "fsync": "batch",
+            "fast": bool(fast),
+        },
+        "rows": verb_rows,
+        "exp_keys": key_rows,
+        "open_loop": {
+            "overall": _pcts(all_lat),
+            "base": _pcts(latencies["base"]),
+            "flash": _pcts(latencies["flash"]),
+            "insert_phase_s": round(insert_s, 2),
+            "paced_phase_s": round(paced_s, 2),
+        },
+        "elastic": {
+            "decisions_total": scaler._seq,
+            "decision_tail": live["decisions"],
+            "scale_ups": scale_ups,
+            "scale_downs": int(counters.get("autoscale.scale_downs", 0)),
+            "sheds": int(counters.get("autoscale.sheds", 0)),
+            "recoveries": int(counters.get("autoscale.recoveries", 0)),
+            "migrated_stores": int(
+                counters.get("router.migrated_stores", 0)),
+            "client_redirects": int(
+                counters.get("netstore.client.redirects", 0)),
+            "final_shards": final_shards,
+            "replay_ok": bool(replay_ok),
+        },
+        "chaos": {
+            "kills": [{"shard": s, "t_s": t} for s, t in killed],
+            "promotions": int(counters.get("shard.promotions", 0)),
+            "router_failovers": int(counters.get("router.failovers", 0)),
+            "client_reroutes": int(
+                counters.get("netstore.client.reroutes", 0)),
+            "rpc_retries": int(counters.get("netstore.rpc.retry", 0)),
+            "idem_hits": int(counters.get("netstore.idem.hits", 0)),
+            "cycles_retried": stats["retried"],
+            "writes_fenced": stats["fenced"],
+        },
+        "headline": {
+            "workers": workers,
+            "kills": len(killed),
+            "promotions": int(counters.get("shard.promotions", 0)),
+            "scale_ups": scale_ups,
+            "trials_completed": done_total,
+            "completed": completed,
+            "zero_lost_dup": bool(range_ok_all and dups == 0),
+            "zero_leakage": bool(leaks == 0),
+            "decision_log_replays": bool(replay_ok),
+            "p99_ms": _pcts(all_lat)["p99_ms"],
+            "wall_s": round(wall_s, 2),
+            "cycles_per_sec": round(workers / wall_s, 2),
+        },
+    }
+
+    scaler.stop()
+    spawner.close()
+    router.shutdown()
+    for srv in primaries + replicas:
+        try:
+            srv.shutdown()
+        except OSError:
+            pass                        # the killed primaries' sockets
+    return doc
+
+
+def main(fast=False, workers=None, rate=None, write_artifact=True):
+    doc = collect(fast=fast, workers=workers, base_rate=rate)
+    print(json.dumps(doc["headline"], indent=1))
+    h = doc["headline"]
+    ok = (h["completed"] and h["zero_lost_dup"] and h["zero_leakage"]
+          and h["decision_log_replays"] and h["kills"] >= 2
+          and h["scale_ups"] >= 1)
+    if write_artifact:
+        stamp = time.strftime("%Y%m%d")
+        out_path = os.path.join(_ROOT, "benchmarks",
+                                f"elastic_load_cpu_{stamp}.json")
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"wrote {out_path}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="scaled-down arms (sanity run)")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="override worker identities (= trials)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="override the diurnal midline rate, cycles/s")
+    ap.add_argument("--no-artifact", action="store_true",
+                    help="headline only")
+    args = ap.parse_args()
+    raise SystemExit(main(fast=args.fast, workers=args.workers,
+                          rate=args.rate,
+                          write_artifact=not args.no_artifact))

@@ -67,6 +67,36 @@ class QuotaExceeded(HyperoptTpuError):
     """
 
 
+class Backpressure(HyperoptTpuError):
+    """The service is shedding load and asks the caller to come back later.
+
+    Unlike :class:`QuotaExceeded` (a per-tenant budget the caller is over
+    by construction), backpressure is a *fleet* condition: the autoscaler
+    tightened admission because capacity cannot grow fast enough.  The
+    server names its own price — ``retry_after_s`` — and well-behaved
+    clients (``_Rpc`` / ``RouterTrials``) sleep a jittered fraction of it
+    and retry WITHOUT burning their transport retry budget: the bytes
+    made it there and back, the server just said "not yet".
+    """
+
+    def __init__(self, message, retry_after_s=1.0):
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(message)
+
+
+class ShardFenced(HyperoptTpuError):
+    """The shard (or one store on it) is fenced for a topology change.
+
+    A typed retriable *redirect*, not a failure: the verb reached a
+    server that is mid-cutover (rebalance, promotion, or a per-store
+    migration) and deliberately refused it so the moving state stays
+    quiesced.  A routed client (``_RoutedRpc``) reacts by forcing a
+    shard-map refresh and retrying against the new owner; a direct
+    client sees it surface after the transport retry budget because a
+    fence does not lift by itself — the *map* changes instead.
+    """
+
+
 class NetstoreUnavailable(HyperoptTpuError):
     """Netstore transport failure that survived the whole retry budget.
 

@@ -13,6 +13,9 @@ Fault points wired into the core::
     rpc.recv          after the server executed the verb, before the client
                       reads the reply (the request DID happen — exercises
                       idempotent replay)
+    rpc.connect       when the pooled client dials a TCP connection —
+                      covers both the fresh dial and the transparent
+                      stale-socket redial inside ``_ConnectionPool``
     store.write       inside FileTrials' atomic document write
     worker.evaluate   around a worker's domain.evaluate call
     objective.call    at the top of Domain.evaluate (every execution path)
@@ -80,6 +83,7 @@ FAULT_POINTS = frozenset(
     {
         "rpc.send",
         "rpc.recv",
+        "rpc.connect",
         "store.write",
         "worker.evaluate",
         "objective.call",

@@ -73,7 +73,8 @@ from urllib.parse import urlsplit
 
 from .filestore import FileTrials, FileWorker, _pickler
 from ..base import JOB_STATE_RUNNING, Trials, docs_from_samples
-from ..exceptions import InjectedFault, NetstoreUnavailable, QuotaExceeded
+from ..exceptions import (Backpressure, InjectedFault, NetstoreUnavailable,
+                          QuotaExceeded, ShardFenced)
 from ..obs import bundle as _obs_bundle
 from ..obs import context as _context
 from ..obs import costs as _obs_costs
@@ -366,12 +367,25 @@ class StoreServer:
     #: truth of the dispatcher arms, so drift is impossible silently.
     _READONLY_VERBS = frozenset({
         "metrics", "health", "bundle", "docs", "fetch_since",
-        "get_domain", "att_get", "att_keys"})
+        "get_domain", "att_get", "att_keys", "stores", "store_export"})
 
     #: Verbs whose success may make a claim (or a claims-quota slot)
     #: available: each wakes the exp_key's parked long-poll reserves.
+    #: ``store_fence`` wakes them for the opposite reason — a parked
+    #: claimant on a store that just fenced for migration must surface
+    #: the typed redirect NOW, not doze out its wait budget.
     _LONGPOLL_WAKE = frozenset({
-        "insert_docs", "suggest", "requeue_stale", "write_result"})
+        "insert_docs", "suggest", "requeue_stale", "write_result",
+        "store_fence"})
+
+    #: Verbs that ADMIT new work into the system (docs inserted, ids
+    #: allocated, proposals computed).  These — and only these — are
+    #: refused with a typed retriable :class:`Backpressure` while a
+    #: shed directive is active: producers are throttled, while
+    #: consumers (reserve / write_result / heartbeat) keep running so
+    #: the backlog drains instead of wedging.
+    _ADMISSION_VERBS = frozenset({"insert_docs", "new_trial_ids",
+                                  "suggest"})
 
     def __init__(self, root: str, host: str = "127.0.0.1", port: int = 0,
                  token: str | None = None,
@@ -443,6 +457,13 @@ class StoreServer:
         # with the store table (same key space), never shrinks.
         self._claim_gates: dict = {}
         self._claim_gates_lock = threading.Lock()
+        # Load-shed directive (autoscaler-driven graceful degradation):
+        # {"level": 0..1, "retry_after_s": float, "until": monotonic
+        # deadline} or None.  Ephemeral BY DESIGN — never WAL-logged,
+        # never in snapshots: a restarted shard comes back accepting
+        # traffic and the autoscaler re-sheds if the overload persists.
+        self._shed: dict | None = None
+        self._shed_rng = random.Random(0x5EED)
         # Flight-bundle sections owned by this server: the time-series
         # window, SLO alert states and cached health verdicts travel in
         # every postmortem dump while the server lives.
@@ -549,6 +570,23 @@ class StoreServer:
                         return
                     body = json.dumps(out).encode()
                     code = 200
+                except Backpressure as e:
+                    # Deliberate load shed, not a server fault: a typed
+                    # retriable refusal with the server's own price
+                    # attached.  503, never 500 — well-behaved clients
+                    # sleep retry_after_s and try again without burning
+                    # transport retry budget.
+                    body = json.dumps(
+                        {"error": f"Backpressure: {e}",
+                         "retry_after_s": e.retry_after_s}).encode()
+                    code = 503
+                except ShardFenced as e:
+                    # Typed retriable redirect: the store/shard is mid-
+                    # cutover; the client should refresh its shard map
+                    # and re-place itself, not retry here.
+                    body = json.dumps(
+                        {"error": f"ShardFenced: {e}"}).encode()
+                    code = 409
                 except Exception as e:  # surface server faults to the client
                     body = json.dumps(
                         {"error": f"{type(e).__name__}: {e}"}).encode()
@@ -795,6 +833,11 @@ class StoreServer:
         try:
             with _context.adopt(ctx):
                 EVENTS.emit("rpc", name=verb)
+                if verb in self._ADMISSION_VERBS:
+                    # Shed gate BEFORE idempotency / WAL / cohort
+                    # machinery: a refused admission must leave no
+                    # durable trace and cache no reply.
+                    self._shed_gate(verb)
                 idem = req.pop("idem", None)
                 wait_s = req.pop("wait_s", None)
                 if verb == "reserve" and wait_s:
@@ -833,7 +876,11 @@ class StoreServer:
         except Exception as e:
             # Black-box the failing dispatch before the error surfaces
             # to the client (one boolean when the recorder is disarmed).
-            _flight.on_crash("dispatch", e)
+            # Typed control-plane refusals (shed, fence) are deliberate
+            # steady-state answers under overload/cutover, not crashes —
+            # a backpressure storm must not spam flight bundles.
+            if not isinstance(e, (Backpressure, ShardFenced)):
+                _flight.on_crash("dispatch", e)
             raise
         finally:
             # Per-verb call count + latency histogram: the contention
@@ -1007,12 +1054,65 @@ class StoreServer:
             return True
         return False
 
+    def _shed_gate(self, verb: str) -> None:
+        """Refuse an admission verb while a shed directive is active.
+
+        Probabilistic by ``level`` (1.0 sheds everything) so partial
+        degradation is possible; the directive self-expires at its
+        monotonic deadline — a dead autoscaler can throttle the fleet
+        for at most one TTL."""
+        shed = self._shed
+        if not shed:
+            return
+        if time.monotonic() >= shed["until"]:
+            self._shed = None
+            return
+        level = float(shed["level"])
+        if level >= 1.0 or self._shed_rng.random() < level:
+            _metrics.registry().counter("backpressure.shed").inc()
+            raise Backpressure(
+                f"admission shed active (level={level:.2f}): {verb} "
+                "refused, retry later",
+                retry_after_s=float(shed["retry_after_s"]))
+
     def _dispatch_verb(self, verb: str, req: dict, tenant=None,
                        idem=None) -> dict:
         if verb in self._READONLY_VERBS:
             return self._dispatch_read(verb, req, tenant=tenant)
+        if verb == "shed":
+            # Admission-control directive (autoscaler / operator):
+            # level<=0 lifts the shed, anything else arms it for ttl_s.
+            level = float(req.get("level", 1.0))
+            ttl = float(req.get("ttl_s", 30.0))
+            if level <= 0.0:
+                self._shed = None
+            else:
+                self._shed = {"level": min(level, 1.0),
+                              "retry_after_s": float(
+                                  req.get("retry_after_s", 1.0)),
+                              "until": time.monotonic() + ttl}
+            _metrics.registry().gauge("backpressure.shed_level").set(
+                max(0.0, min(level, 1.0)))
+            return {"ok": True, "level": max(0.0, min(level, 1.0)),
+                    "ttl_s": ttl}
         with self._lock:
             ft = self._store(req.get("exp_key", "default"), tenant=tenant)
+            if getattr(ft, "fenced", False) and verb not in (
+                    "store_fence", "store_import"):
+                _metrics.registry().counter("store.fenced").inc()
+                raise ShardFenced(
+                    f"store {req.get('exp_key', 'default')!r} is fenced "
+                    f"(migrating): refusing {verb!r}")
+            if verb == "store_fence":
+                ft.fence(drop=bool(req.get("drop")),
+                         lift=bool(req.get("lift")))
+                return {"ok": True, "dropped": bool(req.get("drop")),
+                        "lifted": bool(req.get("lift"))}
+            if verb == "store_import":
+                state = dict(req["state"])
+                state["fenced"] = False
+                ft.load_state(state)
+                return {"ok": True, "docs": len(state.get("docs", []))}
             if verb == "insert_docs":
                 self._charge_admission(tenant, len(req["docs"]))
                 return {"tids": ft._insert_trial_docs(req["docs"])}
@@ -1094,6 +1194,20 @@ class StoreServer:
             return {"bundle": _obs_bundle.collect_payload(
                 "verb", extra={"trigger": "verb",
                                "tenant": getattr(tenant, "name", None)})}
+        if verb == "stores":
+            # Control-plane inventory: every (tenant, exp_key) this
+            # server hosts with coarse sizes — the autoscaler's hot-key
+            # detector and the per-store migration planner read this.
+            with self._lock:
+                items = [
+                    {"tenant": t, "exp_key": k,
+                     "docs": len(getattr(ft, "_by_tid", ()) or ()),
+                     "claims": len(getattr(ft, "_claims", ()) or ()),
+                     "fenced": bool(getattr(ft, "fenced", False))}
+                    for (t, k), ft in sorted(
+                        self._trials.items(),
+                        key=lambda kv: (kv[0][0] or "", kv[0][1]))]
+            return {"stores": items}
         exp_key = req.get("exp_key", "default")
         if not self._read_dispatch:
             with self._lock:
@@ -1106,6 +1220,24 @@ class StoreServer:
         """Store-backed read arms; ``ft`` resolves concurrency above
         (lock-free probe, or under the write lock in the A/B-off arm).
         """
+        if verb == "store_export":
+            # Migration read: the store's full canonical state, exactly
+            # what the receiving shard's ``store_import`` replays.  The
+            # ONE read a fenced store still answers — the fence is what
+            # makes this snapshot final.
+            fn = getattr(ft, "state_dict", None)
+            if fn is None:
+                raise ValueError("store_export requires a service store "
+                                 "(MemTrials)")
+            return {"state": fn()}
+        if getattr(ft, "fenced", False):
+            # A fenced store's documents are moving (or moved) away;
+            # serving a read here would hand the client a stale or empty
+            # view.  Same typed redirect as the mutating path.
+            _metrics.registry().counter("store.fenced").inc()
+            raise ShardFenced(
+                f"store {req.get('exp_key', 'default')!r} is fenced "
+                f"(migrating): refusing {verb!r}")
         if verb == "docs":
             export = getattr(ft, "export_docs", None)
             if export is not None:
@@ -1178,7 +1310,8 @@ class StoreServer:
             if verb == "suggest" and not (out or {}).get("inserted"):
                 return
             key = {"insert_docs": "tids", "suggest": "tids",
-                   "requeue_stale": "n", "write_result": "ok"}[verb]
+                   "requeue_stale": "n", "write_result": "ok",
+                   "store_fence": "ok"}[verb]
             if not (out or {}).get(key):
                 return
         with self._claim_gates_lock:
@@ -1361,7 +1494,15 @@ _MUTATING_VERBS = frozenset(
 #: reconcile both directions against the dispatcher arms).
 _IDEMPOTENT_VERBS = frozenset(
     {"heartbeat", "requeue_stale", "delete_all", "put_domain",
-     "att_set", "att_del"})
+     "att_set", "att_del", "store_fence", "store_import"})
+
+#: Fleet control-plane verbs (autoscaler / operator surface): ephemeral
+#: server directives that never touch durable store state — ``shed``
+#: arms admission control on a shard, ``fence`` quiesces a whole shard
+#: for a bounded cutover.  Driven through ad-hoc RPC clients by the
+#: router and autoscaler; cataloged here so the registry-drift checker
+#: sees their client side.
+_CONTROL_VERBS = frozenset({"shed", "fence"})
 
 _BACKOFF_CAP_S = 2.0
 
@@ -1445,6 +1586,7 @@ class _ConnectionPool:
         else:
             reg.counter("rpc.pool.misses").inc()
         if conn is None:
+            _faults.maybe_fail("rpc.connect", host=host, port=port)
             conn = _http_client.HTTPConnection(host, port, timeout=timeout)
         else:
             conn.timeout = timeout
@@ -1452,24 +1594,54 @@ class _ConnectionPool:
                 conn.sock.settimeout(timeout)
         try:
             status, body, keep = self._roundtrip(conn, path, data, headers)
-        except (OSError, _http_client.HTTPException) as e:
+        except BaseException as e:
             conn.close()
-            if not reused:
-                raise self._transport_error(e) from e
-            # Stale keep-alive socket: one transparent redial.
+            if not reused or not isinstance(
+                    e, (OSError, _http_client.HTTPException)):
+                # Fresh-dial failure (a real transport error), or a
+                # non-transport exception (injected fault, interrupt):
+                # nothing to transparently retry — but never leak the
+                # half-used socket either.
+                if isinstance(e, (OSError, _http_client.HTTPException)):
+                    raise self._transport_error(e) from e
+                raise
+            # Stale keep-alive socket: one transparent redial.  If the
+            # redial itself fails — connect refused, or the rpc.connect
+            # fault point firing — the host is unreachable, and every
+            # OTHER idle socket for this key predates the failure, so
+            # they are presumed just as dead: flush them all.  Leaving
+            # them would poison the pool — each future call would check
+            # out a corpse, fail, redial, fail, one per socket.
             reg.counter("rpc.pool.stale_reconnects").inc()
-            conn = _http_client.HTTPConnection(host, port, timeout=timeout)
             try:
+                _faults.maybe_fail("rpc.connect", host=host, port=port,
+                                   redial=True)
+                conn = _http_client.HTTPConnection(host, port,
+                                                   timeout=timeout)
                 status, body, keep = self._roundtrip(conn, path, data,
                                                      headers)
-            except (OSError, _http_client.HTTPException) as e2:
+            except BaseException as e2:
                 conn.close()
-                raise self._transport_error(e2) from e2
+                self._flush_host(key)
+                if isinstance(e2, (OSError, _http_client.HTTPException)):
+                    raise self._transport_error(e2) from e2
+                raise
         if keep:
             self._checkin(key, conn)
         else:
             conn.close()
         return status, body
+
+    def _flush_host(self, key) -> None:
+        """Drop every idle socket for ``key`` (host unreachable: a
+        failed redial proves anything older is dead too)."""
+        with self._lock:
+            stale = self._idle.pop(key, [])
+        if stale:
+            _metrics.registry().counter("rpc.pool.flushed").inc(
+                len(stale))
+        for c in stale:
+            c.close()
 
     @staticmethod
     def _roundtrip(conn, path, data, headers):
@@ -1665,6 +1837,9 @@ class _Rpc:
             # the HTTP read timeout must outlive it.
             timeout = max(timeout, float(_timeout))
         attempts = 0
+        bp_honored = 0
+        bp_budget = int(os.environ.get(
+            "HYPEROPT_TPU_BACKPRESSURE_RETRIES", "8") or "8")
         t_start = time.perf_counter()
         while True:
             try:
@@ -1702,6 +1877,26 @@ class _Rpc:
                     headers["Content-Type"] = "application/json"
                     data = json.dumps(kw).encode()
                     continue
+                if (str(out.get("error", "")).startswith("Backpressure")
+                        and bp_honored < bp_budget):
+                    # The server is shedding load and named its own
+                    # price.  Honor it: sleep a jittered fraction of
+                    # retry_after_s and re-send the SAME request (same
+                    # idem key) WITHOUT charging the transport retry
+                    # budget — the bytes made it there and back, the
+                    # server just said "not yet".
+                    bp_honored += 1
+                    try:
+                        retry_after = float(out.get("retry_after_s", 1.0))
+                    except (TypeError, ValueError):
+                        retry_after = 1.0
+                    reg = _metrics.registry()
+                    reg.counter("backpressure.client.honored").inc()
+                    reg.histogram("backpressure.client.retry_after.s"
+                                  ).observe(retry_after)
+                    time.sleep(retry_after
+                               * (0.5 + self._jitter.random()))
+                    continue
                 break
             except (URLError, OSError, InjectedFault) as e:
                 attempts += 1
@@ -1727,6 +1922,20 @@ class _Rpc:
                 # TRANSIENT_ERRORS — blind retry of a rate refusal is
                 # exactly the traffic the quota exists to shed.
                 raise QuotaExceeded(f"netstore server: {out['error']}")
+            if out["error"].startswith("Backpressure"):
+                # The shed outlived the honor budget: surface the typed
+                # error so the caller can decide (a routed client has
+                # already been told N times to come back later).
+                try:
+                    _ra = float(out.get("retry_after_s", 1.0))
+                except (TypeError, ValueError):
+                    _ra = 1.0
+                raise Backpressure(f"netstore server: {out['error']}",
+                                   retry_after_s=_ra)
+            if out["error"].startswith("ShardFenced"):
+                # Typed retriable redirect — a routed client refreshes
+                # its shard map and re-places itself (_RoutedRpc).
+                raise ShardFenced(f"netstore server: {out['error']}")
             raise RuntimeError(f"netstore server: {out['error']}")
         return out
 
@@ -2098,6 +2307,8 @@ class _RoutedRpc:
             rpc = self._shard_rpc
         try:
             return rpc(verb, **kw)
+        except ShardFenced:
+            return self._redirect(verb, kw)
         except NetstoreUnavailable:
             # Primary gone — and since the data path is direct, the
             # router may not know yet.  Push this very verb THROUGH the
@@ -2112,6 +2323,32 @@ class _RoutedRpc:
             except (NetstoreUnavailable, RuntimeError, OSError):
                 pass                 # best effort; next call retries it
             return out
+
+    def _redirect(self, verb: str, kw: dict) -> dict:
+        """Typed retriable redirect: the owning store (or its whole
+        shard) fenced for a bounded cutover — rebalance, promotion, or
+        a per-store migration.  Refresh the map and re-place; the fence
+        lifts by the MAP changing, not by waiting it out, so each retry
+        re-fetches topology first.  Bounded by the client timeout: a
+        fence that outlives it is an operator problem and the typed
+        error surfaces."""
+        _metrics.registry().counter("netstore.client.redirects").inc()
+        deadline = time.monotonic() + max(float(self.timeout), 5.0)
+        delay = 0.05
+        while True:
+            try:
+                self._refresh_map(force=True)
+            except (NetstoreUnavailable, RuntimeError, OSError):
+                pass             # router briefly busy: retry below
+            with self._lock:
+                rpc = self._shard_rpc
+            try:
+                return rpc(verb, **kw)
+            except ShardFenced:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(delay * (0.5 + rpc._jitter.random()))
+                delay = min(delay * 2.0, 0.5)
 
 
 class RouterTrials(NetTrials):

@@ -18,7 +18,11 @@ Layers (each usable alone):
 * :mod:`.replica` — :class:`ShardServer` (role-aware primary/replica)
   + :class:`WalShipper` (snapshot+tail WAL shipping, scrub);
 * :mod:`.router` — :class:`Router`, the stateless consistent-hash
-  front with kill-tolerant failover and bounded-cutover rebalance.
+  front with kill-tolerant failover, bounded-cutover rebalance, elastic
+  ``shard_add``/``shard_remove`` and multi-router ``map_sync`` HA;
+* :mod:`.autoscaler` — :class:`Autoscaler`, the SLO-burn control loop
+  driving those verbs (scale up/down, shed/recover) with a WAL-durable
+  decision log.
 """
 
 from .cluster import DEFAULT_VNODES, HashRing, ShardMap, key_hash
@@ -27,9 +31,10 @@ from .tenancy import Tenant, TenantTable, TokenBucket
 from .wal import Wal, inspect, read_wal
 
 __all__ = [
-    "DEFAULT_VNODES", "HashRing", "MemTrials", "Router", "ServiceServer",
-    "ShardMap", "ShardServer", "Tenant", "TenantTable", "TokenBucket",
-    "Wal", "WalShipper", "inspect", "key_hash", "read_wal",
+    "Autoscaler", "DEFAULT_VNODES", "HashRing", "LocalSpawner",
+    "MemTrials", "Router", "ServiceServer", "ShardMap", "ShardServer",
+    "Tenant", "TenantTable", "TokenBucket", "Wal", "WalShipper",
+    "inspect", "key_hash", "read_wal",
 ]
 
 
@@ -46,4 +51,7 @@ def __getattr__(name):
     if name == "Router":
         from .router import Router
         return Router
+    if name in ("Autoscaler", "LocalSpawner"):
+        from . import autoscaler
+        return getattr(autoscaler, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

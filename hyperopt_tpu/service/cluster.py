@@ -124,7 +124,7 @@ class ShardMap:
     """
 
     def __init__(self, shards: dict, virtual_nodes: int | None = None,
-                 version: int = 1):
+                 version: int = 1, pins: dict | None = None):
         self.version = int(version)
         self.shards = {
             str(sid): {"primary": str(ent["primary"]).rstrip("/"),
@@ -134,11 +134,70 @@ class ShardMap:
         if not self.shards:
             raise ValueError("shard map needs at least one shard")
         self.ring = HashRing(self.shards, virtual_nodes=virtual_nodes)
+        # Placement pins: "<tenant>\x00<exp_key>" -> shard id, overriding
+        # the ring for that one store.  The elastic-scale cutover uses
+        # them as the bounded in-between state: each migrated store is
+        # pinned to its destination the moment its import commits, and
+        # the pin set clears atomically when the ring itself changes
+        # (shard added/removed) — clients only ever see ring+pins as one
+        # versioned document, so placement is never ambiguous.
+        self.pins: dict = {str(k): str(v) for k, v in (pins or {}).items()
+                           if str(v) in self.shards}
+
+    @staticmethod
+    def pin_key(tenant, exp_key: str) -> str:
+        """Wire-safe placement key (NUL-separated, like key_hash)."""
+        return f"{tenant or ''}\x00{exp_key}"
 
     def owner(self, tenant, exp_key: str):
         """``(shard_id, entry)`` owning the ``(tenant, exp_key)`` store."""
-        sid = self.ring.owner(tenant, exp_key)
+        sid = self.pins.get(self.pin_key(tenant, exp_key))
+        if sid is None or sid not in self.shards:
+            sid = self.ring.owner(tenant, exp_key)
         return sid, self.shards[sid]
+
+    def pin(self, tenant, exp_key: str, sid: str) -> None:
+        """Pin one store to ``sid`` (bounded-cutover override)."""
+        if sid not in self.shards:
+            raise ValueError(f"cannot pin to unknown shard {sid!r}")
+        self.pins[self.pin_key(tenant, exp_key)] = sid
+        self.version += 1
+
+    def add_shard(self, sid: str, entry: dict) -> dict:
+        """Grow the ring by one shard.  Existing pins are preserved —
+        the migration that is about to move keys onto the new shard
+        replaces them store by store, then clears them via
+        :meth:`clear_pins` once the moved set is consistent."""
+        sid = str(sid)
+        if sid in self.shards:
+            raise ValueError(f"shard {sid!r} already in the map")
+        self.shards[sid] = {
+            "primary": str(entry["primary"]).rstrip("/"),
+            "replica": (str(entry["replica"]).rstrip("/")
+                        if entry.get("replica") else None)}
+        self.ring.add(sid)
+        self.version += 1
+        return self.shards[sid]
+
+    def remove_shard(self, sid: str) -> None:
+        """Shrink the ring by one shard (its keys must already have
+        been migrated off — the router enforces that ordering)."""
+        sid = str(sid)
+        if sid not in self.shards:
+            raise ValueError(f"shard {sid!r} not in the map")
+        if len(self.shards) == 1:
+            raise ValueError("cannot remove the last shard")
+        del self.shards[sid]
+        self.ring.remove(sid)
+        self.pins = {k: v for k, v in self.pins.items() if v != sid}
+        self.version += 1
+
+    def clear_pins(self) -> None:
+        """Drop every placement pin (ring placement now agrees with the
+        pinned placement — the migration's terminal state)."""
+        if self.pins:
+            self.pins = {}
+            self.version += 1
 
     def promote(self, sid: str) -> dict:
         """Failover: the warm replica becomes the primary.  Returns the
@@ -161,12 +220,15 @@ class ShardMap:
         return ent
 
     def to_dict(self) -> dict:
-        return {"version": self.version,
-                "virtual_nodes": self.ring.virtual_nodes,
-                "shards": {sid: dict(ent)
-                           for sid, ent in sorted(self.shards.items())}}
+        doc = {"version": self.version,
+               "virtual_nodes": self.ring.virtual_nodes,
+               "shards": {sid: dict(ent)
+                          for sid, ent in sorted(self.shards.items())}}
+        if self.pins:
+            doc["pins"] = dict(sorted(self.pins.items()))
+        return doc
 
     @classmethod
     def from_dict(cls, doc: dict) -> "ShardMap":
         return cls(doc["shards"], virtual_nodes=doc.get("virtual_nodes"),
-                   version=doc.get("version", 1))
+                   version=doc.get("version", 1), pins=doc.get("pins"))

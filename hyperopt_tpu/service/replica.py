@@ -28,9 +28,22 @@ before the primary died → the retry dedupes) or it never reached the
 log (→ the retry executes for the first time).  Both timelines contain
 the verb exactly once.
 
-Chained replication (a replica shipping onward) is deliberately out of
-scope: one primary ships to its replicas, promotion re-arms shipping
-from the new primary (``replica_attach``).
+**Chained replication**: a replica can itself ship onward — attach a
+downstream target to it (``--replicate-to`` or the ``replica_attach``
+verb) and every ``wal_ship`` batch it applies re-appends locally, which
+fires the same WAL listener the primary uses and forwards the records
+down the chain (P→R1→R2→…).  The primary's fan-out cost is O(1) in the
+replication factor; gap detection and snapshot resync work hop-by-hop
+(R2 missing records asks R1, never the primary), and the scrub verb
+proves byte-identity at EVERY hop because each link runs the identical
+apply path.
+
+A whole-shard **fence** (the ``fence`` verb) quiesces a primary for a
+bounded cutover: mutating client verbs get the typed retriable
+:class:`~hyperopt_tpu.exceptions.ShardFenced` redirect, parked
+long-poll claimants are woken immediately (they must not doze out the
+cutover window), and replication/control verbs keep flowing so the
+handoff itself can finish.
 """
 
 from __future__ import annotations
@@ -43,7 +56,7 @@ import time
 from collections import deque
 
 from .. import faults as _faults
-from ..exceptions import InjectedFault, NetstoreUnavailable
+from ..exceptions import InjectedFault, NetstoreUnavailable, ShardFenced
 from ..obs import bundle as _obs_bundle
 from ..obs import flight as _flight
 from ..obs import metrics as _metrics
@@ -54,10 +67,12 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["ShardServer", "WalShipper", "main"]
 
-#: Replication verbs a ShardServer answers itself; everything else runs
-#: the inherited WAL dispatch (mutations fenced while role=replica).
+#: Replication/cutover verbs a ShardServer answers itself; everything
+#: else runs the inherited WAL dispatch (mutations fenced while
+#: role=replica, or while a whole-shard ``fence`` is up).
 _REPLICATION_VERBS = frozenset({
-    "wal_ship", "snapshot_install", "scrub", "promote", "replica_attach"})
+    "wal_ship", "snapshot_install", "scrub", "promote", "replica_attach",
+    "fence"})
 
 
 def _env_int(name: str, default: int) -> int:
@@ -285,6 +300,16 @@ class ShardServer(ServiceServer):
             raise ValueError(f"role {role!r}: want primary|replica")
         self._role = role
         self._shippers: list = []
+        # Whole-shard cutover fence (the ``fence`` verb): while set,
+        # client mutating verbs get the typed ShardFenced redirect and
+        # parked long-poll claimants are woken to surface it.  Ephemeral
+        # by design — a restarted shard comes back unfenced and the
+        # router re-fences if its cutover is still in flight.
+        self._fence_all = False
+        # Highest promotion epoch observed (a router passes its shard-map
+        # version): a stale router whose map predates the last topology
+        # change cannot promote this shard backwards.
+        self._promote_epoch: int | None = None
         self._ship_token = (ship_token if ship_token is not None
                             else kw.get("token"))
         self._scrub_interval = scrub_interval
@@ -371,11 +396,21 @@ class ShardServer(ServiceServer):
         if verb == "scrub":
             return self._scrub_verb()
         if verb == "promote":
-            return self._promote_verb()
+            return self._promote_verb(req)
         if verb == "replica_attach":
             self.attach_replica(req["url"])
             return {"attached": req["url"],
                     "n_replicas": len(self._shippers)}
+        if verb == "fence":
+            return self._fence_verb(req)
+        if (self._fence_all and not self._replaying
+                and verb in ServiceServer._WAL_VERBS):
+            # Whole-shard cutover fence: a typed retriable redirect —
+            # the client refreshes its map and lands wherever the
+            # cutover put the store.
+            _metrics.registry().counter("shard.fenced").inc()
+            raise ShardFenced(
+                f"shard fenced for cutover: refusing {verb!r}")
         if (self._role == "replica" and not self._replaying
                 and verb in ServiceServer._WAL_VERBS):
             # Fence: a write reaching an unpromoted replica would fork
@@ -384,6 +419,24 @@ class ShardServer(ServiceServer):
             raise RuntimeError(
                 f"shard is a replica (not promoted): refusing {verb!r}")
         return super()._dispatch_verb(verb, req, tenant=tenant, idem=idem)
+
+    def _fence_verb(self, req: dict) -> dict:
+        """Raise or drop the whole-shard cutover fence.  Raising it
+        wakes EVERY parked long-poll claimant — a ``reserve(wait_s=W)``
+        dozing on its claim gate must surface the typed redirect now,
+        not after the cutover window has already expired."""
+        up = bool(req.get("up", True))
+        self._fence_all = up
+        reg = _metrics.registry()
+        reg.gauge("shard.fence_up").set(1.0 if up else 0.0)
+        if up:
+            reg.counter("shard.fences").inc()
+            with self._claim_gates_lock:
+                gates = list(self._claim_gates.values())
+            for gate in gates:
+                gate.signal()
+            EVENTS.emit("shard_fence", up=True)
+        return {"ok": True, "fenced": up}
 
     def _wal_ship_verb(self, req: dict) -> dict:
         """Apply a shipped tail batch in log order.  Records at or below
@@ -441,13 +494,34 @@ class ShardServer(ServiceServer):
                     "hash": _obs_bundle.state_hash(self.state_bytes()),
                     "role": self._role}
 
-    def _promote_verb(self) -> dict:
+    def _promote_verb(self, req: dict | None = None) -> dict:
+        """Role flip to primary — idempotent (re-promoting a primary is
+        a no-op; ``shard.promotions`` counts actual transitions only,
+        which is what makes N routers racing one dead primary provably
+        single-flight: total promotions across the fleet == 1).  An
+        optional ``epoch`` (the caller's shard-map version) is a
+        monotonic guard: a router whose map predates the last observed
+        topology change is refused, so a laggard cannot re-promote after
+        a newer cutover moved primacy elsewhere."""
+        epoch = (req or {}).get("epoch")
         with self._lock:
+            if epoch is not None:
+                epoch = int(epoch)
+                if (self._promote_epoch is not None
+                        and epoch < self._promote_epoch):
+                    _metrics.registry().counter(
+                        "shard.promote.stale").inc()
+                    return {"role": self._role, "was": self._role,
+                            "seq": self._wal.seq, "stale": True,
+                            "epoch": self._promote_epoch}
+                self._promote_epoch = max(self._promote_epoch or 0, epoch)
             was = self._role
             self._role = "primary"
+            self._fence_all = False
             seq = self._wal.seq
         reg = _metrics.registry()
         reg.gauge("shard.role").set(1.0)
+        reg.gauge("shard.fence_up").set(0.0)
         if was != "primary":
             reg.counter("shard.promotions").inc()
             EVENTS.emit("shard_promote", seq=seq)

@@ -39,6 +39,7 @@ import time
 
 from .. import faults as _faults
 from ..base import JOB_STATE_RUNNING, coarse_utcnow
+from ..exceptions import ShardFenced
 from ..obs import bundle as _obs_bundle
 from ..obs import flight as _flight
 from ..obs import metrics as _metrics
@@ -240,7 +241,7 @@ class ServiceServer(StoreServer):
     _WAL_VERBS = frozenset({
         "insert_docs", "new_trial_ids", "reserve", "heartbeat",
         "write_result", "requeue_stale", "delete_all", "put_domain",
-        "att_set", "att_del", "suggest"})
+        "att_set", "att_del", "suggest", "store_fence", "store_import"})
 
     def __init__(self, wal_dir: str, host: str = "127.0.0.1", port: int = 0,
                  token: str | None = None, tenants=None,
@@ -305,6 +306,15 @@ class ServiceServer(StoreServer):
         tname = getattr(tenant, "name", tenant)
         exp_key = req.get("exp_key", "default")
         with self._lock:
+            # Migration fence gate BEFORE the append (same discipline as
+            # the quota gates): a fenced store's refusal must leave no
+            # durable trace, or replay would re-raise mid-recovery.
+            if (self._store(exp_key, tenant=tname).fenced
+                    and verb not in ("store_fence", "store_import")):
+                _metrics.registry().counter("store.fenced").inc()
+                raise ShardFenced(
+                    f"store {exp_key!r} is fenced (migrating): "
+                    f"refusing {verb!r}")
             t = coarse_utcnow()
             seq0 = self._wal.seq
             if verb == "suggest":
@@ -432,7 +442,7 @@ class ServiceServer(StoreServer):
                 state = self._trials[key].state_dict()
                 if not (state["docs"] or state["allocated"]
                         or state["claims"] or state["domain_blob"]
-                        or state["attachments"]):
+                        or state["attachments"] or state.get("fenced")):
                     # A store only ever touched by reads: semantically
                     # absent — replay of the (write-only) log would not
                     # recreate it, and it must not break byte-identity.

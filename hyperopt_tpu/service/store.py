@@ -67,6 +67,13 @@ class MemTrials(Trials):
     def __init__(self, exp_key: str = "default", refresh=True):
         # Claim table: tid -> owner (the .claim files of the filestore).
         self._claims: dict = {}
+        # Migration fence: a fenced store refuses mutating verbs at the
+        # server dispatch layer (typed ShardFenced redirect) while its
+        # state moves to another shard.  Durable — it rides state_dict()
+        # and the WAL ``store_fence`` record — so a donor that crashes
+        # mid-migration recovers still fenced instead of resurrecting a
+        # store whose ownership moved away.
+        self._fenced: bool = False
         # tids handed out by new_trial_ids but possibly not yet inserted
         # (the filestore's exclusive-create marker files).
         self._allocated: set = set()
@@ -523,6 +530,7 @@ class MemTrials(Trials):
                 "attachments": {
                     str(k): base64.b64encode(self._att_blob(k)).decode()
                     for k in sorted(self.attachments, key=str)},
+                "fenced": bool(self._fenced),
             }
 
     def state_bytes(self) -> bytes:
@@ -532,8 +540,54 @@ class MemTrials(Trials):
         from ..parallel.filestore import _pickler
         return _pickler.dumps(self.attachments[key])
 
+    @property
+    def fenced(self) -> bool:
+        return self._fenced
+
+    def fence(self, drop: bool = False, lift: bool = False) -> None:
+        """Raise (or, with ``drop``, finalize) the migration fence.
+
+        ``drop=False`` quiesces the store: it stays readable (the
+        migration exports through the read path) but the dispatch layer
+        refuses mutations.  ``drop=True`` is the donor-side tombstone
+        after a successful export — the moved documents are released so
+        the donor's memory shrinks, while the fence itself stays set so
+        a stale client retry can never fork the moved store.
+        ``lift=True`` is the migration ROLLBACK: a cutover that failed
+        before the import landed moved nothing, so the fence must not
+        outlive it — the store returns to service with every document
+        and claim intact.  All three are WAL-replayed (``store_fence``),
+        so recovery lands in the same place."""
+        with self._lock:
+            if lift:
+                self._fenced = False
+                return
+            self._fenced = True
+            if drop:
+                self._claims = {}
+                self._allocated = set()
+                self._by_tid = {}
+                self._ids = set()
+                self._domain_blob = None
+                self.attachments = {}
+                self._epoch = self._new_epoch()
+                self._seq_mut = 0
+                self._revs = {}
+                self._live = set()
+                self._done_tids = []
+                self._done_set = set()
+                self._done_pending = []
+                self._col = None
+                self._col_dirty = True
+                self._pos = {}
+                self._tpos = {}
+                self._list_dirty = True
+                self._export_cache = None
+                self.refresh()
+
     def load_state(self, state: dict) -> None:
         with self._lock:
+            self._fenced = bool(state.get("fenced", False))
             self._by_tid = {d["tid"]: dict(d) for d in state["docs"]}
             self._claims = {int(t): o
                             for t, o in state.get("claims", {}).items()}

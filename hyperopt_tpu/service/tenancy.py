@@ -91,6 +91,30 @@ class Tenant:
             return True
         return self.bucket.take(float(n))
 
+    def set_admission_scale(self, factor: float) -> None:
+        """Tighten (or restore) the admission rate to ``factor`` × the
+        configured ``trials_per_s``.
+
+        This is the autoscaler's graceful-degradation knob: when the
+        fleet cannot grow, admission is squeezed fleet-wide instead of
+        letting queues build unboundedly.  Idempotent and lossless —
+        the configured rate is never overwritten, so ``factor=1.0``
+        restores exactly the original quota.  Accumulated tokens are
+        clamped to the new burst so a tightened tenant cannot spend a
+        pre-tightening surplus.  Tenants with no rate quota configured
+        stay unlimited (there is nothing to scale).
+        """
+        if self.trials_per_s is None:
+            return
+        factor = max(0.0, float(factor))
+        rate = self.trials_per_s * factor
+        if self.bucket is None or factor <= 0.0:
+            self.bucket = TokenBucket(max(rate, 1e-9))
+            return
+        self.bucket.rate = rate
+        self.bucket.burst = max(1.0, rate)
+        self.bucket.tokens = min(self.bucket.tokens, self.bucket.burst)
+
     def __repr__(self):  # never echo the token
         return (f"Tenant({self.name!r}, max_claims={self.max_claims}, "
                 f"trials_per_s={self.trials_per_s})")

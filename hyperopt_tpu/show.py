@@ -351,6 +351,31 @@ def render_live(snap: dict, out=None, prev=None) -> dict:
                           f"{fwd:>6d} {pct(h, 'p50')} {pct(h, 'p95')} "
                           f"{pct(h, 'p99')}  "
                           f"{info.get('error', '?')}", file=out)
+    # AUTOSCALE: the control plane's decision-log tail (snap["autoscale"],
+    # present when an Autoscaler is attached to the polled router).  Each
+    # row is one WAL-durable decision — the runbook's first stop when a
+    # topology change needs explaining.
+    auto = snap.get("autoscale")
+    if auto is not None:
+        if auto.get("error"):
+            print(f"autoscale: UNAVAILABLE {auto['error']}", file=out)
+        else:
+            shed = auto.get("shed_level", 0.0)
+            print(f"autoscale: {'running' if auto.get('running') else 'idle'}"
+                  f"   shed {shed:.0%}   calm {auto.get('calm', 0)}/"
+                  f"{auto.get('calm_ticks', '?')}   bounds "
+                  f"[{auto.get('min_shards', '?')}, "
+                  f"{auto.get('max_shards', '?')}] shards", file=out)
+            decisions = auto.get("decisions") or []
+            for d in decisions[-6:]:
+                when = time.strftime("%H:%M:%S",
+                                     time.localtime(d.get("t", 0)))
+                ok = ("ok" if d.get("ok")
+                      else f"FAILED {d.get('error', '')}")
+                print(f"  {when} {d.get('action', '?'):<11s} "
+                      f"burn {d.get('burn', 0):>6.2f}  "
+                      f"shards {d.get('shards', '?'):>2}  {ok}  "
+                      f"{d.get('reason', '')}", file=out)
     occ = gauges.get("pipeline.occupancy", m_gauges.get("pipeline.occupancy"))
     backlog = gauges.get("pipeline.eval_backlog",
                          m_gauges.get("pipeline.eval_backlog"))

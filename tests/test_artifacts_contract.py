@@ -538,6 +538,71 @@ class TestBenchmarkArtifacts:
                 f"{name}: lost or duplicated trials across failover")
             assert head["zero_leakage"] is True, name
 
+    def test_elastic_load_artifact_schema(self):
+        """ISSUE 20 acceptance artifact: ≥100k open-loop worker
+        identities on a diurnal + flash-crowd arrival process against
+        the self-driving elastic fleet — autoscaler scale-ups under
+        backlog burn, socket-kills of both seeded primaries mid-ramp
+        with single-flight promotion, bounded per-store cutovers, and a
+        WAL decision log that replays — written by
+        benchmarks/elastic_load.py."""
+        paths = sorted(glob.glob(os.path.join(
+            _BENCH_DIR, "elastic_load_*.json")))
+        assert paths, \
+            "no benchmarks/elastic_load_*.json artifact checked in"
+        for path in paths:
+            name = os.path.basename(path)
+            with open(path) as fh:
+                doc = json.load(fh)
+            assert doc["metric"] == "elastic_load_openloop", name
+            assert doc["backend"] in ("cpu", "tpu", "gpu"), name
+            assert "timestamp" in doc, name
+            # the worker cycle, the replication plane (shipping +
+            # promotion after the kills) AND the migration plane (the
+            # autoscaler's bounded cutovers) must all have been
+            # exercised
+            verbs = {r["verb"] for r in doc["rows"]}
+            assert {"reserve", "write_result", "wal_ship", "promote",
+                    "store_export", "store_import"} <= verbs, name
+            for r in doc["rows"]:
+                assert {"verb", "count", "p50_ms", "p95_ms",
+                        "p99_ms"} <= set(r), f"{name}: {r}"
+                assert r["count"] > 0, f"{name}: {r}"
+                assert 0 <= r["p50_ms"] <= r["p95_ms"] <= r["p99_ms"], \
+                    f"{name}: {r}"
+            for k in doc["exp_keys"]:
+                assert k["dups"] == 0, f"{name}: {k}"
+                assert k["tid_range_ok"] is True, f"{name}: {k}"
+                assert k["stamp_leaks"] == 0, f"{name}: {k}"
+            # per-phase percentiles: the flash crowd really ran, and
+            # every percentile block is internally ordered
+            ol = doc["open_loop"]
+            for phase in ("overall", "base", "flash"):
+                p = ol[phase]
+                assert p["cycles"] > 0, f"{name}: {phase}"
+                assert 0 <= p["p50_ms"] <= p["p95_ms"] <= p["p99_ms"], \
+                    f"{name}: {phase}: {p}"
+            el = doc["elastic"]
+            assert el["scale_ups"] >= 1, (
+                f"{name}: the flash crowd never grew the fleet")
+            assert el["migrated_stores"] > 0, name
+            assert el["replay_ok"] is True, (
+                f"{name}: decision log did not replay")
+            assert el["decisions_total"] >= el["scale_ups"], name
+            head = doc["headline"]
+            assert head["workers"] >= 100_000, name
+            assert head["kills"] >= 2, (
+                f"{name}: chaos too gentle — "
+                f"{head['kills']} < 2 primary kills")
+            assert head["promotions"] >= head["kills"], name
+            assert head["completed"] is True, name
+            assert head["zero_lost_dup"] is True, (
+                f"{name}: lost or duplicated trials across "
+                f"failover/migration")
+            assert head["zero_leakage"] is True, name
+            assert head["decision_log_replays"] is True, name
+            assert head["p99_ms"] is not None, name
+
     def test_service_hotpath_ab_artifact_schema(self):
         """ISSUE 18 acceptance artifact: interleaved A/B arms over a
         multi-tenant service shape at fsync=always — pooled keep-alive

@@ -200,6 +200,74 @@ class TestPoolStaleReconnect:
             srv2.shutdown()
 
 
+class TestPoolPoisonFlush:
+    def test_failed_redial_flushes_sibling_corpses(self, tmp_path,
+                                                   monkeypatch):
+        """The poisoning window: a server restart leaves SEVERAL idle
+        keep-alive sockets dead, and the redial for the first corpse
+        fails too (``rpc.connect`` fault).  The pool must flush every
+        sibling socket for that host right there — otherwise each later
+        verb checks out another corpse and pays the stale-redial dance
+        once per socket.  One verb with ``retries=1`` absorbs the whole
+        episode, and the follow-up verbs see a clean pool."""
+        from hyperopt_tpu import faults
+        from hyperopt_tpu.parallel.netstore import _rpc_pool
+
+        monkeypatch.setenv("HYPEROPT_TPU_RPC_POOL", "8")
+        root = str(tmp_path / "store")
+        srv = StoreServer(root)
+        host, port = srv.start()
+        srv_down = False
+        try:
+            # Warm THREE pooled sockets: three concurrent long-poll
+            # reserves each hold a distinct connection while parked,
+            # and all three check in at timeout.
+            def parked_reserve():
+                NetTrials(srv.url, exp_key="e",
+                          refresh=False).reserve("w", wait_s=0.5)
+
+            threads = [threading.Thread(target=parked_reserve)
+                       for _ in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10)
+            assert all(not t.is_alive() for t in threads)
+            idle = _rpc_pool()._idle.get((host, port), [])
+            assert len(idle) == 3, "test needs 3 pooled sockets"
+
+            f0 = _counter("rpc.pool.flushed")
+            s0 = _counter("rpc.pool.stale_reconnects")
+            srv.shutdown()
+            srv_down = True
+            srv2 = StoreServer(root, host=host, port=port)
+            srv2.start()
+            try:
+                # The redial for the first corpse is made to fail too.
+                faults.configure(
+                    {"rpc.connect": {"prob": 1.0, "times": 1}})
+                nt = NetTrials(srv2.url, exp_key="e", retries=1,
+                               refresh=False)
+                assert nt.new_trial_ids(1) == [0]
+                # One stale checkout, failed redial, BOTH sibling
+                # corpses flushed — then the retry fresh-dials clean.
+                assert _counter("rpc.pool.flushed") == f0 + 2
+                assert _counter("rpc.pool.stale_reconnects") == s0 + 1
+                # The regression guard: follow-up verbs never touch
+                # another corpse (an unflushed pool would redial once
+                # per remaining socket).
+                assert nt.new_trial_ids(1) == [1]
+                assert nt.new_trial_ids(1) == [2]
+                assert _counter("rpc.pool.stale_reconnects") == s0 + 1
+                assert _counter("rpc.pool.flushed") == f0 + 2
+            finally:
+                faults.clear()
+                srv2.shutdown()
+        finally:
+            if not srv_down:
+                srv.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # long-poll claims
 # ---------------------------------------------------------------------------
